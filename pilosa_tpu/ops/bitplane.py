@@ -1,0 +1,299 @@
+"""Dense bit-plane representation and XLA bitmap ops.
+
+The unit of storage is a *slice-row*: one row of one fragment, covering
+SLICE_WIDTH = 2^20 columns, stored as 32,768 uint32 words (128 KiB).  A
+fragment is a plane of shape (rows, WORDS_PER_SLICE).  Bit ``i`` of a
+slice-row (column ``slice*SLICE_WIDTH + i``) lives at word ``i >> 5``,
+bit ``i & 31`` (little-endian within the word, matching the reference's
+roaring bitmap-container layout where word ``w`` holds values
+``[w*64, w*64+64)`` — we use uint32 words because TPUs have no uint64).
+
+These functions replace the reference's per-container sorted-merge kernels
+and popcount assembly (reference: roaring/roaring.go:1259-1716,
+roaring/assembly_amd64.s) with whole-row vector ops: XLA fuses the bitwise
+op into the popcount reduce, so ``count_and`` etc. never materialize the
+intermediate row in HBM.  On TPU, the fused count family can also route
+through the Pallas kernels in :mod:`pilosa_tpu.ops.kernels`.
+
+All counts are returned as int32 device scalars (a slice-row holds at most
+2^20 bits, and a full plane reduce stays far below 2^31); callers accumulate
+cross-slice totals in Python ints.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Matches the reference: SliceWidth = 2^20 (reference: fragment.go:47).
+SLICE_WIDTH = 1 << 20
+WORD_BITS = 32
+WORDS_PER_SLICE = SLICE_WIDTH // WORD_BITS  # 32768 words = 128 KiB
+# A roaring container spans 2^16 bits (reference: roaring/roaring.go:36).
+CONTAINER_BITS = 1 << 16
+WORDS_PER_CONTAINER = CONTAINER_BITS // WORD_BITS  # 2048
+CONTAINERS_PER_SLICE = SLICE_WIDTH // CONTAINER_BITS  # 16
+
+# Rows are padded to multiples of ROW_BLOCK so query shapes bucket into a
+# small set of compiled programs (avoids XLA recompilation storms when
+# maxRowID grows one row at a time).
+ROW_BLOCK = 8
+
+
+def row_shape() -> tuple[int]:
+    return (WORDS_PER_SLICE,)
+
+
+def empty_row() -> np.ndarray:
+    return np.zeros(WORDS_PER_SLICE, dtype=np.uint32)
+
+
+def empty_plane(rows: int) -> np.ndarray:
+    return np.zeros((rows, WORDS_PER_SLICE), dtype=np.uint32)
+
+
+def pad_rows(rows: int) -> int:
+    """Round a row count up to the shape bucket."""
+    if rows <= 0:
+        return ROW_BLOCK
+    return ((rows + ROW_BLOCK - 1) // ROW_BLOCK) * ROW_BLOCK
+
+
+# ---------------------------------------------------------------------------
+# Host-side (numpy) bit manipulation — the write path.  Mutations happen on
+# the host-resident authoritative plane; device mirrors are refreshed lazily
+# (see core/fragment.py).
+# ---------------------------------------------------------------------------
+
+
+def np_set_bit(plane: np.ndarray, bit: int) -> bool:
+    """Set bit ``bit`` (a fragment position: row*SLICE_WIDTH + col%SLICE_WIDTH
+    flattened into the plane).  Returns True if the bit changed."""
+    row, offset = divmod(bit, SLICE_WIDTH)
+    word, shift = divmod(offset, WORD_BITS)
+    mask = np.uint32(1 << shift)
+    old = plane[row, word]
+    if old & mask:
+        return False
+    plane[row, word] = old | mask
+    return True
+
+
+def np_clear_bit(plane: np.ndarray, bit: int) -> bool:
+    row, offset = divmod(bit, SLICE_WIDTH)
+    word, shift = divmod(offset, WORD_BITS)
+    mask = np.uint32(1 << shift)
+    old = plane[row, word]
+    if not (old & mask):
+        return False
+    plane[row, word] = old & ~mask
+    return True
+
+
+def np_contains(plane: np.ndarray, bit: int) -> bool:
+    row, offset = divmod(bit, SLICE_WIDTH)
+    word, shift = divmod(offset, WORD_BITS)
+    return bool((int(plane[row, word]) >> shift) & 1)
+
+
+def np_set_bulk(plane: np.ndarray, rows: np.ndarray, offsets: np.ndarray) -> None:
+    """Bulk set: vectorized scatter-OR for imports (reference:
+    fragment.go:936-1004 bulk Import path)."""
+    words = offsets // WORD_BITS
+    masks = (np.uint32(1) << (offsets % WORD_BITS).astype(np.uint32)).astype(np.uint32)
+    np.bitwise_or.at(plane, (rows, words), masks)
+
+
+def np_row_to_columns(row_words: np.ndarray) -> np.ndarray:
+    """Expand one slice-row's set bits into sorted uint64 column offsets
+    within the slice (0 .. SLICE_WIDTH)."""
+    bits = np.unpackbits(
+        np.ascontiguousarray(row_words).view(np.uint8), bitorder="little"
+    )
+    (positions,) = np.nonzero(bits)
+    return positions.astype(np.uint64)
+
+
+def np_columns_to_row(offsets: np.ndarray) -> np.ndarray:
+    """Inverse of np_row_to_columns: bit offsets (within slice) -> row words."""
+    row = empty_row()
+    if len(offsets) == 0:
+        return row
+    offsets = np.asarray(offsets, dtype=np.uint64)
+    words = (offsets // WORD_BITS).astype(np.int64)
+    masks = (np.uint32(1) << (offsets % WORD_BITS).astype(np.uint32)).astype(np.uint32)
+    np.bitwise_or.at(row, words, masks)
+    return row
+
+
+def np_count(words: np.ndarray) -> int:
+    """Host popcount (the CPU reference path, equivalent of the reference's
+    pure-Go popcntSlice fallback, reference: roaring/assembly.go:21-28)."""
+    return int(np.unpackbits(words.view(np.uint8)).sum())
+
+
+# ---------------------------------------------------------------------------
+# Device ops (XLA).  Everything below is jit-compiled; shapes are static per
+# (rows,) bucket.  These are the hot kernels: the equivalents of the
+# reference's popcntAndSlice/popcntOrSlice/popcntXorSlice asm procs and the
+# materializing container merges.
+# ---------------------------------------------------------------------------
+
+
+def _popcount_sum(words: jnp.ndarray) -> jnp.ndarray:
+    """Sum of set bits over the whole array -> int32 scalar."""
+    return jnp.sum(jax.lax.population_count(words).astype(jnp.int32))
+
+
+def _use_pallas() -> bool:
+    if os.environ.get("PILOSA_TPU_DISABLE_PALLAS"):
+        return False
+    return jax.default_backend() == "tpu"
+
+
+@jax.jit
+def count(words):
+    """Popcount of a row/plane (reference: popcntSliceAsm)."""
+    if _use_pallas():
+        from pilosa_tpu.ops import kernels
+
+        return kernels.count(words)
+    return _popcount_sum(words)
+
+
+@jax.jit
+def count_and(a, b):
+    """|a AND b| without materializing (reference: intersectionCount*,
+    roaring/roaring.go:1259-1347, popcntAndSliceAsm)."""
+    if _use_pallas():
+        from pilosa_tpu.ops import kernels
+
+        return kernels.fused_count(a, b, "and")
+    return _popcount_sum(a & b)
+
+
+@jax.jit
+def count_or(a, b):
+    if _use_pallas():
+        from pilosa_tpu.ops import kernels
+
+        return kernels.fused_count(a, b, "or")
+    return _popcount_sum(a | b)
+
+
+@jax.jit
+def count_xor(a, b):
+    if _use_pallas():
+        from pilosa_tpu.ops import kernels
+
+        return kernels.fused_count(a, b, "xor")
+    return _popcount_sum(a ^ b)
+
+
+@jax.jit
+def count_andnot(a, b):
+    """|a AND NOT b| (reference: popcntMaskSliceAsm / differenceCount)."""
+    if _use_pallas():
+        from pilosa_tpu.ops import kernels
+
+        return kernels.fused_count(a, b, "andnot")
+    return _popcount_sum(a & ~b)
+
+
+# Materializing set algebra (reference: roaring/roaring.go:345-474 dispatch,
+# 1349-1716 kernels) — a single vector op on the dense plane.
+
+
+@jax.jit
+def and_(a, b):
+    return a & b
+
+
+@jax.jit
+def or_(a, b):
+    return a | b
+
+
+@jax.jit
+def xor(a, b):
+    return a ^ b
+
+
+@jax.jit
+def andnot(a, b):
+    return a & ~b
+
+
+def _range_mask(n: int, start, end) -> jnp.ndarray:
+    """uint32[n] word masks selecting bit positions in [start, end).
+
+    Built word-by-word (not per-bit) so XLA fuses it into the consuming
+    bitwise op.  start/end fit comfortably in int32 (SLICE_WIDTH = 2^20).
+    """
+    lo = jnp.arange(n, dtype=jnp.int32) * WORD_BITS
+    s = jnp.clip(start - lo, 0, WORD_BITS).astype(jnp.uint32)
+    e = jnp.clip(end - lo, 0, WORD_BITS).astype(jnp.uint32)
+    width = jnp.maximum(e.astype(jnp.int32) - s.astype(jnp.int32), 0).astype(jnp.uint32)
+    full = jnp.uint32(0xFFFFFFFF)
+    base = jnp.where(width == 32, full, (jnp.uint32(1) << width) - jnp.uint32(1))
+    return (base << s).astype(jnp.uint32)
+
+
+@jax.jit
+def flip_range(words, start, end):
+    """Negate bits in [start, end) of a flat word array (reference:
+    roaring.Bitmap.Flip, roaring/roaring.go:708-734)."""
+    return words ^ _range_mask(words.shape[-1], start, end)
+
+
+@jax.jit
+def count_range(words, start, end):
+    """Count set bits with positions in [start, end) (reference:
+    roaring.Bitmap.CountRange, roaring/roaring.go:195-249)."""
+    return _popcount_sum(words & _range_mask(words.shape[-1], start, end))
+
+
+@jax.jit
+def row_counts(plane):
+    """Per-row popcounts of a plane -> int32[rows] (rebuilds the ranked
+    cache after imports; reference: fragment.go:244-282 openCache recount)."""
+    return jnp.sum(jax.lax.population_count(plane).astype(jnp.int32), axis=-1)
+
+
+@jax.jit
+def top_counts(plane, src_row):
+    """Per-row |row AND src| -> int32[rows]: the batched TopN(Src=...) scorer.
+
+    The reference prunes candidates sequentially with cache-threshold early
+    termination (reference: fragment.go:601-627); on TPU we instead score
+    every row in one fused batched kernel and select on the host — same
+    results, hardware-shaped loop structure.
+    """
+    if _use_pallas():
+        from pilosa_tpu.ops import kernels
+
+        return kernels.top_counts(plane, src_row)
+    return jnp.sum(
+        jax.lax.population_count(plane & src_row[None, :]).astype(jnp.int32), axis=-1
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def top_k(counts, k: int):
+    """Top-k (count, rowID) by count descending — ties broken by smaller row
+    id first, matching the reference's Pair sort (reference: cache.go:316-330).
+    """
+    kk = min(k, counts.shape[0])
+    # lax.top_k breaks ties toward the lower index, which matches the
+    # reference's Pair ordering (count desc, then smaller row id).
+    topc, topidx = jax.lax.top_k(counts, kk)
+    return topc, topidx
+
+
+def batch_rows(rows: list[np.ndarray]) -> np.ndarray:
+    """Stack slice-rows for batched device transfer."""
+    return np.stack(rows) if rows else np.zeros((0, WORDS_PER_SLICE), np.uint32)

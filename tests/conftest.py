@@ -1,0 +1,38 @@
+"""Test fixtures: force JAX onto a virtual 8-device CPU mesh.
+
+The distributed logic must be testable without a TPU pod (SURVEY.md §4
+implication), so every test runs on the CPU backend with 8 virtual
+devices; the driver separately dry-run-compiles the multi-chip path and
+benches on real TPU hardware.
+"""
+
+import os
+
+# Force the CPU backend even when the container routes JAX at a TPU by
+# default (JAX_PLATFORMS=axon + a sitecustomize that registers the tunnel
+# plugin whenever PALLAS_AXON_POOL_IPS is set).  Tests must never touch
+# the real chip: clearing the pool IPs prevents plugin registration in
+# pytest worker processes, and JAX_PLATFORMS=cpu selects the host backend.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The container's sitecustomize imports jax at interpreter startup (to
+# register the TPU-tunnel PJRT plugin), which latches JAX_PLATFORMS=axon
+# before this file runs — so updating the env alone is not enough: update
+# the live config too, before any backend initializes.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

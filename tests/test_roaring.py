@@ -1,0 +1,138 @@
+"""Roaring codec: encode/decode round trips, op-log replay, corruption
+detection (parity tier for roaring/roaring_test.go serialization tests)."""
+
+import struct
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.ops import bitplane as bp
+from pilosa_tpu.ops import roaring
+
+
+def bits_to_containers(values):
+    out = {}
+    for v in values:
+        key, off = divmod(int(v), roaring.CONTAINER_BITS)
+        if key not in out:
+            out[key] = np.zeros(roaring.CONTAINER_WORDS64, dtype=np.uint64)
+        out[key][off // 64] |= np.uint64(1) << np.uint64(off % 64)
+    return out
+
+
+def containers_to_bits(containers):
+    vals = []
+    for key, words in containers.items():
+        bits = np.unpackbits(words.view(np.uint8), bitorder="little")
+        (pos,) = np.nonzero(bits)
+        vals.extend(int(key) * roaring.CONTAINER_BITS + int(p) for p in pos)
+    return sorted(vals)
+
+
+def test_roundtrip_array_container(rng):
+    values = sorted(rng.choice(100000, size=500, replace=False))
+    data = roaring.encode(bits_to_containers(values))
+    got = containers_to_bits(roaring.decode(data))
+    assert got == [int(v) for v in values]
+
+
+def test_roundtrip_bitmap_container(rng):
+    # >4096 bits in one container forces bitmap form
+    values = sorted(rng.choice(roaring.CONTAINER_BITS, size=10000, replace=False))
+    data = roaring.encode(bits_to_containers(values))
+    # container payload must be 8 KiB bitmap, not 40 KB array
+    info = roaring.info(data)
+    assert info.containers[0].type == "bitmap"
+    got = containers_to_bits(roaring.decode(data))
+    assert got == [int(v) for v in values]
+
+
+def test_array_bitmap_threshold():
+    vals = list(range(4096))
+    data = roaring.encode(bits_to_containers(vals))
+    assert roaring.info(data).containers[0].type == "array"
+    vals = list(range(4097))
+    data = roaring.encode(bits_to_containers(vals))
+    assert roaring.info(data).containers[0].type == "bitmap"
+
+
+def test_oplog_replay(rng):
+    values = [1, 2, 3, 100000, 2 ** 30]
+    data = roaring.encode(bits_to_containers(values))
+    data += roaring.encode_op(roaring.OP_ADD, 7)
+    data += roaring.encode_op(roaring.OP_REMOVE, 2)
+    data += roaring.encode_op(roaring.OP_ADD, 2 ** 40)
+    got = containers_to_bits(roaring.decode(data))
+    assert got == sorted([1, 3, 7, 100000, 2 ** 30, 2 ** 40])
+    assert roaring.info(data).ops == 3
+
+
+def test_bad_cookie():
+    with pytest.raises(roaring.CorruptError):
+        roaring.decode(struct.pack("<II", 9999, 0))
+
+
+def test_bad_op_checksum():
+    data = roaring.encode({})
+    op = bytearray(roaring.encode_op(roaring.OP_ADD, 5))
+    op[9] ^= 0xFF
+    with pytest.raises(roaring.CorruptError, match="checksum mismatch"):
+        roaring.decode(data + bytes(op))
+    assert roaring.check(data + bytes(op))  # non-empty problem list
+
+
+def test_check_healthy(rng):
+    data = roaring.encode(bits_to_containers([5, 10, 70000]))
+    assert roaring.check(data) == []
+
+
+def test_plane_bridge(rng):
+    plane = bp.empty_plane(3)
+    bits = [0, 63, 64, 2 ** 16, bp.SLICE_WIDTH - 1, bp.SLICE_WIDTH + 5,
+            2 * bp.SLICE_WIDTH + 12345]
+    for b in bits:
+        bp.np_set_bit(plane, b)
+    containers = roaring.plane_to_containers(plane, bp.SLICE_WIDTH)
+    assert containers_to_bits(containers) == sorted(bits)
+    plane2 = roaring.containers_to_plane(containers, bp.SLICE_WIDTH)
+    assert plane2.shape[0] == 3
+    assert np.array_equal(plane[:3], plane2)
+
+
+def test_plane_roundtrip_through_file(rng):
+    plane = bp.empty_plane(2)
+    offs = rng.choice(2 * bp.SLICE_WIDTH, size=30000, replace=False)
+    for o in offs:
+        bp.np_set_bit(plane, int(o))
+    data = roaring.encode(roaring.plane_to_containers(plane, bp.SLICE_WIDTH))
+    plane2 = roaring.containers_to_plane(roaring.decode(data), bp.SLICE_WIDTH)
+    assert np.array_equal(plane[:2], plane2[:2])
+
+
+def test_fnv1a():
+    # FNV-1a reference vectors
+    assert roaring.fnv1a32(b"") == 0x811C9DC5
+    assert roaring.fnv1a32(b"a") == 0xE40C292C
+    assert roaring.fnv1a32(b"foobar") == 0xBF9CF968
+
+
+def test_truncated_payload():
+    data = roaring.encode(bits_to_containers([1, 2, 3]))
+    with pytest.raises(roaring.CorruptError, match="out of bounds"):
+        roaring.decode(data[:-4])
+    assert roaring.check(data[:-4])  # reported, not crashed
+
+
+def test_malformed_header_and_values():
+    # header claims 5 containers but no key table
+    bad = struct.pack("<II", roaring.COOKIE, 5)
+    assert roaring.check(bad)  # reported, not crashed
+    with pytest.raises(roaring.CorruptError, match="claims 5 containers"):
+        roaring.decode(bad)
+    # array container payload with a low-bits value >= 2^16
+    good = roaring.encode(bits_to_containers([1]))
+    corrupt = bytearray(good)
+    corrupt[-4:] = struct.pack("<I", 70000)  # overwrite the one array value
+    with pytest.raises(roaring.CorruptError, match="out of range"):
+        roaring.decode(bytes(corrupt))
+    assert roaring.check(bytes(corrupt))
