@@ -130,10 +130,28 @@ def np_columns_to_row(offsets: np.ndarray) -> np.ndarray:
     return row
 
 
-def np_count(words: np.ndarray) -> int:
-    """Host popcount (the CPU reference path, equivalent of the reference's
-    pure-Go popcntSlice fallback, reference: roaring/assembly.go:21-28)."""
-    return int(np.unpackbits(words.view(np.uint8)).sum())
+if hasattr(np, "bitwise_count"):  # numpy >= 2.0
+
+    def np_count(words: np.ndarray) -> int:
+        """Host popcount (the CPU reference path, equivalent of the
+        reference's pure-Go popcntSlice fallback, reference:
+        roaring/assembly.go:21-28)."""
+        return int(np.bitwise_count(words).sum())
+
+    def np_row_counts(plane: np.ndarray) -> np.ndarray:
+        """Host per-row popcounts (cache maintenance without a device trip)."""
+        return np.bitwise_count(plane).sum(axis=-1, dtype=np.int64)
+
+else:  # pragma: no cover - numpy 1.x fallback
+
+    def np_count(words: np.ndarray) -> int:
+        return int(np.unpackbits(np.ascontiguousarray(words).view(np.uint8)).sum())
+
+    def np_row_counts(plane: np.ndarray) -> np.ndarray:
+        return (
+            np.unpackbits(np.ascontiguousarray(plane).view(np.uint8), axis=-1)
+            .sum(axis=-1, dtype=np.int64)
+        )
 
 
 # ---------------------------------------------------------------------------

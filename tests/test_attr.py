@@ -1,0 +1,66 @@
+"""AttrStore tests (parity tier for attr_test.go)."""
+
+import pytest
+
+from pilosa_tpu.core.attr import ATTR_BLOCK_SIZE, AttrStore, diff_blocks
+
+
+@pytest.fixture
+def store(tmp_path):
+    s = AttrStore(str(tmp_path / "data"))
+    s.open()
+    yield s
+    s.close()
+
+
+def test_set_get(store):
+    store.set_attrs(1, {"a": "x", "b": 2, "c": True, "d": 1.5})
+    assert store.attrs(1) == {"a": "x", "b": 2, "c": True, "d": 1.5}
+    assert store.attrs(2) == {}
+
+
+def test_merge_and_delete(store):
+    store.set_attrs(1, {"a": "x", "b": 2})
+    store.set_attrs(1, {"b": None, "c": 3})
+    assert store.attrs(1) == {"a": "x", "c": 3}
+
+
+def test_invalid_type(store):
+    with pytest.raises(TypeError):
+        store.set_attrs(1, {"a": [1, 2]})
+
+
+def test_persistence(tmp_path):
+    s = AttrStore(str(tmp_path / "data"))
+    s.open()
+    s.set_attrs(7, {"k": "v"})
+    s.close()
+    s2 = AttrStore(str(tmp_path / "data"))
+    s2.open()
+    assert s2.attrs(7) == {"k": "v"}
+    s2.close()
+
+
+def test_bulk(store):
+    store.set_bulk_attrs({1: {"a": 1}, 2: {"b": 2}, 300: {"c": 3}})
+    assert store.attrs(1) == {"a": 1}
+    assert store.attrs(300) == {"c": 3}
+
+
+def test_blocks_and_diff(tmp_path):
+    a = AttrStore(str(tmp_path / "a"))
+    b = AttrStore(str(tmp_path / "b"))
+    a.open()
+    b.open()
+    for s in (a, b):
+        s.set_attrs(1, {"x": 1})
+        s.set_attrs(ATTR_BLOCK_SIZE + 5, {"y": 2})
+    assert diff_blocks(a.blocks(), b.blocks()) == []
+    b.set_attrs(1, {"x": 99})  # diverge block 0
+    assert diff_blocks(a.blocks(), b.blocks()) == [0]
+    a.set_attrs(5 * ATTR_BLOCK_SIZE, {"z": 1})  # block only on a
+    assert diff_blocks(a.blocks(), b.blocks()) == [0, 5]
+    # block_data returns the block's attrs
+    assert a.block_data(5) == {5 * ATTR_BLOCK_SIZE: {"z": 1}}
+    a.close()
+    b.close()

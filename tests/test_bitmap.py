@@ -1,0 +1,64 @@
+"""RowBitmap (segmented row) tests — parity tier for bitmap.go tests."""
+
+import numpy as np
+
+from pilosa_tpu.core.bitmap import RowBitmap
+from pilosa_tpu.ops import bitplane as bp
+
+SW = bp.SLICE_WIDTH
+
+
+def test_from_bits_roundtrip():
+    bits = [0, 5, SW - 1, SW, SW + 7, 3 * SW + 100]
+    b = RowBitmap.from_bits(bits)
+    assert b.bits() == sorted(bits)
+    assert b.count() == len(bits)
+    assert sorted(b.segments) == [0, 1, 3]
+
+
+def test_intersect_union_difference_xor():
+    a = RowBitmap.from_bits([1, 2, 3, SW + 1])
+    b = RowBitmap.from_bits([2, 3, 4, 2 * SW + 9])
+    assert a.intersect(b).bits() == [2, 3]
+    assert a.union(b).bits() == [1, 2, 3, 4, SW + 1, 2 * SW + 9]
+    assert a.difference(b).bits() == [1, SW + 1]
+    assert a.xor(b).bits() == [1, 4, SW + 1, 2 * SW + 9]
+
+
+def test_intersection_count():
+    a = RowBitmap.from_bits([1, 2, 3, SW + 1, SW + 2])
+    b = RowBitmap.from_bits([2, 3, SW + 2, 5 * SW])
+    assert a.intersection_count(b) == 3
+
+
+def test_merge_in_place():
+    a = RowBitmap.from_bits([1, 2])
+    b = RowBitmap.from_bits([2, 3, SW + 5])
+    a.merge(b)
+    assert a.bits() == [1, 2, 3, SW + 5]
+
+
+def test_segment_count_memoized():
+    a = RowBitmap.from_bits([1, 2, 3])
+    assert a.segment_count(0) == 3
+    # mutate under the hood: memo should still return 3 until invalidated
+    seg = a.segments[0].copy()
+    seg[0] |= np.uint32(1 << 10)
+    a.segments[0] = seg
+    assert a.segment_count(0) == 3
+    a.invalidate_count()
+    assert a.segment_count(0) == 4
+
+
+def test_set_bit_and_json():
+    b = RowBitmap()
+    assert b.set_bit(42)
+    assert not b.set_bit(42)
+    assert b.to_json_dict() == {"attrs": {}, "bits": [42]}
+    b.attrs = {"x": 1}
+    assert b.to_json_dict() == {"attrs": {"x": 1}, "bits": [42]}
+
+
+def test_equality():
+    assert RowBitmap.from_bits([1, SW]) == RowBitmap.from_bits([SW, 1])
+    assert RowBitmap.from_bits([1]) != RowBitmap.from_bits([2])

@@ -1,0 +1,93 @@
+"""Cache tests (parity tier for cache.go behaviors)."""
+
+from pilosa_tpu.core import cache as cm
+
+
+def test_lru_eviction():
+    c = cm.LRUCache(max_entries=3)
+    for i in range(5):
+        c.add(i, i * 10)
+    assert c.len() == 3
+    assert c.get(0) == 0  # evicted
+    assert c.get(4) == 40
+
+
+def test_lru_top_sorted():
+    c = cm.LRUCache(10)
+    c.add(1, 5)
+    c.add(2, 50)
+    c.add(3, 5)
+    assert c.top() == [cm.Pair(2, 50), cm.Pair(1, 5), cm.Pair(3, 5)]
+
+
+def test_rank_cache_ordering_and_ids():
+    c = cm.RankCache(10)
+    c.add(1, 10)
+    c.add(2, 30)
+    c.add(3, 20)
+    assert [p.id for p in c.top()] == [2, 3, 1]
+    assert c.ids() == [1, 2, 3]
+    assert c.get(3) == 20
+    assert c.get(99) == 0
+
+
+def test_rank_cache_zero_removes():
+    c = cm.RankCache(10)
+    c.add(1, 10)
+    c.add(1, 0)
+    assert c.len() == 0
+
+
+def test_rank_cache_threshold_pruning():
+    c = cm.RankCache(max_entries=10)
+    for i in range(12):  # 12 > 10 * 1.1
+        c.add(i, i + 1)
+    # pruned down to max_entries with a threshold floor
+    assert c.len() == 10
+    assert c.threshold_value > 0
+    floor = c.threshold_value
+    # adds below the floor for unknown rows are rejected
+    c.add(100, floor - 1)
+    assert c.get(100) == 0
+    # adds above pass
+    c.add(101, floor + 100)
+    assert c.get(101) == floor + 100
+
+
+def test_rank_cache_update_existing_below_threshold():
+    c = cm.RankCache(max_entries=10)
+    for i in range(12):
+        c.add(i, 100 + i)
+    present = c.ids()[0]
+    c.add(present, 1)  # existing rows may always update
+    assert c.get(present) == 1
+
+
+def test_add_pairs_merge():
+    a = [cm.Pair(1, 10), cm.Pair(2, 20)]
+    b = [cm.Pair(2, 5), cm.Pair(3, 1)]
+    merged = {p.id: p.count for p in cm.add_pairs(a, b)}
+    assert merged == {1: 10, 2: 25, 3: 1}
+
+
+def test_sort_pairs_tiebreak():
+    got = cm.sort_pairs([cm.Pair(5, 7), cm.Pair(1, 7), cm.Pair(2, 9)])
+    assert [(p.id, p.count) for p in got] == [(2, 9), (1, 7), (5, 7)]
+
+
+def test_new_cache_dispatch():
+    assert isinstance(cm.new_cache("ranked", 10), cm.RankCache)
+    assert isinstance(cm.new_cache("lru", 10), cm.LRUCache)
+
+
+def test_rank_cache_invalidate_is_throttled():
+    c = cm.RankCache(10)
+    c.add(1, 10)
+    assert [p.id for p in c.top()] == [1]
+    c.add(2, 99)
+    c.invalidate()
+    # within the 10s window the stale rankings are served...
+    assert [p.id for p in c.top()] == [1]
+    # ...and an explicit recalculate forces the re-sort
+    c.recalculate()
+    assert [p.id for p in c.top()] == [2, 1]

@@ -1,0 +1,316 @@
+"""Fragment tests — the component tier, mirroring fragment_test.go's
+wrapper pattern: temp-dir fixture + reopen for persistence checks."""
+
+import io
+import os
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core import cache as cm
+from pilosa_tpu.core.bitmap import RowBitmap
+from pilosa_tpu.core.fragment import (
+    Fragment,
+    FragmentError,
+    PairSet,
+    TopOptions,
+)
+from pilosa_tpu.core.attr import AttrStore
+from pilosa_tpu.ops import bitplane as bp
+
+SW = bp.SLICE_WIDTH
+
+
+@pytest.fixture
+def frag(tmp_path):
+    f = Fragment(str(tmp_path / "0"), "i", "f", "standard", 0)
+    f.open()
+    yield f
+    f.close()
+
+
+def reopen(f: Fragment) -> Fragment:
+    f.close()
+    f2 = Fragment(
+        f.path, f.index, f.frame, f.view, f.slice,
+        cache_type=f.cache_type, cache_size=f.cache_size, max_op_n=f.max_op_n,
+    )
+    f2.open()
+    return f2
+
+
+def test_set_clear_contains(frag):
+    assert frag.set_bit(2, 100)
+    assert not frag.set_bit(2, 100)
+    assert frag.contains(2, 100)
+    assert frag.row(2).bits() == [100]
+    assert frag.clear_bit(2, 100)
+    assert not frag.contains(2, 100)
+
+
+def test_column_out_of_bounds(tmp_path):
+    f = Fragment(str(tmp_path / "3"), "i", "f", "standard", 3)
+    f.open()
+    with pytest.raises(FragmentError):
+        f.set_bit(0, 5)  # col 5 is in slice 0, not 3
+    f.set_bit(0, 3 * SW + 5)
+    assert f.row(0).bits() == [3 * SW + 5]
+    f.close()
+
+
+def test_persistence_via_oplog(tmp_path):
+    f = Fragment(str(tmp_path / "0"), "i", "f", "standard", 0)
+    f.open()
+    f.set_bit(1, 10)
+    f.set_bit(1, 20)
+    f.set_bit(130, 5)
+    f.clear_bit(1, 10)
+    f2 = reopen(f)
+    assert f2.row(1).bits() == [20]
+    assert f2.row(130).bits() == [5]
+    assert f2.max_row_id == 130
+    f2.close()
+
+
+def test_snapshot_on_max_opn(tmp_path):
+    f = Fragment(str(tmp_path / "0"), "i", "f", "standard", 0, max_op_n=5)
+    f.open()
+    for i in range(6):
+        f.set_bit(0, i)
+    assert f._op_n < 5  # snapshot reset the op counter
+    f2 = reopen(f)
+    assert f2.row(0).bits() == [0, 1, 2, 3, 4, 5]
+    f2.close()
+
+
+def test_import_bulk_and_row_counts(frag):
+    rows = [0, 0, 1, 2, 2, 2]
+    cols = [1, 2, 3, 4, 5, 6]
+    frag.import_bulk(rows, cols)
+    assert frag.row(0).bits() == [1, 2]
+    assert frag.row(2).bits() == [4, 5, 6]
+    assert frag.cache.get(2) == 3
+    f2 = reopen(frag)
+    assert f2.row(2).bits() == [4, 5, 6]
+    f2.close()
+
+
+def test_count(frag):
+    frag.import_bulk([0, 1, 5], [1, 2, 3])
+    assert frag.count() == 3
+
+
+def test_top_n_basic(frag):
+    frag.import_bulk(
+        [0, 0, 0, 1, 1, 2], [1, 2, 3, 4, 5, 6],
+    )
+    top = frag.top(TopOptions(n=2))
+    assert [(p.id, p.count) for p in top] == [(0, 3), (1, 2)]
+    top_all = frag.top(TopOptions())
+    assert [(p.id, p.count) for p in top_all] == [(0, 3), (1, 2), (2, 1)]
+
+
+def test_top_with_src_intersection(frag):
+    frag.import_bulk(
+        [0, 0, 0, 1, 1, 2], [10, 20, 30, 10, 40, 50],
+    )
+    src = RowBitmap.from_bits([10, 40])
+    top = frag.top(TopOptions(n=10, src=src))
+    assert [(p.id, p.count) for p in top] == [(1, 2), (0, 1)]
+
+
+def test_top_row_ids(frag):
+    frag.import_bulk([0, 1, 1, 2, 2, 2], [1, 2, 3, 4, 5, 6])
+    top = frag.top(TopOptions(row_ids=[0, 2]))
+    assert [(p.id, p.count) for p in top] == [(2, 3), (0, 1)]
+
+
+def test_top_min_threshold(frag):
+    frag.import_bulk([0, 1, 1, 2, 2, 2], [1, 2, 3, 4, 5, 6])
+    top = frag.top(TopOptions(min_threshold=2))
+    assert [(p.id, p.count) for p in top] == [(2, 3), (1, 2)]
+
+
+def test_top_filters_via_attr_store(tmp_path):
+    f = Fragment(str(tmp_path / "0"), "i", "f", "standard", 0)
+    f.open()
+    store = AttrStore(str(tmp_path / "attrs"))
+    store.open()
+    f.row_attr_store = store
+    f.import_bulk([0, 0, 1, 2], [1, 2, 3, 4])
+    store.set_attrs(0, {"category": "a"})
+    store.set_attrs(1, {"category": "b"})
+    top = f.top(TopOptions(filter_field="category", filter_values=["b"]))
+    assert [(p.id, p.count) for p in top] == [(1, 1)]
+    top = f.top(TopOptions(filter_field="category", filter_values=["a", "b"]))
+    assert [(p.id, p.count) for p in top] == [(0, 2), (1, 1)]
+    store.close()
+    f.close()
+
+
+def test_top_tanimoto(frag):
+    # reference semantics: score = ceil(100*|A&B| / (|A|+|B|-|A&B|)) > thr
+    frag.import_bulk(
+        [0, 0, 0, 1, 1, 2, 2, 2, 2], [1, 2, 3, 1, 2, 1, 2, 3, 4],
+    )
+    src = RowBitmap.from_bits([1, 2, 3])
+    top = frag.top(TopOptions(src=src, tanimoto_threshold=70))
+    got = {p.id: p.count for p in top}
+    # row0: |A&B|=3, |A|=3 -> 100 > 70 yes; row1: 2/(2+3-2)=67 no;
+    # row2: 3/(4+3-3)=75 > 70 yes
+    assert got == {0: 3, 2: 3}
+
+
+def test_blocks_checksums_change(frag):
+    assert frag.blocks() == []
+    frag.set_bit(0, 1)
+    b1 = frag.blocks()
+    assert [b[0] for b in b1] == [0]
+    frag.set_bit(150, 1)  # second block
+    b2 = frag.blocks()
+    assert [b[0] for b in b2] == [0, 1]
+    frag.set_bit(0, 2)
+    b3 = frag.blocks()
+    assert b3[0][1] != b2[0][1]  # block 0 checksum changed
+    assert b3[1][1] == b2[1][1]  # block 1 untouched
+    assert frag.checksum() != b""
+
+
+def test_block_data(frag):
+    frag.set_bit(0, 5)
+    frag.set_bit(102, 9)
+    ps = frag.block_data(1)
+    assert ps.row_ids == [102]
+    assert ps.column_ids == [9]
+
+
+def test_merge_block_consensus(frag):
+    # local has {r0c1}; two remotes have {r0c1, r0c2}; majority = 2 of 3
+    frag.set_bit(0, 1)
+    remote = PairSet(row_ids=[0, 0], column_ids=[1, 2])
+    sets, clears = frag.merge_block(0, [remote, remote])
+    # consensus: c1 (3 votes), c2 (2 votes >= 2) -> local gains c2
+    assert frag.row(0).bits() == [1, 2]
+    # remotes already have both; no diffs for them
+    assert all(not s.row_ids for s in sets)
+    assert all(not c.row_ids for c in clears)
+
+
+def test_merge_block_clears_minority_bit(frag):
+    # local has a bit nobody else has; 1 of 3 votes < 2 -> cleared
+    frag.set_bit(0, 7)
+    empty = PairSet()
+    sets, clears = frag.merge_block(0, [empty, empty])
+    assert frag.row(0).bits() == []
+    assert all(not s.row_ids for s in sets)
+
+
+def test_merge_block_tie_sets(frag):
+    # local empty, one remote has the bit: 1 of 2 votes, majority=(2+1)//2=1
+    # -> tie resolves to set (reference: "even split then a set is used")
+    remote = PairSet(row_ids=[0], column_ids=[3])
+    sets, clears = frag.merge_block(0, [remote])
+    assert frag.row(0).bits() == [3]
+    assert not sets[0].row_ids and not clears[0].row_ids
+
+
+def test_merge_block_remote_diffs(frag):
+    # local + remote1 have c1 (2/3 majority); remote2 lacks it -> remote2
+    # gets a set-diff
+    frag.set_bit(0, 1)
+    r1 = PairSet(row_ids=[0], column_ids=[1])
+    r2 = PairSet()
+    sets, clears = frag.merge_block(0, [r1, r2])
+    assert not sets[0].row_ids
+    assert sets[1].row_ids == [0] and sets[1].column_ids == [1]
+
+
+def test_tar_roundtrip(tmp_path, frag):
+    frag.import_bulk([0, 1, 250], [1, 2, 3])
+    buf = io.BytesIO()
+    frag.write_to(buf)
+    buf.seek(0)
+    f2 = Fragment(str(tmp_path / "copy"), "i", "f", "standard", 0)
+    f2.open()
+    f2.read_from(buf)
+    assert f2.row(0).bits() == [1]
+    assert f2.row(250).bits() == [3]
+    assert f2.max_row_id == 250
+    # restored fragment persisted to its own file
+    f3 = reopen(f2)
+    assert f3.row(250).bits() == [3]
+    f3.close()
+
+
+def test_cache_persistence(tmp_path):
+    f = Fragment(str(tmp_path / "0"), "i", "f", "standard", 0)
+    f.open()
+    f.import_bulk([3, 3, 4], [1, 2, 3])
+    f.flush_cache()
+    f2 = reopen(f)
+    assert f2.cache.get(3) == 2
+    assert f2.cache.get(4) == 1
+    f2.close()
+
+
+def test_lru_cache_type(tmp_path):
+    f = Fragment(str(tmp_path / "0"), "i", "f", "standard", 0, cache_type="lru")
+    f.open()
+    f.set_bit(1, 1)
+    assert isinstance(f.cache, cm.LRUCache)
+    assert f.top(TopOptions(n=1))[0].id == 1
+    f.close()
+
+
+def test_flock_excludes_second_opener(tmp_path, frag):
+    f2 = Fragment(frag.path, "i", "f", "standard", 0)
+    with pytest.raises(FragmentError, match="locked"):
+        f2.open()
+
+
+def test_for_each_bit(frag):
+    frag.set_bit(2, 5)
+    frag.set_bit(0, 1)
+    assert sorted(frag.for_each_bit()) == [(0, 1), (2, 5)]
+
+
+def test_blocks_checksum_canonical_across_padding(tmp_path):
+    # Same logical bits, different plane-growth history -> same checksums
+    a = Fragment(str(tmp_path / "a"), "i", "f", "standard", 0)
+    a.open()
+    a.set_bit(0, 1)
+    a.set_bit(103, 5)   # grows plane to 104+ rows
+    a.clear_bit(103, 5)  # logical content back to just row 0
+    b = Fragment(str(tmp_path / "b"), "i", "f", "standard", 0)
+    b.open()
+    b.set_bit(0, 1)
+    assert a.blocks() == b.blocks()
+    assert a.checksum() == b.checksum()
+    a.close()
+    b.close()
+
+
+def test_read_from_rejects_negative_cache_id(tmp_path, frag):
+    frag.set_bit(0, 1)
+    import io as _io, json as _json, tarfile as _tar, time as _time
+    buf = _io.BytesIO()
+    frag.write_to(buf)
+    # rebuild the tar with a poisoned cache member
+    buf.seek(0)
+    tr = _tar.open(fileobj=buf, mode="r|")
+    members = {m.name: tr.extractfile(m).read() for m in tr}
+    tr.close()
+    members["cache"] = _json.dumps([-1, 0]).encode()
+    out = _io.BytesIO()
+    tw = _tar.open(fileobj=out, mode="w|")
+    for name, payload in members.items():
+        info = _tar.TarInfo(name)
+        info.size = len(payload)
+        tw.addfile(info, _io.BytesIO(payload))
+    tw.close()
+    out.seek(0)
+    f2 = Fragment(str(tmp_path / "c"), "i", "f", "standard", 0)
+    f2.open()
+    f2.read_from(out)
+    assert all(p.id >= 0 for p in f2.top(TopOptions()))
+    f2.close()

@@ -1,0 +1,211 @@
+"""Holder/Index/Frame/View hierarchy tests (parity tier for
+holder_test.go / index_test.go / frame_test.go / view_test.go)."""
+
+from datetime import datetime
+
+import pytest
+
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.core.names import ValidationError
+from pilosa_tpu.core.view import VIEW_INVERSE, VIEW_STANDARD
+from pilosa_tpu.ops.bitplane import SLICE_WIDTH
+
+
+@pytest.fixture
+def holder(tmp_path):
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    yield h
+    h.close()
+
+
+def reopen(h: Holder) -> Holder:
+    h.close()
+    h2 = Holder(h.path)
+    h2.open()
+    return h2
+
+
+def test_create_index_and_frame(holder):
+    idx = holder.create_index("i")
+    f = idx.create_frame("f")
+    assert holder.index("i") is idx
+    assert holder.frame("i", "f") is f
+    assert holder.frame("i", "missing") is None
+    assert holder.frame("missing", "f") is None
+
+
+def test_name_validation(holder):
+    with pytest.raises(ValidationError):
+        holder.create_index("UPPER")
+    with pytest.raises(ValidationError):
+        holder.create_index("1leading-digit")
+    idx = holder.create_index("ok-name_2")
+    with pytest.raises(ValidationError):
+        idx.create_frame("Bad Frame")
+
+
+def test_row_column_label_collision(holder):
+    idx = holder.create_index("i", column_label="thing")
+    with pytest.raises(ValidationError):
+        idx.create_frame("f", row_label="thing")
+
+
+def test_set_bit_and_persistence(tmp_path):
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    idx = h.create_index("i")
+    f = idx.create_frame("f")
+    f.set_bit(VIEW_STANDARD, 10, 100)
+    f.set_bit(VIEW_STANDARD, 10, SLICE_WIDTH + 5)  # second slice
+    h2 = reopen(h)
+    f2 = h2.frame("i", "f")
+    assert f2 is not None
+    frag0 = h2.fragment("i", "f", VIEW_STANDARD, 0)
+    frag1 = h2.fragment("i", "f", VIEW_STANDARD, 1)
+    assert frag0.row(10).bits() == [100]
+    assert frag1.row(10).bits() == [SLICE_WIDTH + 5]
+    assert idx.name in [i for i in h2.indexes()]
+    h2.close()
+
+
+def test_max_slice(holder):
+    idx = holder.create_index("i")
+    f = idx.create_frame("f")
+    assert idx.max_slice() == 0
+    f.set_bit(VIEW_STANDARD, 0, 3 * SLICE_WIDTH + 1)
+    assert idx.max_slice() == 3
+    idx.set_remote_max_slice(7)
+    assert idx.max_slice() == 7
+    assert holder.max_slices() == {"i": 7}
+
+
+def test_time_quantum_views(holder):
+    idx = holder.create_index("i")
+    f = idx.create_frame("f", time_quantum="YMD")
+    f.set_bit(VIEW_STANDARD, 1, 2, t=datetime(2017, 3, 5))
+    views = set(f.views().keys())
+    assert views == {
+        VIEW_STANDARD, "standard_2017", "standard_201703", "standard_20170305",
+    }
+    for v in views:
+        assert f.view(v).fragment(0).row(1).bits() == [2]
+
+
+def test_index_default_time_quantum_inherited(holder):
+    idx = holder.create_index("i", time_quantum="Y")
+    f = idx.create_frame("f")
+    assert f.time_quantum == "Y"
+
+
+def test_inverse_import(holder):
+    idx = holder.create_index("i")
+    f = idx.create_frame("f", inverse_enabled=True)
+    f.import_bulk([1, 2], [10, 20])
+    std = f.view(VIEW_STANDARD)
+    inv = f.view(VIEW_INVERSE)
+    assert std.fragment(0).row(1).bits() == [10]
+    # inverse has row/col swapped
+    assert inv.fragment(0).row(10).bits() == [1]
+    assert inv.fragment(0).row(20).bits() == [2]
+    assert f.max_inverse_slice() == 0
+
+
+def test_import_without_inverse_skips_inverse_views(holder):
+    idx = holder.create_index("i")
+    f = idx.create_frame("f")
+    f.import_bulk([1], [10])
+    assert f.view(VIEW_INVERSE) is None
+
+
+def test_import_with_time(holder):
+    idx = holder.create_index("i")
+    f = idx.create_frame("f", time_quantum="D")
+    f.import_bulk([1], [10], [datetime(2017, 1, 2)])
+    assert set(f.views()) == {VIEW_STANDARD, "standard_20170102"}
+    assert f.view("standard_20170102").fragment(0).row(1).bits() == [10]
+    # bits with timestamps also write the standard view (reference:
+    # frame.go:546-549)
+    assert f.view(VIEW_STANDARD).fragment(0).row(1).bits() == [10]
+
+
+def test_import_time_without_quantum_errors(holder):
+    idx = holder.create_index("i")
+    f = idx.create_frame("f")
+    with pytest.raises(Exception, match="time quantum"):
+        f.import_bulk([1], [10], [datetime(2017, 1, 2)])
+
+
+def test_delete_frame_and_index(tmp_path):
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    idx = h.create_index("i")
+    idx.create_frame("f").set_bit(VIEW_STANDARD, 1, 1)
+    idx.delete_frame("f")
+    assert idx.frame("f") is None
+    h.delete_index("i")
+    assert h.index("i") is None
+    h2 = reopen(h)
+    assert h2.indexes() == {}
+    h2.close()
+
+
+def test_schema(holder):
+    idx = holder.create_index("i")
+    idx.create_frame("f", cache_type="lru", cache_size=100)
+    schema = holder.schema()
+    assert schema[0]["name"] == "i"
+    assert schema[0]["frames"][0]["name"] == "f"
+    assert schema[0]["frames"][0]["cacheType"] == "lru"
+    assert schema[0]["frames"][0]["cacheSize"] == 100
+
+
+def test_on_create_slice_callback(tmp_path):
+    events = []
+    h = Holder(str(tmp_path / "data"))
+    h.on_create_slice = lambda index, frame, s: events.append((index, frame, s))
+    h.open()
+    idx = h.create_index("i")
+    f = idx.create_frame("f")
+    f.set_bit(VIEW_STANDARD, 0, 2 * SLICE_WIDTH)  # creates slice 2
+    assert ("i", "f", 2) in events
+    h.close()
+
+
+def test_column_attrs(holder):
+    idx = holder.create_index("i")
+    idx.column_attr_store.set_attrs(5, {"name": "col5"})
+    assert idx.column_attr_store.attrs(5) == {"name": "col5"}
+
+
+def test_frame_meta_persistence(tmp_path):
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    idx = h.create_index("i")
+    idx.create_frame(
+        "f", row_label="rid", cache_type="lru", cache_size=9,
+        inverse_enabled=True, time_quantum="YM",
+    )
+    h2 = reopen(h)
+    f = h2.frame("i", "f")
+    assert f.row_label == "rid"
+    assert f.cache_type == "lru"
+    assert f.cache_size == 9
+    assert f.inverse_enabled is True
+    assert f.time_quantum == "YM"
+    h2.close()
+
+
+def test_open_skips_stray_dirs(tmp_path):
+    import os
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    h.create_index("good").create_frame("f")
+    h.close()
+    os.makedirs(str(tmp_path / "data" / "lost+found"))
+    os.makedirs(str(tmp_path / "data" / "good" / "Bad Frame Dir"))
+    h2 = Holder(str(tmp_path / "data"))
+    h2.open()  # must not raise
+    assert sorted(h2.indexes()) == ["good"]
+    assert sorted(h2.index("good").frames()) == ["f"]
+    h2.close()

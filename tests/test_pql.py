@@ -1,0 +1,134 @@
+"""PQL parser tests (parity tier for pql/*_test.go)."""
+
+import pytest
+
+from pilosa_tpu import pql
+
+
+def parse1(s):
+    q = pql.parse_string(s)
+    assert len(q.calls) == 1
+    return q.calls[0]
+
+
+def test_basic_call():
+    c = parse1('Bitmap(rowID=1, frame="f")')
+    assert c.name == "Bitmap"
+    assert c.args == {"rowID": 1, "frame": "f"}
+    assert c.children == []
+
+
+def test_nested_children_and_args():
+    c = parse1('TopN(Bitmap(rowID=1, frame="other"), frame="f", n=20)')
+    assert c.name == "TopN"
+    assert [ch.name for ch in c.children] == ["Bitmap"]
+    assert c.args == {"frame": "f", "n": 20}
+
+
+def test_multi_call_query():
+    q = pql.parse_string("SetBit(id=1, frame='f', col=2)\nCount(Bitmap(id=1))")
+    assert [c.name for c in q.calls] == ["SetBit", "Count"]
+    assert q.write_call_n() == 1
+
+
+def test_value_types():
+    c = parse1(
+        'F(a=true, b=false, c=null, d=ident, e="str", f=42, g=-1, h=1.5, '
+        "i=[1,2,3], j=['x', y, true])"
+    )
+    assert c.args["a"] is True
+    assert c.args["b"] is False
+    assert c.args["c"] is None
+    assert c.args["d"] == "ident"
+    assert c.args["e"] == "str"
+    assert c.args["f"] == 42
+    assert c.args["g"] == -1
+    assert c.args["h"] == 1.5
+    assert c.args["i"] == [1, 2, 3]
+    assert c.args["j"] == ["x", "y", True]
+
+
+def test_string_escapes():
+    c = parse1('F(a="x\\ny", b="q\\"w", c=\'it\\\'s\')')
+    assert c.args["a"] == "x\ny"
+    assert c.args["b"] == 'q"w'
+    assert c.args["c"] == "it's"
+
+
+def test_canonical_string_sorted_keys():
+    c = parse1('SetBit(id=1, frame="f", col=10)')
+    assert str(c) == 'SetBit(col=10, frame="f", id=1)'
+
+
+def test_canonical_string_children_first():
+    c = parse1('Count(Union(Bitmap(a=1), Bitmap(a=2)), x="y")')
+    assert str(c) == 'Count(Union(Bitmap(a=1), Bitmap(a=2)), x="y")'
+
+
+def test_canonical_string_values():
+    c = parse1("F(a=true, b=null, c=1.5, d=2.0, e=[1,2], f=[\"s\", t])")
+    assert str(c) == 'F(a=true, b=<nil>, c=1.5, d=2, e=[1,2], f=["s","t"])'
+
+
+def test_roundtrip_canonical():
+    src = 'TopN(Bitmap(frame="o", rowID=5), field="q", filters=["a",2], frame="f", n=10)'
+    assert str(parse1(src)) == src
+
+
+def test_uint_arg():
+    c = parse1("F(a=5, b=-1, s=\"x\")")
+    assert c.uint_arg("a") == 5
+    assert c.uint_arg("missing") is None
+    assert c.uint_arg("b") == 2 ** 64 - 1  # negative wraps like Go's cast
+    with pytest.raises(TypeError):
+        c.uint_arg("s")
+
+
+def test_uint_slice_arg():
+    c = parse1("F(ids=[1,2,3], bad=[1,\"x\"])")
+    assert c.uint_slice_arg("ids") == [1, 2, 3]
+    assert c.uint_slice_arg("missing") is None
+    with pytest.raises(TypeError):
+        c.uint_slice_arg("bad")
+
+
+def test_is_inverse():
+    assert parse1("Bitmap(columnID=1)").is_inverse("rowID", "columnID")
+    assert not parse1("Bitmap(rowID=1)").is_inverse("rowID", "columnID")
+    assert not parse1("Bitmap(rowID=1, columnID=2)").is_inverse("rowID", "columnID")
+    assert parse1("TopN(inverse=true)").is_inverse("rowID", "columnID")
+    assert not parse1("TopN(inverse=false)").is_inverse("rowID", "columnID")
+    assert not parse1("Union(columnID=1)").is_inverse("rowID", "columnID")
+
+
+def test_clone_independent():
+    c = parse1('Count(Bitmap(rowID=1), x="y")')
+    c2 = c.clone()
+    c2.args["x"] = "z"
+    c2.children[0].args["rowID"] = 9
+    assert c.args["x"] == "y"
+    assert c.children[0].args["rowID"] == 1
+
+
+@pytest.mark.parametrize("bad", [
+    "",
+    "Bitmap(",
+    "Bitmap)",
+    "Bitmap(rowID=)",
+    "Bitmap(rowID=1",
+    "Bitmap(rowID=1 frame=2)",
+    "Bitmap(rowID=1, rowID=2)",
+    "5(x=1)",
+    'F(a="unterminated)',
+    'F(a="bad\\escape")',
+    "F(a=[1,)",
+    "F(a=1,,b=2)",
+])
+def test_parse_errors(bad):
+    with pytest.raises(pql.ParseError):
+        pql.parse_string(bad)
+
+
+def test_ident_chars():
+    c = parse1("Range(frame=my-frame.v2_x, start=1)")
+    assert c.args["frame"] == "my-frame.v2_x"

@@ -1,0 +1,72 @@
+"""Time-quantum view tests (parity tier for time_test.go)."""
+
+from datetime import datetime
+
+import pytest
+
+from pilosa_tpu.core import timequantum as tq
+
+
+def test_parse():
+    assert tq.parse_time_quantum("ymdh") == "YMDH"
+    assert tq.parse_time_quantum("") == ""
+    with pytest.raises(tq.InvalidTimeQuantumError):
+        tq.parse_time_quantum("YMH")
+    with pytest.raises(tq.InvalidTimeQuantumError):
+        tq.parse_time_quantum("X")
+
+
+def test_view_by_time_unit():
+    t = datetime(2017, 3, 5, 14)
+    assert tq.view_by_time_unit("std", t, "Y") == "std_2017"
+    assert tq.view_by_time_unit("std", t, "M") == "std_201703"
+    assert tq.view_by_time_unit("std", t, "D") == "std_20170305"
+    assert tq.view_by_time_unit("std", t, "H") == "std_2017030514"
+    assert tq.view_by_time_unit("std", t, "X") == ""
+
+
+def test_views_by_time():
+    t = datetime(2017, 3, 5, 14)
+    assert tq.views_by_time("v", t, "YMDH") == [
+        "v_2017", "v_201703", "v_20170305", "v_2017030514",
+    ]
+    assert tq.views_by_time("v", t, "D") == ["v_20170305"]
+
+
+def test_views_by_time_range_hour_span():
+    # 2017-03-05 22:00 .. 2017-03-06 02:00 with DH: hours up to midnight,
+    # then... next day not complete, so hours again
+    got = tq.views_by_time_range(
+        "v", datetime(2017, 3, 5, 22), datetime(2017, 3, 6, 2), "DH"
+    )
+    assert got == ["v_2017030522", "v_2017030523", "v_2017030600", "v_2017030601"]
+
+
+def test_views_by_time_range_full_day():
+    got = tq.views_by_time_range(
+        "v", datetime(2017, 3, 5, 22), datetime(2017, 3, 7, 0), "DH"
+    )
+    assert got == ["v_2017030522", "v_2017030523", "v_20170306"]
+
+
+def test_views_by_time_range_month_cover():
+    got = tq.views_by_time_range(
+        "v", datetime(2017, 1, 30), datetime(2017, 3, 2), "MD"
+    )
+    assert got == ["v_20170130", "v_20170131", "v_201702", "v_20170301"]
+
+
+def test_views_by_time_range_year():
+    got = tq.views_by_time_range(
+        "v", datetime(2016, 1, 1), datetime(2018, 1, 1), "YMDH"
+    )
+    assert got == ["v_2016", "v_2017"]
+
+
+def test_views_by_time_range_quantum_y_only_misaligned():
+    # Y-only quantum with a mid-year start behaves like the reference:
+    # year views stamped at the (unaligned) cursor.
+    got = tq.views_by_time_range(
+        "v", datetime(2016, 6, 15), datetime(2018, 7, 1), "Y"
+    )
+    assert got == ["v_2016", "v_2017"]
