@@ -24,6 +24,18 @@ from typing import Any
 ATTR_BLOCK_SIZE = 100
 
 
+def _to_db_id(id_: int) -> int:
+    """Map a uint64 id into SQLite's signed 64-bit INTEGER (two's
+    complement); the reference's boltdb keys are raw big-endian u64 so
+    ids up to 2^64-1 are legal at the API."""
+    id_ &= (1 << 64) - 1
+    return id_ - (1 << 64) if id_ >= (1 << 63) else id_
+
+
+def _from_db_id(id_: int) -> int:
+    return id_ + (1 << 64) if id_ < 0 else id_
+
+
 def validate_attrs(attrs: dict[str, Any]) -> None:
     for k, v in attrs.items():
         if v is None:
@@ -69,7 +81,7 @@ class AttrStore:
             if id_ in self._cache:
                 return dict(self._cache[id_])
             row = self._conn().execute(
-                "SELECT data FROM attrs WHERE id = ?", (id_,)
+                "SELECT data FROM attrs WHERE id = ?", (_to_db_id(id_),)
             ).fetchone()
             m = json.loads(row[0]) if row else {}
             self._cache[id_] = m
@@ -90,7 +102,7 @@ class AttrStore:
                     cur[k] = v
             self._conn().execute(
                 "INSERT OR REPLACE INTO attrs (id, data) VALUES (?, ?)",
-                (id_, json.dumps(cur, sort_keys=True)),
+                (_to_db_id(id_), json.dumps(cur, sort_keys=True)),
             )
             self._conn().commit()
             self._cache[id_] = cur
@@ -109,7 +121,7 @@ class AttrStore:
                         cur[k] = v
                 self._conn().execute(
                     "INSERT OR REPLACE INTO attrs (id, data) VALUES (?, ?)",
-                    (id_, json.dumps(cur, sort_keys=True)),
+                    (_to_db_id(id_), json.dumps(cur, sort_keys=True)),
                 )
                 self._cache[id_] = cur
             self._conn().commit()
@@ -120,8 +132,11 @@ class AttrStore:
         """[(block_id, sha1)] over all ids, blocked per 100 ids."""
         with self._lock:
             rows = self._conn().execute(
-                "SELECT id, data FROM attrs ORDER BY id"
+                "SELECT id, data FROM attrs"
             ).fetchall()
+        # Sort by the *unsigned* id so block order matches the
+        # reference's big-endian key order.
+        rows = sorted((_from_db_id(i), d) for i, d in rows)
         out: list[tuple[int, bytes]] = []
         h = None
         cur_block = None
@@ -143,12 +158,23 @@ class AttrStore:
         """All attrs in one block (reference: BlockData, attr.go:226-254)."""
         lo = block_id * ATTR_BLOCK_SIZE
         hi = lo + ATTR_BLOCK_SIZE
+        dlo, dhi = _to_db_id(lo), _to_db_id(hi - 1)
         with self._lock:
-            rows = self._conn().execute(
-                "SELECT id, data FROM attrs WHERE id >= ? AND id < ? ORDER BY id",
-                (lo, hi),
-            ).fetchall()
-        return {id_: json.loads(data) for id_, data in rows if json.loads(data)}
+            if dlo <= dhi:
+                rows = self._conn().execute(
+                    "SELECT id, data FROM attrs WHERE id >= ? AND id <= ?",
+                    (dlo, dhi),
+                ).fetchall()
+            else:  # block straddles the uint63 sign boundary
+                rows = self._conn().execute(
+                    "SELECT id, data FROM attrs WHERE id >= ? OR id <= ?",
+                    (dlo, dhi),
+                ).fetchall()
+        return {
+            _from_db_id(id_): json.loads(data)
+            for id_, data in sorted(rows)
+            if json.loads(data)
+        }
 
 
 def diff_blocks(

@@ -52,6 +52,8 @@ SLICE_WIDTH = bp.SLICE_WIDTH
 # reference: fragment.go:58-65
 HASH_BLOCK_SIZE = 100
 DEFAULT_FRAGMENT_MAX_OP_N = 2000
+# Dense-plane row capacity: 2^16 rows x 128 KiB = 8 GiB worst case.
+MAX_PLANE_ROWS = 1 << 16
 
 
 class FragmentError(RuntimeError):
@@ -220,6 +222,14 @@ class Fragment:
         return self._max_row_id
 
     def _ensure_rows(self, row_id: int) -> None:
+        if row_id >= MAX_PLANE_ROWS:
+            # The dense plane caps row capacity (rows x 128 KiB) where the
+            # reference's roaring storage is sparse-tall for free; writes
+            # beyond the cap error instead of exhausting memory.  Raise
+            # MAX_PLANE_ROWS / add row-block paging for taller frames.
+            raise FragmentError(
+                f"row {row_id} exceeds fragment plane capacity ({MAX_PLANE_ROWS})"
+            )
         needed = bp.pad_rows(row_id + 1)
         if needed > self._plane.shape[0]:
             extra = np.zeros((needed - self._plane.shape[0], bp.WORDS_PER_SLICE), np.uint32)
@@ -266,6 +276,14 @@ class Fragment:
                 self._device = jax.device_put(self._plane)
                 self._device_version = self._version
             return self._device
+
+    def device_row(self, row_id: int):
+        """One row of the HBM mirror — a device gather, no host copy.
+        Query plans stack these as fused-program leaves (exec/plan.py)."""
+        with self._mu:
+            if row_id >= self._plane.shape[0]:
+                return None
+            return self.device_plane()[row_id]
 
     # ------------------------------------------------------------------
     # writes (reference: fragment.go:379-473)

@@ -150,7 +150,9 @@ def _go_value(v: Any) -> str:
     if isinstance(v, bool):
         return "true" if v else "false"
     if v is None:
-        return "<nil>"
+        # "null" (not Go's "%v" rendering "<nil>") so the canonical string
+        # re-parses: remote forwarding ships str(query) as the wire format.
+        return "null"
     if isinstance(v, str):
         return _go_quote(v)
     if isinstance(v, float):

@@ -1,0 +1,84 @@
+"""Cluster topology tests (reference: cluster_test.go)."""
+
+import numpy as np
+
+from pilosa_tpu.cluster import Cluster, Node, fnv64a, jump_hash
+from pilosa_tpu.cluster.topology import new_cluster
+
+
+def test_jump_hash_vectors():
+    """Vectors generated from the jump-hash reference C++ code
+    (reference: cluster_test.go:77-95)."""
+    cases = {
+        0: [0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0],
+        1: [0, 0, 0, 0, 0, 0, 6, 6, 6, 6, 6, 6, 6, 6, 6, 6, 6, 17, 17],
+        0xDEADBEEF: [0, 1, 2, 3, 3, 5, 5, 5, 5, 5, 5, 5, 5, 5, 5, 5, 16, 16, 16],
+        0x0DDC0FFEEBADF00D: [0, 1, 2, 2, 2, 2, 2, 2, 2, 2, 2, 2, 2, 2, 2, 15, 15, 15, 15],
+    }
+    for key, buckets in cases.items():
+        for i, want in enumerate(buckets):
+            assert jump_hash(key, i + 1) == want, (key, i + 1)
+
+
+def test_fnv64a():
+    # Standard FNV-1a test vectors.
+    assert fnv64a(b"") == 0xCBF29CE484222325
+    assert fnv64a(b"a") == 0xAF63DC4C8601EC8C
+    assert fnv64a(b"foobar") == 0x85944171F73967E8
+
+
+def test_partition_range():
+    c = new_cluster(3)
+    rng = np.random.default_rng(7)
+    for _ in range(200):
+        index = "idx" + str(rng.integers(0, 100))
+        s = int(rng.integers(0, 1 << 32))
+        p = c.partition(index, s)
+        assert 0 <= p < c.partition_n
+
+
+def test_partition_nodes_ring():
+    """Replicas go around the ring (reference: cluster_test.go:30-50)."""
+    c = Cluster(
+        nodes=[Node("serverA:1000"), Node("serverB:1000"), Node("serverC:1000")],
+        replica_n=2,
+    )
+    # With jump hash, partition 0 maps deterministically; replica is next.
+    owners = c.partition_nodes(0)
+    assert len(owners) == 2
+    i = c.nodes.index(owners[0])
+    assert owners[1] is c.nodes[(i + 1) % 3]
+
+
+def test_replica_n_clamped():
+    c = new_cluster(2)
+    c.replica_n = 5
+    assert len(c.partition_nodes(0)) == 2
+    c.replica_n = 0
+    assert len(c.partition_nodes(0)) == 1
+
+
+def test_owns_slices_partitions_all():
+    """Every slice has exactly one primary owner; owns_slices over all
+    hosts covers [0, max] exactly once."""
+    c = new_cluster(4)
+    max_slice = 63
+    seen = []
+    for h in c.hosts():
+        seen.extend(c.owns_slices("i", max_slice, h))
+    assert sorted(seen) == list(range(max_slice + 1))
+
+
+def test_fragment_nodes_stable():
+    c = new_cluster(3)
+    a = [n.host for n in c.fragment_nodes("i", 0)]
+    b = [n.host for n in c.fragment_nodes("i", 0)]
+    assert a == b
+
+
+def test_add_node_sorted_idempotent():
+    c = Cluster()
+    c.add_node("b:1")
+    c.add_node("a:1")
+    c.add_node("b:1")
+    assert c.hosts() == ["a:1", "b:1"]

@@ -1,0 +1,316 @@
+"""Executor tests — single-node and mocked-remote map/reduce
+(parity tier for executor_test.go)."""
+
+from datetime import datetime
+
+import pytest
+
+from pilosa_tpu.cluster.topology import Cluster, Node, new_cluster
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.core.view import VIEW_INVERSE, VIEW_STANDARD
+from pilosa_tpu.exec import ExecOptions, Executor, ExecutorError, TooManyWritesError
+from pilosa_tpu.ops.bitplane import SLICE_WIDTH
+from pilosa_tpu.pql.parser import parse_string
+
+
+@pytest.fixture
+def holder(tmp_path):
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    yield h
+    h.close()
+
+
+@pytest.fixture
+def ex(holder):
+    """Single-node executor pinned to node 0 (reference:
+    executor_test.go:758-770)."""
+    c = new_cluster(1)
+    return Executor(holder, host=c.nodes[0].host, cluster=c)
+
+
+def must_set_bits(holder, index, frame, bits, view=VIEW_STANDARD):
+    idx = holder.create_index_if_not_exists(index)
+    f = idx.create_frame_if_not_exists(frame)
+    for row, col in bits:
+        f.set_bit(view, row, col)
+    return f
+
+
+def q(ex, index, pql, slices=None, opt=None):
+    return ex.execute(index, parse_string(pql), slices, opt)
+
+
+# --- bitmap reads (reference: executor_test.go:31-205) ---------------------
+
+
+def test_execute_bitmap(ex, holder):
+    f = must_set_bits(
+        holder, "i", "f", [(10, 3), (10, SLICE_WIDTH + 1)]
+    )
+    f.row_attr_store.set_attrs(10, {"foo": "bar", "baz": 123})
+    (bm,) = q(ex, "i", "Bitmap(rowID=10, frame=f)")
+    assert bm.bits() == [3, SLICE_WIDTH + 1]
+    assert bm.attrs == {"foo": "bar", "baz": 123}
+
+
+def test_execute_bitmap_default_frame(ex, holder):
+    must_set_bits(holder, "i", "general", [(10, 3)])
+    (bm,) = q(ex, "i", "Bitmap(rowID=10)")
+    assert bm.bits() == [3]
+
+
+def test_execute_intersect_difference_union_count(ex, holder):
+    must_set_bits(
+        holder,
+        "i",
+        "f",
+        [(10, 0), (10, 1), (10, SLICE_WIDTH + 2), (11, 1), (11, SLICE_WIDTH + 2)],
+    )
+    (bm,) = q(ex, "i", "Intersect(Bitmap(rowID=10, frame=f), Bitmap(rowID=11, frame=f))")
+    assert bm.bits() == [1, SLICE_WIDTH + 2]
+    (bm,) = q(ex, "i", "Union(Bitmap(rowID=10, frame=f), Bitmap(rowID=11, frame=f))")
+    assert bm.bits() == [0, 1, SLICE_WIDTH + 2]
+    (bm,) = q(ex, "i", "Difference(Bitmap(rowID=10, frame=f), Bitmap(rowID=11, frame=f))")
+    assert bm.bits() == [0]
+    (n,) = q(ex, "i", "Count(Union(Bitmap(rowID=10, frame=f), Bitmap(rowID=11, frame=f)))")
+    assert n == 3
+
+
+def test_execute_nested_tree(ex, holder):
+    must_set_bits(holder, "i", "f", [(1, 1), (1, 2), (2, 2), (2, 3), (3, 3)])
+    (n,) = q(
+        ex,
+        "i",
+        "Count(Union(Intersect(Bitmap(rowID=1, frame=f), Bitmap(rowID=2, frame=f)),"
+        " Bitmap(rowID=3, frame=f)))",
+    )
+    assert n == 2  # {2} | {3}
+
+
+def test_execute_empty_intersect_errors(ex, holder):
+    must_set_bits(holder, "i", "f", [(1, 1)])
+    with pytest.raises(Exception, match="empty Intersect"):
+        q(ex, "i", "Count(Intersect())")
+
+
+def test_execute_count_requires_child(ex, holder):
+    must_set_bits(holder, "i", "f", [(1, 1)])
+    with pytest.raises(ExecutorError, match="requires an input"):
+        q(ex, "i", "Count()")
+
+
+def test_bitmap_missing_row_and_col(ex, holder):
+    must_set_bits(holder, "i", "f", [(1, 1)])
+    with pytest.raises(ExecutorError, match="must specify"):
+        q(ex, "i", "Bitmap(frame=f)")
+
+
+def test_inverse_bitmap(ex, holder):
+    idx = holder.create_index("i")
+    f = idx.create_frame("f", inverse_enabled=True)
+    # Writing through the executor populates both orientations.
+    q(ex, "i", "SetBit(frame=f, rowID=10, columnID=3)")
+    q(ex, "i", "SetBit(frame=f, rowID=11, columnID=3)")
+    (bm,) = q(ex, "i", "Bitmap(columnID=3, frame=f)")
+    assert bm.bits() == [10, 11]
+
+
+def test_inverse_requires_enabled(ex, holder):
+    must_set_bits(holder, "i", "f", [(1, 1)])
+    with pytest.raises(ExecutorError, match="inverse storage enabled"):
+        q(ex, "i", "Bitmap(columnID=1, frame=f)")
+
+
+# --- writes ----------------------------------------------------------------
+
+
+def test_set_and_clear_bit(ex, holder):
+    holder.create_index("i").create_frame("f")
+    (changed,) = q(ex, "i", "SetBit(frame=f, rowID=1, columnID=9)")
+    assert changed is True
+    (changed,) = q(ex, "i", "SetBit(frame=f, rowID=1, columnID=9)")
+    assert changed is False
+    (n,) = q(ex, "i", "Count(Bitmap(rowID=1, frame=f))")
+    assert n == 1
+    (changed,) = q(ex, "i", "ClearBit(frame=f, rowID=1, columnID=9)")
+    assert changed is True
+    (n,) = q(ex, "i", "Count(Bitmap(rowID=1, frame=f))")
+    assert n == 0
+
+
+def test_setbit_with_timestamp_and_range(ex, holder):
+    idx = holder.create_index("i")
+    idx.create_frame("f", time_quantum="YMDH")
+    q(ex, "i", 'SetBit(frame=f, rowID=1, columnID=2, timestamp="2010-01-01T00:00")')
+    q(ex, "i", 'SetBit(frame=f, rowID=1, columnID=3, timestamp="2010-03-01T00:00")')
+    q(ex, "i", 'SetBit(frame=f, rowID=1, columnID=4, timestamp="2011-01-01T00:00")')
+    (bm,) = q(
+        ex, "i",
+        'Range(rowID=1, frame=f, start="2010-01-01T00:00", end="2010-12-31T23:59")',
+    )
+    assert bm.bits() == [2, 3]
+
+
+def test_set_row_attrs(ex, holder):
+    holder.create_index("i").create_frame("f")
+    q(ex, "i", 'SetRowAttrs(frame=f, rowID=7, alpha="beta", n=123)')
+    assert holder.frame("i", "f").row_attr_store.attrs(7) == {"alpha": "beta", "n": 123}
+
+
+def test_bulk_set_row_attrs(ex, holder):
+    holder.create_index("i").create_frame("f")
+    res = q(ex, "i", 'SetRowAttrs(frame=f, rowID=1, a=1) SetRowAttrs(frame=f, rowID=2, b=2)')
+    assert res == [None, None]
+    store = holder.frame("i", "f").row_attr_store
+    assert store.attrs(1) == {"a": 1}
+    assert store.attrs(2) == {"b": 2}
+
+
+def test_set_column_attrs(ex, holder):
+    holder.create_index("i")
+    q(ex, "i", 'SetColumnAttrs(id=99, x="y")')
+    assert holder.index("i").column_attr_store.attrs(99) == {"x": "y"}
+
+
+def test_max_writes_guard(holder):
+    c = new_cluster(1)
+    e = Executor(holder, host=c.nodes[0].host, cluster=c, max_writes_per_request=2)
+    holder.create_index("i").create_frame("f")
+    pql = " ".join(f"SetBit(frame=f, rowID=1, columnID={i})" for i in range(3))
+    with pytest.raises(TooManyWritesError):
+        q(e, "i", pql)
+
+
+# --- TopN (reference: executor_test.go:207-376) ----------------------------
+
+
+def test_topn(ex, holder):
+    bits = [(0, i) for i in range(5)] + [(10, i) for i in range(3)] + [(12, 5)]
+    bits += [(0, SLICE_WIDTH + i) for i in range(2)]
+    must_set_bits(holder, "i", "f", bits)
+    (pairs,) = q(ex, "i", "TopN(frame=f, n=2)")
+    assert [(p.id, p.count) for p in pairs] == [(0, 7), (10, 3)]
+
+
+def test_topn_with_src(ex, holder):
+    must_set_bits(
+        holder, "i", "f",
+        [(0, 0), (0, 1), (0, 2), (10, 1), (10, 2), (12, 2)],
+    )
+    (pairs,) = q(ex, "i", "TopN(Bitmap(rowID=0, frame=f), frame=f, n=2)")
+    assert [(p.id, p.count) for p in pairs] == [(0, 3), (10, 2)]
+
+
+def test_topn_ids(ex, holder):
+    must_set_bits(holder, "i", "f", [(0, 0), (0, 1), (10, 1), (12, 2)])
+    (pairs,) = q(ex, "i", "TopN(frame=f, ids=[0, 12])")
+    assert [(p.id, p.count) for p in pairs] == [(0, 2), (12, 1)]
+
+
+def test_topn_tanimoto_bounds(ex, holder):
+    must_set_bits(holder, "i", "f", [(0, 0)])
+    with pytest.raises(ExecutorError, match="Tanimoto"):
+        q(ex, "i", "TopN(Bitmap(rowID=0, frame=f), frame=f, n=2, tanimotoThreshold=150)")
+
+
+# --- remote fan-out with a mock client (reference:
+# executor_test.go:520-745 TestExecutor_Execute_Remote_*) -------------------
+
+
+class MockClient:
+    """Function-mock internal client (reference: handler_test.go:964-974
+    HandlerExecutor.ExecuteFn pattern)."""
+
+    def __init__(self, fn):
+        self.fn = fn
+        self.calls = []
+
+    def execute_query(self, index, query, slices, remote):
+        self.calls.append((index, query, list(slices or []), remote))
+        return self.fn(index, query, slices, remote)
+
+
+def test_remote_count_merges(holder):
+    """Coordinator sends the sub-query with the peer's slice list and sums
+    remote + local counts."""
+    c = new_cluster(2)
+    holder.create_index("i").create_frame("f")
+    # Make local data on the slices owned by node 0.
+    local_slices = c.owns_slices("i", 2, c.nodes[0].host)
+    remote_slices = [s for s in range(3) if s not in local_slices]
+    f = holder.frame("i", "f")
+    for s in local_slices:
+        f.set_bit(VIEW_STANDARD, 10, s * SLICE_WIDTH + 1)
+    # Grow max_slice so the executor fans out over slices 0..2.
+    holder.index("i").set_remote_max_slice(2)
+
+    client = MockClient(lambda index, query, slices, remote: [len(slices or [])])
+    e = Executor(
+        holder, host=c.nodes[0].host, cluster=c, client_factory=lambda node: client
+    )
+    (n,) = e.execute("i", parse_string("Count(Bitmap(rowID=10, frame=f))"))
+    # local bits + mock's per-slice 1
+    assert n == len(local_slices) + len(remote_slices)
+    assert client.calls, "remote node should have been queried"
+    _, query, slices, remote = client.calls[0]
+    assert remote is True
+    assert sorted(slices) == sorted(remote_slices)
+    assert query == "Count(Bitmap(frame=\"f\", rowID=10))"
+
+
+def test_remote_failure_fails_over_to_replica(holder):
+    """A failed node's slices re-map to replicas (reference:
+    executor.go:1186-1197)."""
+    c = new_cluster(2)
+    c.replica_n = 2  # every slice has both nodes
+    holder.create_index("i").create_frame("f")
+    f = holder.frame("i", "f")
+    for s in range(3):
+        f.set_bit(VIEW_STANDARD, 10, s * SLICE_WIDTH + 1)
+
+    def fail(index, query, slices, remote):
+        raise ConnectionError("remote down")
+
+    client = MockClient(fail)
+    e = Executor(
+        holder, host=c.nodes[0].host, cluster=c, client_factory=lambda node: client
+    )
+    (n,) = e.execute("i", parse_string("Count(Bitmap(rowID=10, frame=f))"))
+    assert n == 3  # all slices answered locally via replica failover
+
+
+def test_remote_unavailable_without_replica(holder):
+    c = new_cluster(2)  # replica_n = 1
+    holder.create_index("i").create_frame("f")
+    holder.index("i").set_remote_max_slice(4)
+
+    def fail(index, query, slices, remote):
+        raise ConnectionError("remote down")
+
+    e = Executor(
+        holder, host=c.nodes[0].host, cluster=c,
+        client_factory=lambda node: MockClient(fail),
+    )
+    with pytest.raises(ConnectionError):
+        e.execute("i", parse_string("Count(Bitmap(rowID=10, frame=f))"))
+
+
+def test_remote_opt_executes_local_only(holder):
+    """opt.remote=True must only touch local slices (reference:
+    executor.go:1165-1169)."""
+    c = new_cluster(2)
+    holder.create_index("i").create_frame("f")
+    f = holder.frame("i", "f")
+    local = c.owns_slices("i", 3, c.nodes[0].host)
+    for s in range(4):
+        f.set_bit(VIEW_STANDARD, 10, s * SLICE_WIDTH + 1)
+
+    boom = MockClient(lambda *a: (_ for _ in ()).throw(AssertionError("must not call")))
+    e = Executor(holder, host=c.nodes[0].host, cluster=c, client_factory=lambda n: boom)
+    (n,) = e.execute(
+        "i", parse_string("Count(Bitmap(rowID=10, frame=f))"),
+        slices=local, opt=ExecOptions(remote=True),
+    )
+    assert n == len(local)
+    assert not boom.calls

@@ -67,7 +67,9 @@ def test_canonical_string_children_first():
 
 def test_canonical_string_values():
     c = parse1("F(a=true, b=null, c=1.5, d=2.0, e=[1,2], f=[\"s\", t])")
-    assert str(c) == 'F(a=true, b=<nil>, c=1.5, d=2, e=[1,2], f=["s","t"])'
+    # null (not Go's "<nil>") so the canonical string re-parses for
+    # remote forwarding.
+    assert str(c) == 'F(a=true, b=null, c=1.5, d=2, e=[1,2], f=["s","t"])'
 
 
 def test_roundtrip_canonical():
