@@ -1,0 +1,88 @@
+"""Benchmark: 1B-column PQL Intersect+Count throughput (BASELINE.json
+north_star / configs[3]-shaped workload).
+
+Builds ~954 slices (1B columns) of two-row fragments, measures the fused
+AND+popcount query throughput on the accelerator, and compares against
+the host-CPU popcount path (numpy ``bitwise_count``, the stand-in for
+the reference's Go/amd64 POPCNT roaring loop — reference:
+roaring/assembly_amd64.s).  Goal: >=10x (BASELINE.md).
+
+Prints exactly ONE JSON line on stdout:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+Progress goes to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from pilosa_tpu.exec import plan
+    from pilosa_tpu.ops.bitplane import SLICE_WIDTH, WORDS_PER_SLICE
+    from pilosa_tpu.pql.parser import parse_string
+
+    total_columns = 1_000_000_000
+    n_slices = (total_columns + SLICE_WIDTH - 1) // SLICE_WIDTH  # 954
+    log(f"backend={jax.default_backend()} devices={jax.devices()}")
+    log(f"building {n_slices} slices x 2 rows x {WORDS_PER_SLICE} words (~50% density)")
+
+    rng = np.random.default_rng(7)
+    leaves = rng.integers(
+        0, 2**32, size=(n_slices, 2, WORDS_PER_SLICE), dtype=np.uint32
+    )
+
+    # --- host-CPU baseline: the reference's popcntAndSlice loop shape ---
+    a, b = leaves[:, 0], leaves[:, 1]
+    t0 = time.perf_counter()
+    host_count = int(np.bitwise_count(a & b).sum())
+    host_s = time.perf_counter() - t0
+    log(f"host AND+popcount: {host_s:.3f}s -> {host_count}")
+
+    # --- device: fused Intersect+Count, batched over all slices ---
+    q = parse_string("Count(Intersect(Bitmap(rowID=1), Bitmap(rowID=2)))")
+    expr, _ = plan.decompose(q.calls[0].children[0])
+    fn = plan.compiled_batched(expr, "count")
+
+    dev = jnp.asarray(leaves)
+    jax.block_until_ready(dev)
+    # warmup/compile
+    out = jax.block_until_ready(fn(dev))
+    dev_count = int(np.asarray(out, dtype=np.int64).sum())
+    assert dev_count == host_count, f"bit-exactness: {dev_count} != {host_count}"
+
+    iters = 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(dev)
+    jax.block_until_ready(out)
+    dev_s = (time.perf_counter() - t0) / iters
+    log(f"device fused Intersect+Count: {dev_s*1e3:.2f} ms/query (x{iters})")
+
+    cols_per_s = total_columns / dev_s
+    vs = host_s / dev_s
+    print(
+        json.dumps(
+            {
+                "metric": "intersect_count_1b_columns",
+                "value": round(cols_per_s / 1e9, 3),
+                "unit": "Gcols/s",
+                "vs_baseline": round(vs, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -52,8 +52,12 @@ SLICE_WIDTH = bp.SLICE_WIDTH
 # reference: fragment.go:58-65
 HASH_BLOCK_SIZE = 100
 DEFAULT_FRAGMENT_MAX_OP_N = 2000
-# Dense-plane row capacity: 2^16 rows x 128 KiB = 8 GiB worst case.
-MAX_PLANE_ROWS = 1 << 16
+# Cap on *touched* (non-empty-ever) rows per fragment: memory is
+# slots x 128 KiB (8 GiB at the cap).  Row *ids* are unbounded — storage
+# is compact (slot per touched row), the analog of roaring's
+# pay-per-container sparsity for tall-sparse fragments such as inverse
+# views, where the row axis is the column space.
+MAX_FRAGMENT_ROWS = 1 << 16
 
 
 class FragmentError(RuntimeError):
@@ -109,7 +113,11 @@ class Fragment:
         self.stats = None  # StatsClient, wired by View
 
         self._mu = threading.RLock()
+        # Compact row storage: plane row *slots* hold touched rows only;
+        # _slot_of maps logical row id -> slot, _row_ids is the inverse.
         self._plane = bp.empty_plane(bp.ROW_BLOCK)
+        self._slot_of: dict[int, int] = {}
+        self._row_ids: list[int] = []
         self._max_row_id = 0
         self._op_n = 0
         self._version = 0
@@ -146,15 +154,9 @@ class Fragment:
                 self._file.flush()
             else:
                 containers = roaring.decode(data)
-                self._plane = roaring.containers_to_plane(containers, SLICE_WIDTH)
-                rows = self._plane.shape[0]
-                padded = bp.pad_rows(rows)
-                if padded != rows:
-                    self._plane = np.vstack(
-                        [self._plane, np.zeros((padded - rows, bp.WORDS_PER_SLICE), np.uint32)]
-                    )
-                nz = np.nonzero(self._plane.any(axis=1))[0]
-                self._max_row_id = int(nz[-1]) if nz.size else 0
+                self._load_row_map(
+                    roaring.containers_to_row_map(containers, SLICE_WIDTH)
+                )
                 # count replayed ops for snapshot bookkeeping
                 self._op_n = roaring.info(data).ops
             self._open_cache()
@@ -189,10 +191,10 @@ class Fragment:
             return  # corrupt cache is rebuilt lazily, like the reference
         if not isinstance(ids, list):
             return
-        counts = bp.np_row_counts(self._plane)
         for row_id in ids:
-            if isinstance(row_id, int) and 0 <= row_id <= self._max_row_id:
-                self.cache.bulk_add(row_id, int(counts[row_id]))
+            if isinstance(row_id, int) and row_id in self._slot_of:
+                n = bp.np_count(self._plane[self._slot_of[row_id]])
+                self.cache.bulk_add(row_id, n)
         self.cache.invalidate()
 
     def flush_cache(self) -> None:
@@ -221,19 +223,42 @@ class Fragment:
     def max_row_id(self) -> int:
         return self._max_row_id
 
-    def _ensure_rows(self, row_id: int) -> None:
-        if row_id >= MAX_PLANE_ROWS:
-            # The dense plane caps row capacity (rows x 128 KiB) where the
-            # reference's roaring storage is sparse-tall for free; writes
-            # beyond the cap error instead of exhausting memory.  Raise
-            # MAX_PLANE_ROWS / add row-block paging for taller frames.
+    def _ensure_slot(self, row_id: int) -> int:
+        """Slot for a row, allocating compact plane capacity on first
+        touch (memory scales with touched rows, not max row id)."""
+        slot = self._slot_of.get(row_id)
+        if slot is not None:
+            return slot
+        if len(self._row_ids) >= MAX_FRAGMENT_ROWS:
             raise FragmentError(
-                f"row {row_id} exceeds fragment plane capacity ({MAX_PLANE_ROWS})"
+                f"fragment holds too many distinct rows ({MAX_FRAGMENT_ROWS})"
             )
-        needed = bp.pad_rows(row_id + 1)
+        slot = len(self._row_ids)
+        self._row_ids.append(row_id)
+        self._slot_of[row_id] = slot
+        needed = bp.pad_rows(slot + 1)
         if needed > self._plane.shape[0]:
-            extra = np.zeros((needed - self._plane.shape[0], bp.WORDS_PER_SLICE), np.uint32)
+            grow = max(needed, min(2 * self._plane.shape[0], MAX_FRAGMENT_ROWS))
+            extra = np.zeros(
+                (grow - self._plane.shape[0], bp.WORDS_PER_SLICE), np.uint32
+            )
             self._plane = np.vstack([self._plane, extra])
+        self._max_row_id = max(self._max_row_id, row_id)
+        return slot
+
+    def _load_row_map(self, row_map: dict[int, np.ndarray]) -> None:
+        """Replace storage with a {row_id: words} map (open/restore)."""
+        rows = sorted(row_map)
+        self._row_ids = list(rows)
+        self._slot_of = {r: i for i, r in enumerate(rows)}
+        plane = bp.empty_plane(bp.pad_rows(len(rows)))
+        for i, r in enumerate(rows):
+            plane[i] = row_map[r]
+        self._plane = plane
+        self._max_row_id = rows[-1] if rows else 0
+
+    def _row_map(self) -> dict[int, np.ndarray]:
+        return {r: self._plane[s] for r, s in self._slot_of.items()}
 
     # ------------------------------------------------------------------
     # reads
@@ -245,27 +270,28 @@ class Fragment:
         with self._mu:
             seg = self._row_cache.get(row_id)
             if seg is None:
-                if row_id < self._plane.shape[0]:
-                    seg = self._plane[row_id].copy()
-                else:
-                    seg = bp.empty_row()
+                slot = self._slot_of.get(row_id)
+                seg = self._plane[slot].copy() if slot is not None else bp.empty_row()
                 self._row_cache[row_id] = seg
             return RowBitmap.from_segment(self.slice, seg.copy())
 
     def contains(self, row_id: int, column_id: int) -> bool:
         with self._mu:
-            pos = self.pos(row_id, column_id)
-            if row_id >= self._plane.shape[0]:
+            offset = self.pos(row_id, column_id) % SLICE_WIDTH
+            slot = self._slot_of.get(row_id)
+            if slot is None:
                 return False
-            return bp.np_contains(self._plane, pos)
+            return bp.np_contains(self._plane, slot * SLICE_WIDTH + offset)
 
     def count(self) -> int:
         with self._mu:
             return int(np.asarray(bp.count(self.device_plane())))
 
-    def row_counts(self) -> np.ndarray:
+    def row_counts(self) -> dict[int, int]:
+        """{row_id: popcount} for every touched row."""
         with self._mu:
-            return np.asarray(bp.row_counts(self.device_plane()))
+            counts = np.asarray(bp.row_counts(self.device_plane()))
+            return {r: int(counts[s]) for r, s in self._slot_of.items()}
 
     def device_plane(self):
         """The HBM mirror of the plane, re-uploaded when stale."""
@@ -281,9 +307,10 @@ class Fragment:
         """One row of the HBM mirror — a device gather, no host copy.
         Query plans stack these as fused-program leaves (exec/plan.py)."""
         with self._mu:
-            if row_id >= self._plane.shape[0]:
+            slot = self._slot_of.get(row_id)
+            if slot is None:
                 return None
-            return self.device_plane()[row_id]
+            return self.device_plane()[slot]
 
     # ------------------------------------------------------------------
     # writes (reference: fragment.go:379-473)
@@ -292,29 +319,29 @@ class Fragment:
     def set_bit(self, row_id: int, column_id: int) -> bool:
         with self._mu:
             pos = self.pos(row_id, column_id)
-            self._ensure_rows(row_id)
-            changed = bp.np_set_bit(self._plane, pos)
+            slot = self._ensure_slot(row_id)
+            changed = bp.np_set_bit(self._plane, slot * SLICE_WIDTH + pos % SLICE_WIDTH)
             if changed:
                 self._append_op(roaring.OP_ADD, pos)
-                self._after_write(row_id, delta=1)
+                self._after_write(row_id, slot)
             return changed
 
     def clear_bit(self, row_id: int, column_id: int) -> bool:
         with self._mu:
             pos = self.pos(row_id, column_id)
-            if row_id >= self._plane.shape[0]:
+            slot = self._slot_of.get(row_id)
+            if slot is None:
                 return False
-            changed = bp.np_clear_bit(self._plane, pos)
+            changed = bp.np_clear_bit(self._plane, slot * SLICE_WIDTH + pos % SLICE_WIDTH)
             if changed:
                 self._append_op(roaring.OP_REMOVE, pos)
-                self._after_write(row_id, delta=-1)
+                self._after_write(row_id, slot)
             return changed
 
-    def _after_write(self, row_id: int, delta: int) -> None:
+    def _after_write(self, row_id: int, slot: int) -> None:
         self._version += 1
         self._row_cache.pop(row_id, None)
-        self._max_row_id = max(self._max_row_id, row_id)
-        n = bp.np_count(self._plane[row_id])
+        n = bp.np_count(self._plane[slot])
         self.cache.add(row_id, n)
         self._op_n += 1
         if self._op_n >= self.max_op_n:
@@ -340,14 +367,15 @@ class Fragment:
             if ((cols < min_col) | (cols >= min_col + SLICE_WIDTH)).any():
                 raise FragmentError("column out of bounds for slice")
             offs = cols % SLICE_WIDTH
-            self._ensure_rows(int(rows.max()))
-            bp.np_set_bulk(self._plane, rows, offs)
+            uniq = np.unique(rows)
+            slot_of = {int(r): self._ensure_slot(int(r)) for r in uniq}
+            slots = np.asarray([slot_of[int(r)] for r in rows], dtype=np.int64)
+            bp.np_set_bulk(self._plane, slots, offs)
             self._version += 1
             self._row_cache.clear()
-            self._max_row_id = max(self._max_row_id, int(rows.max()))
             counts = bp.np_row_counts(self._plane)
-            for r in np.unique(rows):
-                self.cache.bulk_add(int(r), int(counts[r]))
+            for r, s in slot_of.items():
+                self.cache.bulk_add(r, int(counts[s]))
             self.cache.invalidate()
             self.cache.recalculate()
             self.snapshot()
@@ -357,7 +385,7 @@ class Fragment:
         file; resets the op count (reference: fragment.go:1032-1074)."""
         with self._mu:
             data = roaring.encode(
-                roaring.plane_to_containers(self._plane, SLICE_WIDTH)
+                roaring.row_map_to_containers(self._row_map(), SLICE_WIDTH)
             )
             tmp = self.path + ".snapshotting"
             with open(tmp, "wb") as fh:
@@ -434,13 +462,13 @@ class Fragment:
         if src_seg is None:
             return []
         with self._mu:
-            ids = [p.id for p in candidates]
-            in_range = [i for i in ids if i < self._plane.shape[0]]
-            if not in_range:
+            present = [p.id for p in candidates if p.id in self._slot_of]
+            if not present:
                 return []
-            sub = self._plane[np.asarray(in_range, dtype=np.int64)]
+            slots = np.asarray([self._slot_of[i] for i in present], dtype=np.int64)
+            sub = self._plane[slots]
         counts = np.asarray(bp.top_counts(sub, np.asarray(src_seg, dtype=np.uint32)))
-        by_id = dict(zip(in_range, (int(c) for c in counts)))
+        by_id = dict(zip(present, (int(c) for c in counts)))
 
         results: list[Pair] = []
         for p in candidates:
@@ -493,39 +521,43 @@ class Fragment:
         depends only on logical content, never on plane padding history —
         two replicas with the same bits always agree."""
         with self._mu:
+            by_block: dict[int, list[int]] = {}
+            for r in self._slot_of:
+                by_block.setdefault(r // HASH_BLOCK_SIZE, []).append(r)
             out = []
-            for block_id in range(
-                0, (self._plane.shape[0] + HASH_BLOCK_SIZE - 1) // HASH_BLOCK_SIZE
-            ):
-                lo = block_id * HASH_BLOCK_SIZE
-                hi = min(lo + HASH_BLOCK_SIZE, self._plane.shape[0])
-                block = self._plane[lo:hi]
+            for block_id in sorted(by_block):
+                block = self._block_rows(block_id, by_block[block_id])
                 if not block.any():
                     continue
-                h = hashlib.sha1(block.tobytes())
-                if hi - lo < HASH_BLOCK_SIZE:
-                    pad = np.zeros(
-                        (HASH_BLOCK_SIZE - (hi - lo), bp.WORDS_PER_SLICE), np.uint32
-                    )
-                    h.update(pad.tobytes())
-                out.append((block_id, h.digest()))
+                out.append((block_id, hashlib.sha1(block.tobytes()).digest()))
             return out
+
+    def _block_rows(self, block_id: int, rows: list[int]) -> np.ndarray:
+        """Materialize one full HASH_BLOCK_SIZE-row extent (absent rows
+        zero) so checksums depend only on logical content."""
+        lo = block_id * HASH_BLOCK_SIZE
+        block = np.zeros((HASH_BLOCK_SIZE, bp.WORDS_PER_SLICE), np.uint32)
+        for r in rows:
+            block[r - lo] = self._plane[self._slot_of[r]]
+        return block
 
     def block_data(self, block_id: int) -> PairSet:
         """All (row, col-offset) bits in a block (reference:
         fragment.go:798-808)."""
         with self._mu:
             lo = block_id * HASH_BLOCK_SIZE
-            hi = min(lo + HASH_BLOCK_SIZE, self._plane.shape[0])
-            if lo >= self._plane.shape[0]:
+            rows = sorted(
+                r for r in self._slot_of if lo <= r < lo + HASH_BLOCK_SIZE
+            )
+            if not rows:
                 return PairSet()
-            block = self._plane[lo:hi]
+            block = self._plane[np.asarray([self._slot_of[r] for r in rows])]
             bits = np.unpackbits(
                 np.ascontiguousarray(block).view(np.uint8), bitorder="little"
-            ).reshape(hi - lo, SLICE_WIDTH)
+            ).reshape(len(rows), SLICE_WIDTH)
             rws, cls = np.nonzero(bits)
             return PairSet(
-                row_ids=[int(r) + lo for r in rws],
+                row_ids=[rows[int(r)] for r in rws],
                 column_ids=[int(c) for c in cls],
             )
 
@@ -607,7 +639,7 @@ class Fragment:
         with self._mu:
             tw = tarfile.open(fileobj=w, mode="w|")
             data = roaring.encode(
-                roaring.plane_to_containers(self._plane, SLICE_WIDTH)
+                roaring.row_map_to_containers(self._row_map(), SLICE_WIDTH)
             )
             info = tarfile.TarInfo("data")
             info.size = len(data)
@@ -628,15 +660,9 @@ class Fragment:
                 payload = tr.extractfile(member).read()
                 if member.name == "data":
                     containers = roaring.decode(payload)
-                    plane = roaring.containers_to_plane(containers, SLICE_WIDTH)
-                    padded = bp.pad_rows(plane.shape[0])
-                    if padded != plane.shape[0]:
-                        plane = np.vstack(
-                            [plane, np.zeros((padded - plane.shape[0], bp.WORDS_PER_SLICE), np.uint32)]
-                        )
-                    self._plane = plane
-                    nz = np.nonzero(self._plane.any(axis=1))[0]
-                    self._max_row_id = int(nz[-1]) if nz.size else 0
+                    self._load_row_map(
+                        roaring.containers_to_row_map(containers, SLICE_WIDTH)
+                    )
                     self._version += 1
                     self._row_cache.clear()
                     self._op_n = 0
@@ -655,10 +681,10 @@ class Fragment:
                     except json.JSONDecodeError:
                         continue
                     self.cache = cache_mod.new_cache(self.cache_type, self.cache_size)
-                    counts = bp.np_row_counts(self._plane)
                     for row_id in ids:
-                        if isinstance(row_id, int) and 0 <= row_id < len(counts):
-                            self.cache.bulk_add(row_id, int(counts[row_id]))
+                        if isinstance(row_id, int) and row_id in self._slot_of:
+                            n = bp.np_count(self._plane[self._slot_of[row_id]])
+                            self.cache.bulk_add(row_id, n)
                     self.cache.invalidate()
             tr.close()
 
@@ -668,14 +694,19 @@ class Fragment:
         """Yield (rowID, absolute columnID) for every set bit (reference:
         fragment.go:487-502)."""
         with self._mu:
-            plane = self._plane.copy()
+            rows = sorted(self._slot_of)
+            plane = (
+                self._plane[np.asarray([self._slot_of[r] for r in rows])]
+                if rows
+                else np.zeros((0, bp.WORDS_PER_SLICE), np.uint32)
+            )
         base = self.slice * SLICE_WIDTH
         bits = np.unpackbits(
             np.ascontiguousarray(plane).view(np.uint8), bitorder="little"
         ).reshape(plane.shape[0], SLICE_WIDTH)
         rws, cls = np.nonzero(bits)
         for r, c in zip(rws, cls):
-            yield int(r), base + int(c)
+            yield rows[int(r)], base + int(c)
 
     def __repr__(self) -> str:
         return (

@@ -416,8 +416,8 @@ class Executor:
     def _execute_topn(
         self, index: str, c: Call, slices: list[int], opt: ExecOptions
     ) -> list[Pair]:
-        ids_arg = c.uint_slice_arg("ids")
-        n = c.uint_arg("n") or 0
+        ids_arg = _uint_slice_arg(c, "ids")
+        n = _uint_arg(c, "n")[0]
 
         pairs = self._execute_topn_slices(index, c, slices, opt)
         # Phase 2 refetch only on the originating node (reference:
@@ -450,12 +450,12 @@ class Executor:
         """reference: executor.go:346-415"""
         frame = c.args.get("frame") or DEFAULT_FRAME
         inverse = bool(c.args.get("inverse", False))
-        n = c.uint_arg("n") or 0
+        n = _uint_arg(c, "n")[0]
         fld = c.args.get("field", "") or ""
-        row_ids = c.uint_slice_arg("ids")
-        min_threshold = c.uint_arg("threshold") or 0
+        row_ids = _uint_slice_arg(c, "ids")
+        min_threshold = _uint_arg(c, "threshold")[0]
         filters = c.args.get("filters")
-        tanimoto = c.uint_arg("tanimotoThreshold") or 0
+        tanimoto = _uint_arg(c, "tanimotoThreshold")[0]
 
         src = None
         if len(c.children) == 1:
@@ -686,22 +686,17 @@ class Executor:
             nodes = [Node(host=self.host)]
 
         result = None
-        done = 0
-        total = len(slices)
         pending = [(nodes, slices)]
         while pending:
             nodes, want = pending.pop()
-            if not want and total == 0:
+            if not want and not slices:
                 # Sliceless execution still runs locally once.
                 resp = self._map_node(Node(host=self.host), [], index, c, opt, map_fn)
                 if resp.error:
                     raise resp.error
                 result = reduce_fn(result, resp.result)
                 break
-            try:
-                m = self._slices_by_node(nodes, index, want)
-            except SliceUnavailableError:
-                raise
+            m = self._slices_by_node(nodes, index, want)
             futures = {
                 self._pool.submit(self._map_node, node, node_slices, index, c, opt, map_fn)
                 for _, (node, node_slices) in m.items()
@@ -717,7 +712,6 @@ class Executor:
                     pending.append((remaining, resp.slices))
                     continue
                 result = reduce_fn(result, resp.result)
-                done += len(resp.slices)
         return result
 
     def _map_node(self, node, node_slices, index, c, opt, map_fn) -> _MapResponse:
@@ -725,13 +719,11 @@ class Executor:
         try:
             if node.host == self.host:
                 resp.result = map_fn(node_slices)
-            elif not opt.remote:
+            else:
                 results = self._exec_remote(
                     node, index, Query(calls=[c]), node_slices, opt
                 )
                 resp.result = results[0] if results else None
-            else:
-                resp.result = map_fn([])
         except Exception as e:  # noqa: BLE001 — failover boundary
             resp.error = e
         return resp
@@ -748,16 +740,22 @@ class Executor:
 
 
 def _uint_arg(c: Call, key: str) -> tuple[int, bool]:
-    """(value, present).  Negative int64s wrap to uint64 — the same cast
-    as Call.uint_arg and the reference's UintArg, so e.g. rowID=-1 reads
-    an (empty) astronomically-high row instead of erroring.  Writes to
-    such rows are rejected by the fragment's plane-capacity guard."""
-    v = c.args.get(key)
-    if v is None:
-        return 0, False
-    if isinstance(v, bool) or not isinstance(v, int):
-        raise ExecutorError(f"invalid arg {key}: {v!r}")
-    return v & 0xFFFFFFFFFFFFFFFF, True
+    """(value, present) via Call.uint_arg (negative int64s wrap to
+    uint64, so e.g. rowID=-1 reads an empty astronomically-high row
+    instead of erroring), with type errors normalized to ExecutorError
+    at the API boundary."""
+    try:
+        v = c.uint_arg(key)
+    except TypeError as e:
+        raise ExecutorError(str(e)) from e
+    return (0, False) if v is None else (v, True)
+
+
+def _uint_slice_arg(c: Call, key: str) -> list[int] | None:
+    try:
+        return c.uint_slice_arg(key)
+    except TypeError as e:
+        raise ExecutorError(str(e)) from e
 
 
 def _time_arg(c: Call, key: str) -> datetime:

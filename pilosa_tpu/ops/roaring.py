@@ -290,3 +290,45 @@ def plane_to_containers(plane: np.ndarray, slice_width: int) -> dict[int, np.nda
                     np.uint64
                 ).copy()
     return out
+
+
+def containers_to_row_map(
+    containers: dict[int, np.ndarray], slice_width: int
+) -> dict[int, np.ndarray]:
+    """Sparse densify: container dict -> {row_id: uint32[slice_width/32]}.
+
+    Unlike :func:`containers_to_plane`, memory scales with *touched* rows,
+    so tall-sparse fragments (inverse views, high rowIDs) stay cheap —
+    the dense-plane analog of roaring's pay-per-container storage.
+    """
+    per_row = slice_width // CONTAINER_BITS
+    words32_per_container = CONTAINER_BITS // 32
+    out: dict[int, np.ndarray] = {}
+    for key, words in containers.items():
+        row, cidx = divmod(key, per_row)
+        r = out.get(row)
+        if r is None:
+            r = out[row] = np.zeros(slice_width // 32, dtype=np.uint32)
+        lo = cidx * words32_per_container
+        r[lo : lo + words32_per_container] = words.view("<u4").astype(np.uint32)
+    return out
+
+
+def row_map_to_containers(
+    row_map: dict[int, np.ndarray], slice_width: int
+) -> dict[int, np.ndarray]:
+    """Inverse of :func:`containers_to_row_map`; empty containers are
+    dropped (the reference never serializes empty containers)."""
+    per_row = slice_width // CONTAINER_BITS
+    words32_per_container = CONTAINER_BITS // 32
+    out: dict[int, np.ndarray] = {}
+    for row in sorted(row_map):
+        words = row_map[row]
+        for cidx in range(per_row):
+            lo = cidx * words32_per_container
+            chunk = words[lo : lo + words32_per_container]
+            if chunk.any():
+                out[int(row) * per_row + cidx] = (
+                    np.ascontiguousarray(chunk).view(np.uint64).copy()
+                )
+    return out
