@@ -58,6 +58,8 @@ DEFAULT_FRAGMENT_MAX_OP_N = 2000
 # pay-per-container sparsity for tall-sparse fragments such as inverse
 # views, where the row axis is the column space.
 MAX_FRAGMENT_ROWS = 1 << 16
+# Largest legal row id: op-log positions are u64 and pos = row*2^20+off.
+MAX_ROW_ID = 1 << 44
 
 
 class FragmentError(RuntimeError):
@@ -114,10 +116,9 @@ class Fragment:
 
         self._mu = threading.RLock()
         # Compact row storage: plane row *slots* hold touched rows only;
-        # _slot_of maps logical row id -> slot, _row_ids is the inverse.
+        # _slot_of maps logical row id -> slot.
         self._plane = bp.empty_plane(bp.ROW_BLOCK)
         self._slot_of: dict[int, int] = {}
-        self._row_ids: list[int] = []
         self._max_row_id = 0
         self._op_n = 0
         self._version = 0
@@ -229,12 +230,16 @@ class Fragment:
         slot = self._slot_of.get(row_id)
         if slot is not None:
             return slot
-        if len(self._row_ids) >= MAX_FRAGMENT_ROWS:
+        # Bit positions are u64 in the op-log (pos = row*2^20 + offset),
+        # so row ids must stay below 2^44; reject before mutating state
+        # (PQL rowID=-1 wraps to 2^64-1 at the executor boundary).
+        if row_id >= MAX_ROW_ID:
+            raise FragmentError(f"row id out of range: {row_id}")
+        if len(self._slot_of) >= MAX_FRAGMENT_ROWS:
             raise FragmentError(
                 f"fragment holds too many distinct rows ({MAX_FRAGMENT_ROWS})"
             )
-        slot = len(self._row_ids)
-        self._row_ids.append(row_id)
+        slot = len(self._slot_of)
         self._slot_of[row_id] = slot
         needed = bp.pad_rows(slot + 1)
         if needed > self._plane.shape[0]:
@@ -249,7 +254,6 @@ class Fragment:
     def _load_row_map(self, row_map: dict[int, np.ndarray]) -> None:
         """Replace storage with a {row_id: words} map (open/restore)."""
         rows = sorted(row_map)
-        self._row_ids = list(rows)
         self._slot_of = {r: i for i, r in enumerate(rows)}
         plane = bp.empty_plane(bp.pad_rows(len(rows)))
         for i, r in enumerate(rows):

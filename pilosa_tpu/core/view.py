@@ -118,7 +118,10 @@ class View:
             frag.open()
             self._fragments[slice_i] = frag
             if (grew or first) and self.on_create_slice is not None:
-                self.on_create_slice(self.index, self.frame, slice_i)
+                # (index, view name, slice) — the view name tells the
+                # server whether the new slice is inverse-oriented
+                # (reference: view.go:236-241 CreateSliceMessage).
+                self.on_create_slice(self.index, self.name, slice_i)
             return frag
 
     # --- writes (reference: view.go:262-279) ---

@@ -1,0 +1,311 @@
+"""Node runtime — wires Holder + Executor + Handler + Cluster + loops.
+
+The counterpart of the reference's root Server (reference:
+server.go:44-172): open the holder, start the broadcast receiver and
+node set, build the executor, serve HTTP, and run three background
+loops — anti-entropy, max-slice polling, and runtime metrics (here the
+cache flusher keeps the reference's holder flush loop as well,
+reference: holder.go:318-352).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from pilosa_tpu import __version__
+from pilosa_tpu.cluster import broadcast as bc
+from pilosa_tpu.cluster.topology import Cluster, Node
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.exec.executor import Executor
+from pilosa_tpu.net import wire_pb2 as wire
+from pilosa_tpu.net.client import InternalClient, client_factory
+from pilosa_tpu.net.handler import Handler, make_http_server
+
+# reference: server.go:38-40
+DEFAULT_ANTI_ENTROPY_INTERVAL = 600.0
+DEFAULT_POLLING_INTERVAL = 60.0
+# reference: holder.go:30-31
+DEFAULT_CACHE_FLUSH_INTERVAL = 60.0
+
+
+class Server:
+    """One node of the cluster."""
+
+    def __init__(
+        self,
+        data_dir: str,
+        host: str = "127.0.0.1:0",
+        cluster: Cluster | None = None,
+        broadcaster=None,
+        broadcast_receiver=None,
+        anti_entropy_interval: float = DEFAULT_ANTI_ENTROPY_INTERVAL,
+        polling_interval: float = DEFAULT_POLLING_INTERVAL,
+        cache_flush_interval: float = DEFAULT_CACHE_FLUSH_INTERVAL,
+        max_writes_per_request: int | None = None,
+        logger=None,
+        stats=None,
+    ):
+        self.data_dir = data_dir
+        self.host = host
+        self.cluster = cluster or Cluster()
+        self.broadcaster = broadcaster or bc.NopBroadcaster()
+        self.broadcast_receiver = broadcast_receiver or bc.NopBroadcastReceiver()
+        self.anti_entropy_interval = anti_entropy_interval
+        self.polling_interval = polling_interval
+        self.cache_flush_interval = cache_flush_interval
+        self.max_writes_per_request = max_writes_per_request
+        self.logger = logger or (lambda m: None)
+        self.stats = stats
+
+        self.holder = Holder(data_dir)
+        self.executor: Executor | None = None
+        self.handler: Handler | None = None
+        self._http = None
+        self._http_thread = None
+        self._closing = threading.Event()
+        self._loops: list[threading.Thread] = []
+
+    # ------------------------------------------------------------------
+    # lifecycle (reference: server.go:99-198)
+    # ------------------------------------------------------------------
+
+    def open(self) -> None:
+        bind_host, _, bind_port = self.host.partition(":")
+        port = int(bind_port or 0)
+
+        # Max-slice growth must reach peers before queries route there
+        # (reference: view.go:236-241 broadcasts CreateSliceMessage).
+        self.holder.on_create_slice = self._on_create_slice
+        self.holder.open()
+
+        # Start HTTP listener first so ":0" resolves to the real port
+        # before the node self-registers (reference: server.go:109-125).
+        self.handler = Handler(
+            holder=self.holder,
+            cluster=self.cluster,
+            broadcaster=self.broadcaster,
+            client_factory=client_factory,
+            version=__version__,
+            logger=self.logger,
+            stats=self.stats,
+        )
+        self._http = make_http_server(self.handler, bind_host or "127.0.0.1", port)
+        addr = self._http.server_address
+        self.host = f"{addr[0]}:{addr[1]}"
+
+        # Self-register in the cluster (reference: server.go:117-125).
+        if self.cluster.node_by_host(self.host) is None:
+            self.cluster.add_node(self.host)
+
+        self.broadcast_receiver.start(self)
+        if hasattr(self.cluster, "node_set") and self.cluster.node_set is not None:
+            self.cluster.node_set.open()
+
+        kwargs = {}
+        if self.max_writes_per_request is not None:
+            kwargs["max_writes_per_request"] = self.max_writes_per_request
+        self.executor = Executor(
+            holder=self.holder,
+            host=self.host,
+            cluster=self.cluster,
+            client_factory=client_factory,
+            **kwargs,
+        )
+        self.handler.executor = self.executor
+
+        self._http_thread = threading.Thread(
+            target=self._http.serve_forever, daemon=True, name=f"http:{self.host}"
+        )
+        self._http_thread.start()
+
+        # Background loops (reference: server.go:166-169).
+        for name, fn, interval in (
+            ("anti-entropy", self._tick_anti_entropy, self.anti_entropy_interval),
+            ("max-slices", self._tick_max_slices, self.polling_interval),
+            ("cache-flush", self._tick_cache_flush, self.cache_flush_interval),
+        ):
+            t = threading.Thread(
+                target=self._loop,
+                args=(fn, interval),
+                daemon=True,
+                name=f"{name}:{self.host}",
+            )
+            t.start()
+            self._loops.append(t)
+
+    def close(self) -> None:
+        self._closing.set()
+        if self._http is not None:
+            self._http.shutdown()
+            self._http.server_close()
+        if hasattr(self.broadcast_receiver, "close"):
+            self.broadcast_receiver.close()
+        if self.executor is not None:
+            self.executor.close()
+        self.holder.close()
+
+    def __enter__(self):
+        self.open()
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # ------------------------------------------------------------------
+    # background loops (reference: server.go:200-274, holder.go:318-352)
+    # ------------------------------------------------------------------
+
+    def _loop(self, fn, interval: float) -> None:
+        while not self._closing.wait(interval):
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001 — loops must survive
+                self.logger(f"background loop error: {e}")
+
+    def _tick_anti_entropy(self) -> None:
+        from pilosa_tpu.sync.syncer import HolderSyncer
+
+        HolderSyncer(
+            holder=self.holder,
+            host=self.host,
+            cluster=self.cluster,
+            closing=self._closing,
+        ).sync_holder()
+
+    def _tick_max_slices(self) -> None:
+        """Poll peers' max slices so remote-only slices are queryable
+        (reference: server.go:238-274)."""
+        for node in self.cluster.nodes:
+            if node.host == self.host:
+                continue
+            try:
+                client = InternalClient(node.host, timeout=5.0)
+                for index_name, max_slice in client.max_slice_by_index().items():
+                    idx = self.holder.index(index_name)
+                    if idx is not None:
+                        idx.set_remote_max_slice(max_slice)
+                for index_name, max_slice in client.max_slice_by_index(
+                    inverse=True
+                ).items():
+                    idx = self.holder.index(index_name)
+                    if idx is not None:
+                        idx.set_remote_max_inverse_slice(max_slice)
+            except Exception:  # noqa: BLE001 — peer may be down
+                continue
+
+    def _tick_cache_flush(self) -> None:
+        self.holder.flush_caches()
+
+    def _on_create_slice(self, index: str, view_name: str, slice_i: int) -> None:
+        from pilosa_tpu.core.view import is_inverse_view
+
+        try:
+            self.broadcaster.send_async(
+                wire.CreateSliceMessage(
+                    Index=index, Slice=slice_i, IsInverse=is_inverse_view(view_name)
+                )
+            )
+        except Exception as e:  # noqa: BLE001 — broadcast is best-effort
+            self.logger(f"create-slice broadcast error: {e}")
+
+    # ------------------------------------------------------------------
+    # BroadcastHandler (reference: server.go:277-325)
+    # ------------------------------------------------------------------
+
+    def receive_message(self, msg) -> None:
+        if isinstance(msg, wire.CreateSliceMessage):
+            idx = self.holder.index(msg.Index)
+            if idx is None:
+                raise RuntimeError("index not found")
+            if msg.IsInverse:
+                idx.set_remote_max_inverse_slice(msg.Slice)
+            else:
+                idx.set_remote_max_slice(msg.Slice)
+        elif isinstance(msg, wire.CreateIndexMessage):
+            opts = {}
+            if msg.Meta.ColumnLabel:
+                opts["column_label"] = msg.Meta.ColumnLabel
+            if msg.Meta.TimeQuantum:
+                opts["time_quantum"] = msg.Meta.TimeQuantum
+            self.holder.create_index_if_not_exists(msg.Index, **opts)
+        elif isinstance(msg, wire.DeleteIndexMessage):
+            self.holder.delete_index(msg.Index)
+        elif isinstance(msg, wire.CreateFrameMessage):
+            idx = self.holder.index(msg.Index)
+            if idx is None:
+                raise RuntimeError("index not found")
+            opts = {}
+            if msg.Meta.RowLabel:
+                opts["row_label"] = msg.Meta.RowLabel
+            if msg.Meta.InverseEnabled:
+                opts["inverse_enabled"] = True
+            if msg.Meta.CacheType:
+                opts["cache_type"] = msg.Meta.CacheType
+            if msg.Meta.CacheSize:
+                opts["cache_size"] = msg.Meta.CacheSize
+            if msg.Meta.TimeQuantum:
+                opts["time_quantum"] = msg.Meta.TimeQuantum
+            idx.create_frame_if_not_exists(msg.Frame, **opts)
+        elif isinstance(msg, wire.DeleteFrameMessage):
+            idx = self.holder.index(msg.Index)
+            if idx is not None:
+                idx.delete_frame(msg.Frame)
+        else:
+            raise ValueError(f"unknown message type: {type(msg).__name__}")
+
+    # ------------------------------------------------------------------
+    # status (reference: server.go:331-412)
+    # ------------------------------------------------------------------
+
+    def local_status(self) -> wire.NodeStatus:
+        pb = wire.NodeStatus(Host=self.host, State="UP")
+        for idx in self.holder.indexes().values():
+            pb_idx = wire.Index(
+                Name=idx.name,
+                Meta=wire.IndexMeta(
+                    ColumnLabel=idx.column_label, TimeQuantum=idx.time_quantum
+                ),
+                MaxSlice=idx.max_slice(),
+            )
+            for f in idx.frames().values():
+                pb_idx.Frames.append(
+                    wire.Frame(
+                        Name=f.name,
+                        Meta=wire.FrameMeta(
+                            RowLabel=f.row_label,
+                            InverseEnabled=f.inverse_enabled,
+                            CacheType=f.cache_type,
+                            CacheSize=f.cache_size,
+                            TimeQuantum=f.time_quantum,
+                        ),
+                    )
+                )
+            pb.Indexes.append(pb_idx)
+        return pb
+
+    def handle_remote_status(self, status: wire.NodeStatus) -> None:
+        """Merge a peer's schema into ours (reference:
+        server.go:382-412) — creates missing indexes/frames and adopts
+        remote max slices."""
+        for pb_idx in status.Indexes:
+            opts = {}
+            if pb_idx.Meta.ColumnLabel:
+                opts["column_label"] = pb_idx.Meta.ColumnLabel
+            if pb_idx.Meta.TimeQuantum:
+                opts["time_quantum"] = pb_idx.Meta.TimeQuantum
+            idx = self.holder.create_index_if_not_exists(pb_idx.Name, **opts)
+            idx.set_remote_max_slice(pb_idx.MaxSlice)
+            for pb_f in pb_idx.Frames:
+                fopts = {}
+                if pb_f.Meta.RowLabel:
+                    fopts["row_label"] = pb_f.Meta.RowLabel
+                if pb_f.Meta.InverseEnabled:
+                    fopts["inverse_enabled"] = True
+                if pb_f.Meta.CacheType:
+                    fopts["cache_type"] = pb_f.Meta.CacheType
+                if pb_f.Meta.CacheSize:
+                    fopts["cache_size"] = pb_f.Meta.CacheSize
+                if pb_f.Meta.TimeQuantum:
+                    fopts["time_quantum"] = pb_f.Meta.TimeQuantum
+                idx.create_frame_if_not_exists(pb_f.Name, **fopts)

@@ -314,3 +314,19 @@ def test_read_from_rejects_negative_cache_id(tmp_path, frag):
     f2.read_from(out)
     assert all(p.id >= 0 for p in f2.top(TopOptions()))
     f2.close()
+
+
+def test_huge_row_id_rejected_before_mutation(frag):
+    """rowID=-1 wraps to 2^64-1 at the executor boundary; the fragment
+    must reject it with FragmentError before touching plane or op-log."""
+    import pytest
+
+    from pilosa_tpu.core.fragment import MAX_ROW_ID, FragmentError
+
+    with pytest.raises(FragmentError):
+        frag.set_bit((1 << 64) - 1, 1)
+    with pytest.raises(FragmentError):
+        frag.set_bit(MAX_ROW_ID, 1)
+    # clearing a never-set row is a no-op, regardless of id
+    assert frag.clear_bit((1 << 64) - 1, 1) is False
+    assert frag.count() == 0

@@ -163,12 +163,12 @@ def test_schema(holder):
 def test_on_create_slice_callback(tmp_path):
     events = []
     h = Holder(str(tmp_path / "data"))
-    h.on_create_slice = lambda index, frame, s: events.append((index, frame, s))
+    h.on_create_slice = lambda index, view, s: events.append((index, view, s))
     h.open()
     idx = h.create_index("i")
     f = idx.create_frame("f")
     f.set_bit(VIEW_STANDARD, 0, 2 * SLICE_WIDTH)  # creates slice 2
-    assert ("i", "f", 2) in events
+    assert ("i", VIEW_STANDARD, 2) in events
     h.close()
 
 

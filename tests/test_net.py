@@ -1,0 +1,558 @@
+"""HTTP API layer: codec roundtrips, handler routes, client, servers.
+
+Mirrors the reference's handler/server test strategy (reference:
+handler_test.go, server/server_test.go): full-process servers bound to
+port 0 in one process, exercised through the real client.
+"""
+
+import io
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.cluster import broadcast as bc
+from pilosa_tpu.cluster.topology import Cluster, Node
+from pilosa_tpu.core.bitmap import RowBitmap
+from pilosa_tpu.core.cache import Pair
+from pilosa_tpu.net import codec
+from pilosa_tpu.net import wire_pb2 as wire
+from pilosa_tpu.net.client import ClientError, InternalClient
+from pilosa_tpu.net.handler import Handler, Request
+from pilosa_tpu.net.server import Server
+from pilosa_tpu.ops.bitplane import SLICE_WIDTH
+
+
+# ---------------------------------------------------------------------------
+# codec
+# ---------------------------------------------------------------------------
+
+
+class TestCodec:
+    def test_attrs_roundtrip(self):
+        attrs = {"s": "hi", "i": 42, "b": True, "f": 1.5}
+        back = codec.attrs_from_proto(codec.attrs_to_proto(attrs))
+        assert back == attrs
+
+    def test_attrs_sorted_by_key(self):
+        pb = codec.attrs_to_proto({"z": 1, "a": 2})
+        assert [a.Key for a in pb] == ["a", "z"]
+
+    def test_bitmap_roundtrip(self):
+        b = RowBitmap.from_bits([1, 66000, SLICE_WIDTH + 5])
+        pb = codec.bitmap_to_proto(b)
+        assert list(pb.Bits) == [1, 66000, SLICE_WIDTH + 5]
+        back = codec.bitmap_from_proto(pb)
+        assert codec.bitmap_to_json(back)["bits"] == [1, 66000, SLICE_WIDTH + 5]
+
+    def test_result_polymorphism(self):
+        # count
+        assert codec.result_from_proto(codec.result_to_proto(7)) == 7
+        # changed flag
+        assert codec.result_from_proto(codec.result_to_proto(True)) is True
+        # pairs
+        pairs = codec.result_from_proto(
+            codec.result_to_proto([Pair(id=3, count=9)])
+        )
+        assert [(p.id, p.count) for p in pairs] == [(3, 9)]
+        # bitmap
+        rb = codec.result_from_proto(
+            codec.result_to_proto(RowBitmap.from_bits([10]))
+        )
+        assert isinstance(rb, RowBitmap)
+
+    def test_response_json_shape(self):
+        out = codec.response_to_json([5, RowBitmap.from_bits([1])])
+        assert out["results"][0] == 5
+        assert out["results"][1] == {"attrs": {}, "bits": [1]}
+
+
+# ---------------------------------------------------------------------------
+# broadcast envelope
+# ---------------------------------------------------------------------------
+
+
+class TestBroadcastEnvelope:
+    @pytest.mark.parametrize(
+        "msg",
+        [
+            wire.CreateSliceMessage(Index="i", Slice=3, IsInverse=True),
+            wire.CreateIndexMessage(
+                Index="i", Meta=wire.IndexMeta(ColumnLabel="col")
+            ),
+            wire.DeleteIndexMessage(Index="i"),
+            wire.CreateFrameMessage(
+                Index="i", Frame="f", Meta=wire.FrameMeta(RowLabel="row")
+            ),
+            wire.DeleteFrameMessage(Index="i", Frame="f"),
+        ],
+    )
+    def test_roundtrip(self, msg):
+        back = bc.unmarshal_message(bc.marshal_message(msg))
+        assert type(back) is type(msg)
+        assert back.SerializeToString() == msg.SerializeToString()
+
+    def test_unknown_type(self):
+        with pytest.raises(ValueError):
+            bc.unmarshal_message(b"\xff\x00")
+
+
+# ---------------------------------------------------------------------------
+# single-node server over HTTP
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def server(tmp_path):
+    s = Server(
+        data_dir=str(tmp_path / "data"),
+        host="127.0.0.1:0",
+        anti_entropy_interval=3600,
+        polling_interval=3600,
+        cache_flush_interval=3600,
+    )
+    s.open()
+    yield s
+    s.close()
+
+
+@pytest.fixture
+def client(server):
+    return InternalClient(server.host, timeout=10.0)
+
+
+class TestServerHTTP:
+    def test_version(self, server, client):
+        status, data = client._request("GET", "/version")
+        assert status == 200
+        assert "version" in json.loads(data)
+
+    def test_index_frame_crud(self, server, client):
+        client.create_index("i")
+        client.create_frame("i", "f", {"rowLabel": "rid"})
+        schema = client.schema()
+        assert schema[0]["name"] == "i"
+        assert schema[0]["frames"][0]["name"] == "f"
+        # conflict
+        with pytest.raises(ClientError):
+            client.create_index("i")
+        with pytest.raises(ClientError):
+            client.create_frame("i", "f")
+        client.delete_index("i")
+        assert client.schema() == []
+
+    def test_query_json(self, server, client):
+        client.create_index("i")
+        client.create_frame("i", "f")
+        status, data = client._request(
+            "POST",
+            "/index/i/query",
+            body=b'SetBit(frame="f", rowID=1, columnID=5)',
+        )
+        assert status == 200
+        assert json.loads(data)["results"] == [True]
+        status, data = client._request(
+            "POST", "/index/i/query", body=b'Count(Bitmap(frame="f", rowID=1))'
+        )
+        assert json.loads(data)["results"] == [1]
+        status, data = client._request(
+            "POST", "/index/i/query", body=b'Bitmap(frame="f", rowID=1)'
+        )
+        assert json.loads(data)["results"] == [{"attrs": {}, "bits": [5]}]
+
+    def test_query_protobuf(self, server, client):
+        client.create_index("i")
+        client.create_frame("i", "f")
+        client.execute_query("i", 'SetBit(frame="f", rowID=2, columnID=9)')
+        assert client.execute_pql("i", 'Count(Bitmap(frame="f", rowID=2))') == 1
+        rb = client.execute_pql("i", 'Bitmap(frame="f", rowID=2)')
+        assert isinstance(rb, RowBitmap)
+        assert codec.bitmap_to_json(rb)["bits"] == [9]
+
+    def test_query_error_status(self, server, client):
+        client.create_index("i")
+        status, data = client._request(
+            "POST", "/index/i/query", body=b"Bitmap("
+        )
+        assert status == 400
+        assert "error" in json.loads(data)
+
+    def test_query_invalid_params(self, server, client):
+        client.create_index("i")
+        status, _ = client._request(
+            "POST", "/index/i/query", query={"bogus": "1"}, body=b"Count()"
+        )
+        assert status == 400
+
+    def test_column_attrs_on_query(self, server, client):
+        client.create_index("i")
+        client.create_frame("i", "f")
+        client.execute_query("i", 'SetBit(frame="f", rowID=1, columnID=3)')
+        client.execute_query("i", 'SetColumnAttrs(id=3, name="c3")')
+        status, data = client._request(
+            "POST",
+            "/index/i/query",
+            query={"columnAttrs": "true"},
+            body=b'Bitmap(frame="f", rowID=1)',
+        )
+        out = json.loads(data)
+        assert out["columnAttrs"] == [{"id": 3, "attrs": {"name": "c3"}}]
+
+    def test_slice_max(self, server, client):
+        client.create_index("i")
+        client.create_frame("i", "f")
+        client.execute_query(
+            "i", f'SetBit(frame="f", rowID=0, columnID={SLICE_WIDTH * 2 + 1})'
+        )
+        assert client.max_slice_by_index() == {"i": 2}
+
+    def test_import_and_export(self, server, client):
+        client.create_index("i")
+        client.create_frame("i", "f")
+        bits = [(0, 1), (0, 2), (3, 4)]
+        client.import_bits("i", "f", 0, bits)
+        assert client.execute_pql("i", 'Count(Bitmap(frame="f", rowID=0))') == 2
+        csv = client.export_csv("i", "f", "standard", 0)
+        rows = sorted(
+            tuple(map(int, line.split(","))) for line in csv.strip().splitlines()
+        )
+        assert rows == [(0, 1), (0, 2), (3, 4)]
+
+    def test_fragment_nodes(self, server, client):
+        client.create_index("i")
+        nodes = client.fragment_nodes("i", 0)
+        assert nodes[0]["host"] == server.host
+
+    def test_fragment_backup_restore(self, server, client, tmp_path):
+        client.create_index("i")
+        client.create_frame("i", "f")
+        client.execute_query("i", 'SetBit(frame="f", rowID=7, columnID=8)')
+        data = client.backup_slice("i", "f", "standard", 0)
+        assert data is not None
+        # wipe and restore
+        client.delete_index("i")
+        client.create_index("i")
+        client.create_frame("i", "f")
+        client.restore_slice("i", "f", "standard", 0, data)
+        assert client.execute_pql("i", 'Count(Bitmap(frame="f", rowID=7))') == 1
+
+    def test_backup_to_restore_from(self, server, client):
+        client.create_index("i")
+        client.create_frame("i", "f")
+        for col in (1, SLICE_WIDTH + 2):
+            client.execute_query("i", f'SetBit(frame="f", rowID=1, columnID={col})')
+        buf = io.BytesIO()
+        client.backup_to(buf, "i", "f", "standard")
+        client.delete_index("i")
+        client.create_index("i")
+        client.create_frame("i", "f")
+        buf.seek(0)
+        client.restore_from(buf, "i", "f", "standard")
+        got = client.execute_pql("i", 'Bitmap(frame="f", rowID=1)')
+        assert codec.bitmap_to_json(got)["bits"] == [1, SLICE_WIDTH + 2]
+
+    def test_fragment_blocks_and_block_data(self, server, client):
+        client.create_index("i")
+        client.create_frame("i", "f")
+        client.execute_query("i", 'SetBit(frame="f", rowID=1, columnID=5)')
+        blocks = client.fragment_blocks("i", "f", "standard", 0)
+        assert len(blocks) == 1 and blocks[0][0] == 0
+        rows, cols = client.block_data("i", "f", "standard", 0, 0)
+        assert rows == [1] and cols == [5]
+
+    def test_attr_diff(self, server, client):
+        client.create_index("i")
+        client.create_frame("i", "f")
+        client.execute_query("i", 'SetColumnAttrs(id=1, color="red")')
+        client.execute_query("i", 'SetRowAttrs(frame="f", rowID=2, tag="x")')
+        # empty remote blocks -> everything differs
+        assert client.column_attr_diff("i", []) == {1: {"color": "red"}}
+        assert client.row_attr_diff("i", "f", []) == {2: {"tag": "x"}}
+        # matching blocks -> no diff
+        local = server.holder.index("i").column_attr_store.blocks()
+        assert client.column_attr_diff("i", local) == {}
+
+    def test_views_and_time_quantum(self, server, client):
+        client.create_index("i")
+        client.create_frame("i", "f")
+        status, _ = client._request(
+            "PATCH",
+            "/index/i/frame/f/time-quantum",
+            body=json.dumps({"timeQuantum": "YM"}).encode(),
+        )
+        assert status == 200
+        client.execute_query(
+            "i",
+            'SetBit(frame="f", rowID=1, columnID=2, timestamp="2024-03-05T10:00")',
+        )
+        views = client.frame_views("i", "f")
+        assert "standard" in views
+        assert "standard_2024" in views and "standard_202403" in views
+
+    def test_status_hosts(self, server, client):
+        status, data = client._request("GET", "/status")
+        assert json.loads(data)["status"]["Nodes"][0]["Host"] == server.host
+        status, data = client._request("GET", "/hosts")
+        assert json.loads(data)[0]["host"] == server.host
+
+    def test_webui(self, server, client):
+        status, data = client._request("GET", "/")
+        assert status == 200 and b"pilosa-tpu" in data
+        status, data = client._request("GET", "/assets/main.js")
+        assert status == 200
+        status, _ = client._request("GET", "/assets/nope.js")
+        assert status == 404
+
+    def test_debug_endpoints(self, server, client):
+        status, data = client._request("GET", "/debug/vars")
+        assert status == 200 and "uptime_seconds" in json.loads(data)
+        status, data = client._request("GET", "/debug/pprof/")
+        assert status == 200 and b"thread" in data
+
+    def test_not_found_route(self, server, client):
+        status, _ = client._request("GET", "/nope")
+        assert status == 404
+
+
+# ---------------------------------------------------------------------------
+# multi-node: two real servers, one cluster
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def two_servers(tmp_path):
+    # Real http broadcast between the nodes (reference cluster.type=http):
+    # receivers bind at open; broadcaster host lists are filled once both
+    # ports are known.
+    recv0, recv1 = bc.HTTPBroadcastReceiver(), bc.HTTPBroadcastReceiver()
+    b0, b1 = bc.HTTPBroadcaster([]), bc.HTTPBroadcaster([])
+    cluster0 = Cluster(replica_n=1)
+    cluster1 = Cluster(replica_n=1)
+    s0 = Server(
+        data_dir=str(tmp_path / "n0"),
+        cluster=cluster0,
+        broadcaster=b0,
+        broadcast_receiver=recv0,
+        anti_entropy_interval=3600,
+        polling_interval=3600,
+        cache_flush_interval=3600,
+    )
+    s1 = Server(
+        data_dir=str(tmp_path / "n1"),
+        cluster=cluster1,
+        broadcaster=b1,
+        broadcast_receiver=recv1,
+        anti_entropy_interval=3600,
+        polling_interval=3600,
+        cache_flush_interval=3600,
+    )
+    s0.open()
+    s1.open()
+    b0.internal_hosts.append(recv1.bound_host)
+    b1.internal_hosts.append(recv0.bound_host)
+    # Both clusters know both nodes, in the same order (hash-identical
+    # placement requires identical node lists).
+    for c in (cluster0, cluster1):
+        for host in sorted([s0.host, s1.host]):
+            if c.node_by_host(host) is None:
+                c.add_node(host)
+    # nodes list order must match across clusters
+    cluster0.nodes.sort(key=lambda n: n.host)
+    cluster1.nodes.sort(key=lambda n: n.host)
+    yield s0, s1
+    s0.close()
+    s1.close()
+
+
+class TestMultiNode:
+    def _setup_schema(self, servers):
+        for s in servers:
+            s.holder.create_index_if_not_exists("i")
+            s.holder.index("i").create_frame_if_not_exists("f")
+
+    def test_query_fans_out(self, two_servers):
+        s0, s1 = two_servers
+        self._setup_schema(two_servers)
+        c0 = InternalClient(s0.host, timeout=10.0)
+        # Write bits across many slices; writes route to the owning node
+        # through the coordinator.
+        cols = [1, SLICE_WIDTH + 2, 2 * SLICE_WIDTH + 3, 5 * SLICE_WIDTH + 4]
+        for col in cols:
+            c0.execute_query("i", f'SetBit(frame="f", rowID=1, columnID={col})')
+        # Count from either coordinator sees all slices.
+        assert c0.execute_pql("i", 'Count(Bitmap(frame="f", rowID=1))') == 4
+        # The CreateSliceMessage broadcast is async; wait for s1 to learn
+        # the cluster max slice before querying it as coordinator.
+        c1 = InternalClient(s1.host, timeout=10.0)
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            if s1.holder.index("i").max_slice() == 5:
+                break
+            time.sleep(0.02)
+        assert c1.execute_pql("i", 'Count(Bitmap(frame="f", rowID=1))') == 4
+        rb = c1.execute_pql("i", 'Bitmap(frame="f", rowID=1)')
+        assert codec.bitmap_to_json(rb)["bits"] == sorted(cols)
+
+    def test_bits_actually_distributed(self, two_servers):
+        s0, s1 = two_servers
+        self._setup_schema(two_servers)
+        c0 = InternalClient(s0.host, timeout=10.0)
+        for sl in range(6):
+            c0.execute_query(
+                "i", f'SetBit(frame="f", rowID=1, columnID={sl * SLICE_WIDTH})'
+            )
+
+        def local_count(server):
+            total = 0
+            for sl in range(6):
+                frag = server.holder.fragment("i", "f", "standard", sl)
+                if frag is not None:
+                    total += frag.count()
+            return total
+
+        # Each node holds only its owned slices; together they hold all.
+        assert local_count(s0) + local_count(s1) == 6
+        assert 0 < local_count(s0) < 6
+
+    def test_replica_write_fanout(self, tmp_path):
+        cluster0 = Cluster(replica_n=2)
+        cluster1 = Cluster(replica_n=2)
+        s0 = Server(
+            data_dir=str(tmp_path / "r0"), cluster=cluster0,
+            anti_entropy_interval=3600, polling_interval=3600,
+            cache_flush_interval=3600,
+        )
+        s1 = Server(
+            data_dir=str(tmp_path / "r1"), cluster=cluster1,
+            anti_entropy_interval=3600, polling_interval=3600,
+            cache_flush_interval=3600,
+        )
+        s0.open()
+        s1.open()
+        try:
+            for c in (cluster0, cluster1):
+                for host in sorted([s0.host, s1.host]):
+                    if c.node_by_host(host) is None:
+                        c.add_node(host)
+                c.nodes.sort(key=lambda n: n.host)
+            for s in (s0, s1):
+                s.holder.create_index_if_not_exists("i")
+                s.holder.index("i").create_frame_if_not_exists("f")
+            c0 = InternalClient(s0.host, timeout=10.0)
+            c0.execute_query("i", 'SetBit(frame="f", rowID=1, columnID=5)')
+            # With replica_n=2 and 2 nodes, both hold the bit.
+            for s in (s0, s1):
+                frag = s.holder.fragment("i", "f", "standard", 0)
+                assert frag is not None and frag.contains(1, 5)
+        finally:
+            s0.close()
+            s1.close()
+
+    def test_remote_import_routes_to_owner(self, two_servers):
+        s0, s1 = two_servers
+        self._setup_schema(two_servers)
+        c0 = InternalClient(s0.host, timeout=10.0)
+        # import into slices 0..5 via node 0 only; client routes each
+        # slice to its owner.
+        for sl in range(6):
+            c0.import_bits("i", "f", sl, [(2, sl * SLICE_WIDTH + 1)])
+        assert c0.execute_pql("i", 'Count(Bitmap(frame="f", rowID=2))') == 6
+
+
+# ---------------------------------------------------------------------------
+# http broadcast between two servers
+# ---------------------------------------------------------------------------
+
+
+class TestHTTPBroadcast:
+    def test_schema_replicates(self, tmp_path):
+        recv1 = bc.HTTPBroadcastReceiver()
+        s1 = Server(
+            data_dir=str(tmp_path / "b1"),
+            broadcast_receiver=recv1,
+            anti_entropy_interval=3600, polling_interval=3600,
+            cache_flush_interval=3600,
+        )
+        s1.open()
+        try:
+            broadcaster = bc.HTTPBroadcaster([recv1.bound_host])
+            s0 = Server(
+                data_dir=str(tmp_path / "b0"),
+                broadcaster=broadcaster,
+                anti_entropy_interval=3600, polling_interval=3600,
+                cache_flush_interval=3600,
+            )
+            s0.open()
+            try:
+                c0 = InternalClient(s0.host, timeout=10.0)
+                c0.create_index("i", {"columnLabel": "col"})
+                c0.create_frame("i", "f", {"rowLabel": "row"})
+                # replicated to s1 through the internal listener
+                idx = s1.holder.index("i")
+                assert idx is not None and idx.column_label == "col"
+                assert idx.frame("f").row_label == "row"
+                c0.delete_index("i")
+                assert s1.holder.index("i") is None
+            finally:
+                s0.close()
+        finally:
+            s1.close()
+
+
+# ---------------------------------------------------------------------------
+# anti-entropy over live servers
+# ---------------------------------------------------------------------------
+
+
+class TestAntiEntropy:
+    def test_fragment_sync_converges(self, two_servers):
+        from pilosa_tpu.sync.syncer import HolderSyncer
+
+        s0, s1 = two_servers
+        self._diverge(s0, s1)
+        # Run the syncer from each node; replicas converge to majority.
+        HolderSyncer(
+            holder=s0.holder, host=s0.host, cluster=s0.cluster
+        ).sync_holder()
+        HolderSyncer(
+            holder=s1.holder, host=s1.host, cluster=s1.cluster
+        ).sync_holder()
+        c = InternalClient(s0.host, timeout=10.0)
+        n = c.execute_pql("i", 'Count(Bitmap(frame="f", rowID=0))')
+        assert n == 3
+
+    def _diverge(self, s0, s1):
+        # replica_n=1: each slice owned by exactly one node; write bits
+        # directly into one node's fragment for a slice the *other* node
+        # owns, so sync must repair it.
+        for s in (s0, s1):
+            s.holder.create_index_if_not_exists("i")
+            s.holder.index("i").create_frame_if_not_exists("f")
+        # find a slice owned by s0
+        owned_by_0 = next(
+            sl for sl in range(8) if s0.cluster.owns_fragment(s0.host, "i", sl)
+        )
+        # write the authoritative copy on the owner
+        c0 = InternalClient(s0.host, timeout=10.0)
+        base = owned_by_0 * SLICE_WIDTH
+        for col in (base + 1, base + 2, base + 3):
+            c0.execute_query("i", f'SetBit(frame="f", rowID=0, columnID={col})')
+
+    def test_attr_sync(self, two_servers):
+        from pilosa_tpu.sync.syncer import HolderSyncer
+
+        s0, s1 = two_servers
+        for s in (s0, s1):
+            s.holder.create_index_if_not_exists("i")
+            s.holder.index("i").create_frame_if_not_exists("f")
+        # set attrs only on s0 (bypassing broadcast)
+        s0.holder.index("i").column_attr_store.set_attrs(1, {"color": "red"})
+        s0.holder.frame("i", "f").row_attr_store.set_attrs(2, {"tag": "x"})
+        # sync from s1 pulls the diff
+        HolderSyncer(
+            holder=s1.holder, host=s1.host, cluster=s1.cluster
+        ).sync_holder()
+        assert s1.holder.index("i").column_attr_store.attrs(1) == {"color": "red"}
+        assert s1.holder.frame("i", "f").row_attr_store.attrs(2) == {"tag": "x"}
