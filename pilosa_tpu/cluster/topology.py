@@ -112,7 +112,10 @@ class Cluster:
         the NodeSet currently sees it (reference: cluster.go:149-173)."""
         up = set()
         if self.node_set is not None:
-            up = {n.host for n in self.node_set.nodes()}
+            # NodeSet.nodes() yields host strings (broadcast.NodeSet
+            # protocol); tolerate Node objects too.
+            for n in self.node_set.nodes():
+                up.add(n if isinstance(n, str) else n.host)
         out = {}
         for n in self.nodes:
             n.state = NODE_STATE_UP if n.host in up else NODE_STATE_DOWN

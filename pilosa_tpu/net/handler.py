@@ -698,9 +698,11 @@ def _frame_meta_proto(f) -> wire.FrameMeta:
 
 
 def _dt_from_unix(ts: int):
+    """ImportRequest timestamps are Unix *nanoseconds* (reference:
+    ctl/import.go:157 stores t.UnixNano())."""
     from datetime import datetime, timezone
 
-    return datetime.fromtimestamp(ts, tz=timezone.utc).replace(tzinfo=None)
+    return datetime.fromtimestamp(ts / 1e9, tz=timezone.utc).replace(tzinfo=None)
 
 
 # ---------------------------------------------------------------------------

@@ -99,8 +99,26 @@ class Server:
             self.cluster.add_node(self.host)
 
         self.broadcast_receiver.start(self)
-        if hasattr(self.cluster, "node_set") and self.cluster.node_set is not None:
-            self.cluster.node_set.open()
+        ns = getattr(self.cluster, "node_set", None)
+        if ns is not None:
+            # Gossip backends piggyback node state on probes and surface
+            # membership changes (reference: gossip.go:191-222 LocalState/
+            # MergeRemoteState, cluster.go:161-173 node states).
+            if hasattr(ns, "state_provider") and ns.state_provider is None:
+                ns.state_provider = (
+                    lambda: self.local_status().SerializeToString()
+                )
+            if hasattr(ns, "state_merger") and ns.state_merger is None:
+
+                def _merge(blob: bytes) -> None:
+                    st = wire.NodeStatus()
+                    st.ParseFromString(blob)
+                    self.handle_remote_status(st)
+
+                ns.state_merger = _merge
+            if hasattr(ns, "on_membership_change"):
+                ns.on_membership_change = self._on_membership_change
+            ns.open()
 
         kwargs = {}
         if self.max_writes_per_request is not None:
@@ -196,6 +214,16 @@ class Server:
 
     def _tick_cache_flush(self) -> None:
         self.holder.flush_caches()
+
+    def _on_membership_change(self, items) -> None:
+        """Merge NodeSet membership into cluster node *states*.  The node
+        list itself stays static from config — placement (jump hash over
+        the node count) must not reshard when liveness flaps
+        (reference: cluster.go:161-173)."""
+        for host, state in items:
+            node = self.cluster.node_by_host(host)
+            if node is not None:
+                node.set_state(state)
 
     def _on_create_slice(self, index: str, view_name: str, slice_i: int) -> None:
         from pilosa_tpu.core.view import is_inverse_view

@@ -1,0 +1,372 @@
+"""CLI, config layering, stats clients, and gossip membership."""
+
+import io
+import json
+import socket
+import time
+
+import pytest
+
+from pilosa_tpu import config as config_mod
+from pilosa_tpu.cli.main import main
+from pilosa_tpu.net.client import InternalClient
+from pilosa_tpu.net.server import Server
+from pilosa_tpu.obs import stats as stats_mod
+from pilosa_tpu.ops.bitplane import SLICE_WIDTH
+
+
+# ---------------------------------------------------------------------------
+# config
+# ---------------------------------------------------------------------------
+
+
+class TestConfig:
+    def test_defaults(self):
+        cfg = config_mod.Config()
+        cfg.validate()
+        assert cfg.host == "localhost:10101"
+        assert cfg.cluster.replicas == 1
+        assert cfg.cluster.type == "static"
+
+    def test_toml_roundtrip(self):
+        cfg = config_mod.Config()
+        cfg.cluster.hosts = ["a:1", "b:2"]
+        cfg.cluster.replicas = 2
+        text = cfg.to_toml()
+        back = config_mod.from_toml(text)
+        assert back.cluster.hosts == ["a:1", "b:2"]
+        assert back.cluster.replicas == 2
+
+    def test_unknown_key_rejected(self):
+        with pytest.raises(config_mod.ConfigError):
+            config_mod.from_toml('bogus-key = "x"\n')
+        with pytest.raises(config_mod.ConfigError):
+            config_mod.from_toml("[cluster]\nbogus = 1\n")
+
+    def test_env_overlay(self):
+        cfg = config_mod.Config()
+        config_mod.apply_env(
+            cfg,
+            {
+                "PILOSA_HOST": "h:9",
+                "PILOSA_CLUSTER_REPLICAS": "3",
+                "PILOSA_CLUSTER_HOSTS": "a:1, b:2",
+            },
+        )
+        assert cfg.host == "h:9"
+        assert cfg.cluster.replicas == 3
+        assert cfg.cluster.hosts == ["a:1", "b:2"]
+
+    def test_precedence_flag_over_env_over_file(self, tmp_path):
+        p = tmp_path / "c.toml"
+        p.write_text('host = "file:1"\ndata-dir = "/file"\n')
+        cfg = config_mod.load(
+            str(p),
+            environ={"PILOSA_HOST": "env:2"},
+            overrides={"host": "flag:3"},
+        )
+        assert cfg.host == "flag:3"  # flag wins
+        assert cfg.data_dir == "/file"  # file fills the rest
+
+    def test_invalid_cluster_type(self):
+        cfg = config_mod.Config()
+        cfg.cluster.type = "bogus"
+        with pytest.raises(config_mod.ConfigError):
+            cfg.validate()
+
+
+# ---------------------------------------------------------------------------
+# CLI against a live server
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def server(tmp_path):
+    s = Server(
+        data_dir=str(tmp_path / "data"),
+        anti_entropy_interval=3600,
+        polling_interval=3600,
+        cache_flush_interval=3600,
+    )
+    s.open()
+    c = InternalClient(s.host, timeout=10.0)
+    c.create_index("i")
+    c.create_frame("i", "f")
+    yield s
+    s.close()
+
+
+class TestCLI:
+    def test_generate_config(self, capsys):
+        assert main(["generate-config"]) == 0
+        out = capsys.readouterr().out
+        assert "[cluster]" in out
+        config_mod.from_toml(out)  # parses clean
+
+    def test_config_command(self, tmp_path, capsys):
+        p = tmp_path / "c.toml"
+        p.write_text('host = "x:1"\n')
+        assert main(["config", "-c", str(p)]) == 0
+        assert 'host = "x:1"' in capsys.readouterr().out
+
+    def test_import_export_roundtrip(self, server, tmp_path, capsys):
+        csv_in = tmp_path / "in.csv"
+        csv_in.write_text("1,10\n1,20\n2,30\n")
+        assert (
+            main(
+                ["import", "--host", server.host, "-i", "i", "-f", "f",
+                 str(csv_in)]
+            )
+            == 0
+        )
+        out_file = tmp_path / "out.csv"
+        assert (
+            main(
+                ["export", "--host", server.host, "-i", "i", "-f", "f",
+                 "-o", str(out_file)]
+            )
+            == 0
+        )
+        rows = sorted(
+            tuple(map(int, line.split(",")))
+            for line in out_file.read_text().strip().splitlines()
+        )
+        assert rows == [(1, 10), (1, 20), (2, 30)]
+
+    def test_import_with_timestamp(self, server, tmp_path):
+        server.holder.frame("i", "f").set_time_quantum("YMD")
+        csv_in = tmp_path / "ts.csv"
+        csv_in.write_text("1,10,2024-03-05T10:00\n")
+        assert (
+            main(
+                ["import", "--host", server.host, "-i", "i", "-f", "f",
+                 str(csv_in)]
+            )
+            == 0
+        )
+        c = InternalClient(server.host, timeout=10.0)
+        views = c.frame_views("i", "f")
+        assert "standard_20240305" in views
+
+    def test_backup_restore(self, server, tmp_path):
+        c = InternalClient(server.host, timeout=10.0)
+        c.execute_query("i", 'SetBit(frame="f", rowID=4, columnID=44)')
+        tar_file = tmp_path / "b.tar"
+        assert (
+            main(
+                ["backup", "--host", server.host, "-i", "i", "-f", "f",
+                 "-o", str(tar_file)]
+            )
+            == 0
+        )
+        c.delete_index("i")
+        c.create_index("i")
+        c.create_frame("i", "f")
+        assert (
+            main(
+                ["restore", "--host", server.host, "-i", "i", "-f", "f",
+                 "-d", str(tar_file)]
+            )
+            == 0
+        )
+        assert c.execute_pql("i", 'Count(Bitmap(frame="f", rowID=4))') == 1
+
+    def test_check_and_inspect(self, server, tmp_path, capsys):
+        c = InternalClient(server.host, timeout=10.0)
+        c.execute_query("i", 'SetBit(frame="f", rowID=0, columnID=1)')
+        frag = server.holder.fragment("i", "f", "standard", 0)
+        frag.snapshot()
+        data_file = frag.path
+        assert main(["check", data_file]) == 0
+        assert main(["inspect", data_file]) == 0
+        out = capsys.readouterr().out
+        assert "containers: 1" in out
+        # corrupt file fails check
+        bad = tmp_path / "bad"
+        bad.write_bytes(b"\x00" * 16)
+        assert main(["check", str(bad)]) == 1
+
+    def test_sort(self, tmp_path, capsys, monkeypatch):
+        csv_in = tmp_path / "s.csv"
+        csv_in.write_text(f"5,{SLICE_WIDTH * 2}\n1,3\n2,{SLICE_WIDTH}\n")
+        assert main(["sort", str(csv_in)]) == 0
+        out = capsys.readouterr().out.strip().splitlines()
+        assert out == ["1,3", f"2,{SLICE_WIDTH}", f"5,{SLICE_WIDTH * 2}"]
+
+    def test_bench(self, server, capsys):
+        assert (
+            main(
+                ["bench", "--host", server.host, "-i", "i", "-f", "f",
+                 "-n", "50"]
+            )
+            == 0
+        )
+        assert "op/sec" in capsys.readouterr().out
+
+    def test_server_dry_run(self, tmp_path, capsys):
+        assert (
+            main(
+                ["server", "-d", str(tmp_path / "d"), "--bind",
+                 "127.0.0.1:0", "--dry-run"]
+            )
+            == 0
+        )
+
+
+# ---------------------------------------------------------------------------
+# stats
+# ---------------------------------------------------------------------------
+
+
+class TestStats:
+    def test_expvar_counts_and_tags(self):
+        c = stats_mod.ExpvarStatsClient()
+        c.count("queries", 2)
+        c.count("queries", 3)
+        tagged = c.with_tags("index:i", "frame:f")
+        tagged.count("queries", 1)
+        snap = c.snapshot()
+        assert snap["counts"]["queries"] == 5
+        assert snap["counts"]["queries[frame:f,index:i]"] == 1
+
+    def test_tag_union_is_sorted_dedup(self):
+        c = stats_mod.ExpvarStatsClient().with_tags("b", "a").with_tags("b", "c")
+        assert c.tags() == ["a", "b", "c"]
+
+    def test_histogram_snapshot(self):
+        c = stats_mod.ExpvarStatsClient()
+        for v in (1.0, 2.0, 3.0, 4.0):
+            c.histogram("lat", v)
+        h = c.snapshot()["histograms"]["lat"]
+        assert h["n"] == 4 and h["min"] == 1.0 and h["max"] == 4.0
+
+    def test_statsd_datagram_format(self):
+        rx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        rx.bind(("127.0.0.1", 0))
+        rx.settimeout(2.0)
+        port = rx.getsockname()[1]
+        c = stats_mod.StatsDClient(f"127.0.0.1:{port}").with_tags("index:i")
+        c.count("bits", 3)
+        data, _ = rx.recvfrom(1024)
+        assert data == b"pilosa.bits:3|c|#index:i"
+        c.timing("lat", 1.5)
+        data, _ = rx.recvfrom(1024)
+        assert data == b"pilosa.lat:1.5|ms|#index:i"
+        rx.close()
+
+    def test_multi_fanout(self):
+        a, b = stats_mod.ExpvarStatsClient(), stats_mod.ExpvarStatsClient()
+        m = stats_mod.MultiStatsClient([a, b])
+        m.count("x")
+        assert a.snapshot()["counts"]["x"] == 1
+        assert b.snapshot()["counts"]["x"] == 1
+
+    def test_factory(self):
+        assert isinstance(
+            stats_mod.new_stats_client("nop"), stats_mod.NopStatsClient
+        )
+        assert isinstance(
+            stats_mod.new_stats_client("expvar"), stats_mod.ExpvarStatsClient
+        )
+        with pytest.raises(ValueError):
+            stats_mod.new_stats_client("bogus")
+
+    def test_server_histograms_reach_debug_vars(self, tmp_path):
+        s = Server(
+            data_dir=str(tmp_path / "sv"),
+            stats=stats_mod.ExpvarStatsClient(),
+            anti_entropy_interval=3600, polling_interval=3600,
+            cache_flush_interval=3600,
+        )
+        s.open()
+        try:
+            c = InternalClient(s.host, timeout=10.0)
+            c.create_index("i")
+            status, data = c._request("GET", "/debug/vars")
+            snap = json.loads(data)["stats"]
+            assert any(k.startswith("http.POST") for k in snap["histograms"])
+        finally:
+            s.close()
+
+
+# ---------------------------------------------------------------------------
+# gossip
+# ---------------------------------------------------------------------------
+
+
+class TestGossip:
+    def test_membership_and_user_messages(self):
+        from pilosa_tpu.cluster.gossip import GossipNodeSet
+        from pilosa_tpu.net import wire_pb2 as wire
+
+        received = []
+
+        class H:
+            def receive_message(self, msg):
+                received.append(msg)
+
+        a = GossipNodeSet(host="127.0.0.1:1", bind="127.0.0.1:0",
+                          gossip_interval=0.05, suspect_after=1.0)
+        a.bind = ("127.0.0.1", _free_udp_port())
+        a.start(H())
+        a.open()
+        b = GossipNodeSet(
+            host="127.0.0.1:2", bind="127.0.0.1:0",
+            seed=f"{a.bind[0]}:{a.bind[1]}",
+            gossip_interval=0.05, suspect_after=1.0,
+        )
+        b.bind = ("127.0.0.1", _free_udp_port())
+        b.start(H())
+        b.open()
+        try:
+            deadline = time.time() + 5.0
+            while time.time() < deadline:
+                if "127.0.0.1:2" in a.nodes() and "127.0.0.1:1" in b.nodes():
+                    break
+                time.sleep(0.02)
+            assert "127.0.0.1:2" in a.nodes()
+            assert "127.0.0.1:1" in b.nodes()
+            # user message broadcast reaches the peer's handler
+            a.send_sync(wire.DeleteIndexMessage(Index="y"))
+            deadline = time.time() + 3.0
+            while time.time() < deadline and not received:
+                time.sleep(0.02)
+            assert received and received[0].Index == "y"
+        finally:
+            a.close()
+            b.close()
+
+    def test_down_detection(self):
+        from pilosa_tpu.cluster.gossip import GossipNodeSet
+
+        a = GossipNodeSet(host="127.0.0.1:1", gossip_interval=0.05,
+                          suspect_after=0.3)
+        a.bind = ("127.0.0.1", _free_udp_port())
+        a.open()
+        b = GossipNodeSet(
+            host="127.0.0.1:2", seed=f"{a.bind[0]}:{a.bind[1]}",
+            gossip_interval=0.05, suspect_after=0.3,
+        )
+        b.bind = ("127.0.0.1", _free_udp_port())
+        b.open()
+        try:
+            deadline = time.time() + 5.0
+            while time.time() < deadline and "127.0.0.1:2" not in a.nodes():
+                time.sleep(0.02)
+            b.close()
+            deadline = time.time() + 5.0
+            while time.time() < deadline:
+                if a.member_states().get("127.0.0.1:2") == "DOWN":
+                    break
+                time.sleep(0.05)
+            assert a.member_states()["127.0.0.1:2"] == "DOWN"
+        finally:
+            a.close()
+
+
+def _free_udp_port() -> int:
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
