@@ -143,9 +143,28 @@ def run_import(args) -> int:
 def _import_path(client, args, path: str) -> None:
     if path == "-":
         _import_reader(client, args, sys.stdin)
-    else:
-        with open(path, newline="") as f:
-            _import_reader(client, args, f)
+        return
+    # Fast path: the native CSV parser handles plain "row,col" files;
+    # anything it can't (timestamps, quoting) falls back to Python csv.
+    from pilosa_tpu import native
+
+    with open(path, "rb") as fb:
+        raw = fb.read()
+    parsed = native.parse_csv(raw)
+    if parsed is not None:
+        rows, cols = parsed
+        # Chunk on the numpy arrays so at most buffer_size records are
+        # ever materialized as Python objects at once.
+        for lo in range(0, len(rows), args.buffer_size):
+            chunk = [
+                (int(r), int(c), 0)
+                for r, c in zip(rows[lo : lo + args.buffer_size],
+                                cols[lo : lo + args.buffer_size])
+            ]
+            _flush_bits(client, args, chunk)
+        return
+    with open(path, newline="") as f:
+        _import_reader(client, args, f)
 
 
 def _import_reader(client, args, f) -> None:

@@ -154,12 +154,12 @@ class Fragment:
                 self._file.write(roaring.encode({}))
                 self._file.flush()
             else:
-                containers = roaring.decode(data)
+                containers, op_n = roaring.decode_with_ops(data)
                 self._load_row_map(
                     roaring.containers_to_row_map(containers, SLICE_WIDTH)
                 )
-                # count replayed ops for snapshot bookkeeping
-                self._op_n = roaring.info(data).ops
+                # replayed-op count feeds snapshot bookkeeping
+                self._op_n = op_n
             self._open_cache()
             self._version += 1
             self._opened = True

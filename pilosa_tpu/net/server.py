@@ -92,7 +92,11 @@ class Server:
         )
         self._http = make_http_server(self.handler, bind_host or "127.0.0.1", port)
         addr = self._http.server_address
-        self.host = f"{addr[0]}:{addr[1]}"
+        # Keep the *configured* host string as the node identity — it must
+        # string-match the cluster.hosts entries or placement forks per
+        # node; only a ":0" port is replaced with the bound one.
+        if port == 0:
+            self.host = f"{bind_host or addr[0]}:{addr[1]}"
 
         # Self-register in the cluster (reference: server.go:117-125).
         if self.cluster.node_by_host(self.host) is None:

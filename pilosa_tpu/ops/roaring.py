@@ -78,10 +78,27 @@ class BitmapInfo:
 
 def decode(data: bytes) -> dict[int, np.ndarray]:
     """Decode a roaring file into {container_key: uint64[1024] words},
-    applying the trailing op-log (reference: roaring/roaring.go:567-646)."""
+    applying the trailing op-log (reference: roaring/roaring.go:567-646).
+
+    Dispatches to the C++ codec (pilosa_tpu/native) when available; the
+    Python path is the fallback and parity oracle."""
+    return decode_with_ops(data)[0]
+
+
+def decode_with_ops(data: bytes) -> tuple[dict[int, np.ndarray], int]:
+    """decode() plus the replayed op count — one parse serves both the
+    containers and Fragment.open's op-counter bookkeeping."""
+    from pilosa_tpu import native
+
+    try:
+        res = native.decode(data)
+    except native.NativeCorruptError as e:
+        raise CorruptError(str(e)) from e
+    if res is not None:
+        return res
     containers, ops_offset, _ = _decode_containers(data)
-    _apply_ops(containers, data, ops_offset)
-    return containers
+    op_n = _apply_ops(containers, data, ops_offset)
+    return containers, op_n
 
 
 def _decode_containers(data: bytes):
@@ -176,8 +193,13 @@ def encode(containers: dict[int, np.ndarray]) -> bytes:
 
     Empty containers are dropped (reference: roaring/roaring.go:510-531
     skips c.n == 0).  Containers with <= 4096 bits are written in array
-    form, else bitmap form.
+    form, else bitmap form.  Dispatches to the C++ codec when available.
     """
+    from pilosa_tpu import native
+
+    res = native.encode(containers)
+    if res is not None:
+        return res
     keys = sorted(k for k, w in containers.items() if _words_count(w) > 0)
     header = bytearray()
     header += struct.pack("<II", COOKIE, len(keys))

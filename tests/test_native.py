@@ -1,0 +1,155 @@
+"""Native C++ codec: build, parity vs the Python codec, CSV parsing.
+
+The analog of the reference's asm-vs-Go popcount equivalence tests
+(reference: roaring/assembly_test.go:20-43): every native path must be
+byte-identical with the pure-Python implementation.
+"""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu import native
+from pilosa_tpu.ops import roaring
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native toolchain unavailable"
+)
+
+
+import contextlib
+from unittest import mock
+
+
+@contextlib.contextmanager
+def _python_codec():
+    """Run the real roaring codec with native dispatch disabled, so
+    parity is always measured against the actual fallback path."""
+    with mock.patch.object(native, "decode", return_value=None), \
+         mock.patch.object(native, "encode", return_value=None):
+        yield
+
+
+def _py_encode(containers):
+    with _python_codec():
+        return roaring.encode(containers)
+
+
+def _py_decode(data):
+    with _python_codec():
+        return roaring.decode_with_ops(data)
+
+
+def _random_containers(rng, n_containers=6):
+    out = {}
+    keys = rng.choice(200, size=n_containers, replace=False)
+    for i, key in enumerate(sorted(int(k) for k in keys)):
+        words = np.zeros(1024, dtype=np.uint64)
+        if i % 3 == 0:
+            # sparse (array form)
+            positions = rng.choice(65536, size=rng.integers(1, 100), replace=False)
+            for p in positions:
+                words[p // 64] |= np.uint64(1) << np.uint64(p % 64)
+        elif i % 3 == 1:
+            # dense (bitmap form)
+            words[:] = rng.integers(0, 2**64, size=1024, dtype=np.uint64)
+        # else: empty container (must be dropped on encode)
+        out[key] = words
+    return out
+
+
+class TestNativeParity:
+    def test_encode_byte_identical(self, rng):
+        containers = _random_containers(rng)
+        assert native.encode(containers) == _py_encode(containers)
+
+    def test_decode_matches_python(self, rng):
+        containers = _random_containers(rng)
+        data = _py_encode(containers)
+        # append an op-log
+        data += roaring.encode_op(roaring.OP_ADD, 12345)
+        data += roaring.encode_op(roaring.OP_ADD, 99 * 65536 + 7)
+        data += roaring.encode_op(roaring.OP_REMOVE, 12345)
+        nat, nat_ops = native.decode(data)
+        py, py_ops = _py_decode(data)
+        assert nat_ops == py_ops == 3
+        assert sorted(nat) == sorted(py)
+        for k in py:
+            np.testing.assert_array_equal(nat[k], py[k])
+
+    def test_roundtrip_through_dispatch(self, rng):
+        """roaring.encode/decode dispatch through native and round-trip."""
+        containers = {
+            k: w for k, w in _random_containers(rng).items() if w.any()
+        }
+        data = roaring.encode(containers)
+        back = roaring.decode(data)
+        assert sorted(back) == sorted(containers)
+        for k in containers:
+            np.testing.assert_array_equal(back[k], containers[k])
+
+    def test_encode_op_identical(self):
+        for typ, value in ((0, 0), (1, 7), (0, 2**63 + 5)):
+            want = (
+                bytes([typ])
+                + value.to_bytes(8, "little")
+                + roaring.fnv1a32(
+                    bytes([typ]) + value.to_bytes(8, "little")
+                ).to_bytes(4, "little")
+            )
+            assert native.encode_op(typ, value) == want
+            assert roaring.encode_op(typ, value) == want
+
+    def test_corrupt_rejected(self):
+        with pytest.raises(native.NativeCorruptError):
+            native.decode(b"\x00" * 16)
+        # dispatch layer translates to CorruptError
+        with pytest.raises(roaring.CorruptError):
+            roaring.decode(b"\x00" * 16)
+
+    def test_bad_op_checksum(self, rng):
+        containers = {5: np.zeros(1024, dtype=np.uint64)}
+        containers[5][0] = 1
+        data = _py_encode(containers)
+        op = bytearray(roaring.encode_op(roaring.OP_ADD, 1))
+        op[-1] ^= 0xFF  # break the checksum
+        with pytest.raises(roaring.CorruptError):
+            roaring.decode(data + bytes(op))
+
+
+class TestNativeCSV:
+    def test_parse_basic(self):
+        rows, cols = native.parse_csv(b"1,2\n3,4\n\n5,6\n")
+        assert rows.tolist() == [1, 3, 5]
+        assert cols.tolist() == [2, 4, 6]
+
+    def test_crlf(self):
+        rows, cols = native.parse_csv(b"1,2\r\n3,4\r\n")
+        assert rows.tolist() == [1, 3]
+
+    def test_no_trailing_newline(self):
+        rows, cols = native.parse_csv(b"1,2\n3,4")
+        assert rows.tolist() == [1, 3]
+
+    def test_timestamp_column_falls_back(self):
+        assert native.parse_csv(b"1,2,2024-01-01T00:00\n") is None
+
+    def test_malformed_falls_back(self):
+        assert native.parse_csv(b"a,b\n") is None
+        assert native.parse_csv(b"1\n") is None
+
+    def test_u64_overflow_falls_back(self):
+        # 2^64+1 must not silently wrap to 1
+        assert native.parse_csv(b"18446744073709551617,5\n") is None
+        assert native.parse_csv(b"1,18446744073709551617\n") is None
+        # but u64 max itself is fine
+        rows, cols = native.parse_csv(b"18446744073709551615,5\n")
+        assert rows.tolist() == [18446744073709551615]
+
+    def test_large(self, rng):
+        n = 50_000
+        r = rng.integers(0, 1000, n)
+        c = rng.integers(0, 10_000_000, n)
+        blob = "\n".join(f"{a},{b}" for a, b in zip(r, c)).encode() + b"\n"
+        rows, cols = native.parse_csv(blob)
+        assert rows.tolist() == r.tolist()
+        assert cols.tolist() == c.tolist()
