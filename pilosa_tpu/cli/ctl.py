@@ -140,31 +140,69 @@ def run_import(args) -> int:
     return 0
 
 
+# Native CSV fast path reads the file in blocks of this many bytes, so
+# memory stays bounded regardless of file size.
+_CSV_BLOCK = 64 << 20
+
+
 def _import_path(client, args, path: str) -> None:
     if path == "-":
         _import_reader(client, args, sys.stdin)
         return
-    # Fast path: the native CSV parser handles plain "row,col" files;
-    # anything it can't (timestamps, quoting) falls back to Python csv.
-    from pilosa_tpu import native
-
-    with open(path, "rb") as fb:
-        raw = fb.read()
-    parsed = native.parse_csv(raw)
-    if parsed is not None:
-        rows, cols = parsed
-        # Chunk on the numpy arrays so at most buffer_size records are
-        # ever materialized as Python objects at once.
-        for lo in range(0, len(rows), args.buffer_size):
-            chunk = [
-                (int(r), int(c), 0)
-                for r, c in zip(rows[lo : lo + args.buffer_size],
-                                cols[lo : lo + args.buffer_size])
-            ]
-            _flush_bits(client, args, chunk)
+    # Fast path: the native CSV parser handles plain "row,col" files,
+    # streamed block-by-block (split at the last newline); anything it
+    # can't parse (timestamps, quoting) falls back to Python csv.  A
+    # fallback after a partially imported file is safe: imports are
+    # idempotent bit-sets, so re-importing earlier records is a no-op.
+    if _import_native(client, args, path):
         return
     with open(path, newline="") as f:
         _import_reader(client, args, f)
+
+
+def _import_native(client, args, path: str) -> bool:
+    from pilosa_tpu import native
+
+    if not native.available():
+        return False
+    with open(path, "rb") as fb:
+        carry = b""
+        while True:
+            block = fb.read(_CSV_BLOCK)
+            if not block:
+                break
+            block = carry + block
+            cut = block.rfind(b"\n") + 1
+            if cut == 0:
+                carry, block = b"", block  # no newline: final partial line
+            else:
+                carry, block = block[cut:], block[:cut]
+            if not _import_parsed_block(client, args, block):
+                return False
+        if carry and not _import_parsed_block(client, args, carry):
+            return False
+    return True
+
+
+def _import_parsed_block(client, args, block: bytes) -> bool:
+    from pilosa_tpu import native
+
+    if not block:
+        return True
+    parsed = native.parse_csv(block)
+    if parsed is None:
+        return False
+    rows, cols = parsed
+    # Chunk on the numpy arrays so at most buffer_size records are ever
+    # materialized as Python objects at once.
+    for lo in range(0, len(rows), args.buffer_size):
+        chunk = [
+            (int(r), int(c), 0)
+            for r, c in zip(rows[lo : lo + args.buffer_size],
+                            cols[lo : lo + args.buffer_size])
+        ]
+        _flush_bits(client, args, chunk)
+    return True
 
 
 def _import_reader(client, args, f) -> None:

@@ -298,12 +298,17 @@ class Fragment:
             return {r: int(counts[s]) for r, s in self._slot_of.items()}
 
     def device_plane(self):
-        """The HBM mirror of the plane, re-uploaded when stale."""
+        """The HBM mirror of the plane, re-uploaded when stale.  Pinned
+        to the slice's home device (slice mod n_devices) so multi-device
+        query batches assemble shard-local with no inter-chip copies
+        (parallel/mesh.home_device)."""
         import jax
 
         with self._mu:
             if self._device is None or self._device_version != self._version:
-                self._device = jax.device_put(self._plane)
+                self._device = jax.device_put(
+                    self._plane, bp.home_device(self.slice)
+                )
                 self._device_version = self._version
             return self._device
 

@@ -29,10 +29,12 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from datetime import datetime
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from pilosa_tpu.cluster.topology import Cluster, Node
+from pilosa_tpu.parallel import mesh as pmesh
 from pilosa_tpu.core import cache as cache_mod
 from pilosa_tpu.core import timequantum as tq
 from pilosa_tpu.core.bitmap import RowBitmap
@@ -122,6 +124,7 @@ class Executor:
         self.client_factory = client_factory
         self.max_writes_per_request = max_writes_per_request
         self._pool = ThreadPoolExecutor(max_workers=16)
+        self._zero_rows: dict = {}  # device -> cached all-zero leaf row
 
     def close(self) -> None:
         self._pool.shutdown(wait=False, cancel_futures=True)
@@ -304,7 +307,6 @@ class Executor:
         if not slices:
             return out
 
-        zero = None
         stacks = []
         kept_slices = []
         empties = []
@@ -314,9 +316,7 @@ class Executor:
             for leaf in leaves:
                 r = self._leaf_row_device(index, leaf, s)
                 if r is None:
-                    if zero is None:
-                        zero = jnp.zeros(bp.WORDS_PER_SLICE, dtype=jnp.uint32)
-                    r = zero
+                    r = self._zero_row(s)
                 else:
                     any_set = True
                 rows.append(r)
@@ -326,26 +326,86 @@ class Executor:
             if not any_set:
                 empties.append(s)
                 continue
+            # All of a slice's leaves live on its home device, so this
+            # stack stays device-local.
             stacks.append(jnp.stack(rows))
             kept_slices.append(s)
 
         for s in empties:
             out[s] = 0 if reduce == "count" else None
 
-        if kept_slices:
-            # Pad the slice axis to a power of two: one compiled program
-            # per (tree shape, bucket) instead of per slice count
-            # (SURVEY.md §7 "dynamic shapes" — shape bucketing).
-            n = len(stacks)
-            bucket = 1 << (n - 1).bit_length()
-            if bucket != n:
-                pad = jnp.zeros_like(stacks[0])
-                stacks = stacks + [pad] * (bucket - n)
-            batched = plan.compiled_batched(expr, reduce)
-            res = batched(jnp.stack(stacks))
-            for i, s in enumerate(kept_slices):
-                out[s] = res[i]
+        if not kept_slices:
+            return out
+
+        mesh = pmesh.default_slices_mesh()
+        if mesh is not None and len(kept_slices) > 1:
+            out.update(self._eval_sharded(expr, reduce, kept_slices, stacks, mesh))
+            return out
+
+        # Single device: pad the slice axis to a power of two — one
+        # compiled program per (tree shape, bucket) instead of per slice
+        # count (SURVEY.md §7 "dynamic shapes" — shape bucketing).
+        n = len(stacks)
+        bucket = 1 << (n - 1).bit_length()
+        if bucket != n:
+            pad = jnp.zeros_like(stacks[0])
+            stacks = stacks + [pad] * (bucket - n)
+        batched = plan.compiled_batched(expr, reduce)
+        res = batched(jnp.stack(stacks))
+        for i, s in enumerate(kept_slices):
+            out[s] = res[i]
         return out
+
+    def _eval_sharded(
+        self, expr, reduce, kept_slices, stacks, mesh
+    ) -> dict[int, object]:
+        """Evaluate the batched tree over a multi-device slices mesh.
+
+        Slices are grouped by home device (slice mod n_devices, matching
+        fragment plane placement), per-device blocks are padded to one
+        power-of-two chunk, and the global batch is assembled shard-local
+        (parallel/mesh.assemble_sharded_batch) — the jitted tree program
+        then runs SPMD over the mesh, the in-host analog of the
+        reference's slice->node map/reduce (reference:
+        executor.go:1149-1243), with the reduce riding ICI instead of
+        HTTP fan-in."""
+        n_dev = int(mesh.devices.size)
+        groups: dict[int, list[tuple[int, object]]] = {}
+        for s, st in zip(kept_slices, stacks):
+            groups.setdefault(s % n_dev, []).append((s, st))
+        longest = max(len(g) for g in groups.values())
+        chunk = 1 << (longest - 1).bit_length()
+
+        blocks = []
+        pos_of: dict[int, int] = {}
+        for d in range(n_dev):
+            g = groups.get(d, [])
+            entries = [st for _, st in g]
+            if len(entries) < chunk:
+                zero_stack = jnp.stack(
+                    [self._zero_row(d)] * stacks[0].shape[0]
+                )
+                entries = entries + [zero_stack] * (chunk - len(entries))
+            blocks.append(jnp.stack(entries))
+            for i, (s, _) in enumerate(g):
+                pos_of[s] = d * chunk + i
+
+        batch = pmesh.assemble_sharded_batch(blocks, mesh)
+        res = plan.compiled_batched(expr, reduce)(batch)
+        res = jax.device_get(res)
+        return {s: res[p] for s, p in pos_of.items()}
+
+    def _zero_row(self, slice_i: int):
+        """An all-zero leaf row on a slice's home device (cached per
+        device)."""
+        dev = pmesh.home_device(slice_i)
+        z = self._zero_rows.get(dev)
+        if z is None:
+            z = jax.device_put(
+                np.zeros(bp.WORDS_PER_SLICE, dtype=np.uint32), dev
+            )
+            self._zero_rows[dev] = z
+        return z
 
     def _execute_bitmap_call(
         self, index: str, c: Call, slices: list[int], opt: ExecOptions

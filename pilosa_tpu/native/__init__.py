@@ -27,15 +27,16 @@ _failed = False
 
 
 def _build() -> bool:
-    cmd = [
-        "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-        "-o", _SO + ".tmp", _SRC,
-    ]
+    # Per-process temp name: concurrent builders (server + ctl import on
+    # a fresh checkout) must not interleave writes before the atomic
+    # rename.
+    tmp = f"{_SO}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", tmp, _SRC]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
     except (subprocess.SubprocessError, OSError):
         return False
-    os.replace(_SO + ".tmp", _SO)
+    os.replace(tmp, _SO)
     return True
 
 

@@ -167,6 +167,17 @@ def _popcount_sum(words: jnp.ndarray) -> jnp.ndarray:
     return jnp.sum(jax.lax.population_count(words).astype(jnp.int32))
 
 
+def home_device(slice_i: int):
+    """The device that owns a slice's fragment planes: ``slice mod
+    n_devices`` — the in-host analog of the reference's slice->node
+    placement (reference: cluster.go:202-216).  Lives here (not in
+    parallel/) so the storage layer can pin planes without pulling in
+    the mesh/planner machinery; parallel/mesh.py builds its sharded
+    batches around the same mapping."""
+    devs = jax.local_devices()
+    return devs[slice_i % len(devs)]
+
+
 def _use_pallas() -> bool:
     if os.environ.get("PILOSA_TPU_DISABLE_PALLAS"):
         return False

@@ -37,6 +37,38 @@ from pilosa_tpu.exec import plan
 AXIS_SLICES = "slices"
 AXIS_ROWS = "rows"
 
+_slices_mesh: Mesh | None = None
+
+
+def default_slices_mesh() -> Mesh | None:
+    """A 1-D slices mesh over all local devices; None on single-device
+    hosts (the executor then uses the plain vmapped path)."""
+    global _slices_mesh
+    devs = jax.local_devices()
+    if len(devs) < 2:
+        return None
+    if _slices_mesh is None or _slices_mesh.devices.size != len(devs):
+        _slices_mesh = Mesh(np.array(devs), (AXIS_SLICES,))
+    return _slices_mesh
+
+
+from pilosa_tpu.ops.bitplane import home_device  # noqa: E402 — re-export;
+# placement policy lives with the kernels so core/ never imports this module.
+
+
+def assemble_sharded_batch(blocks: list[jax.Array], mesh: Mesh) -> jax.Array:
+    """Glue per-device blocks (block d committed to mesh device d, all
+    the same shape) into one global array sharded P(slices) on axis 0
+    — no device-to-device traffic."""
+    from jax.sharding import NamedSharding
+
+    chunk = blocks[0].shape[0]
+    shape = (len(blocks) * chunk,) + blocks[0].shape[1:]
+    spec = P(AXIS_SLICES, *([None] * (len(shape) - 1)))
+    return jax.make_array_from_single_device_arrays(
+        shape, NamedSharding(mesh, spec), blocks
+    )
+
 
 def slice_mesh(n_devices: int | None = None, row_shards: int = 1) -> Mesh:
     """A (slices, rows) mesh over the first ``n_devices`` devices.
