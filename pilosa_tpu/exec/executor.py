@@ -383,7 +383,7 @@ class Executor:
             entries = [st for _, st in g]
             if len(entries) < chunk:
                 zero_stack = jnp.stack(
-                    [self._zero_row(d)] * stacks[0].shape[0]
+                    [self._zero_row_on(mesh.devices.flat[d])] * stacks[0].shape[0]
                 )
                 entries = entries + [zero_stack] * (chunk - len(entries))
             blocks.append(jnp.stack(entries))
@@ -396,9 +396,11 @@ class Executor:
         return {s: res[p] for s, p in pos_of.items()}
 
     def _zero_row(self, slice_i: int):
-        """An all-zero leaf row on a slice's home device (cached per
-        device)."""
-        dev = pmesh.home_device(slice_i)
+        """An all-zero leaf row on a slice's home device."""
+        return self._zero_row_on(pmesh.home_device(slice_i))
+
+    def _zero_row_on(self, dev):
+        """An all-zero leaf row committed to ``dev`` (cached per device)."""
         z = self._zero_rows.get(dev)
         if z is None:
             z = jax.device_put(

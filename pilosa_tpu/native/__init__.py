@@ -34,10 +34,16 @@ def _build() -> bool:
     cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", tmp, _SRC]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO)
+        return True
     except (subprocess.SubprocessError, OSError):
         return False
-    os.replace(tmp, _SO)
-    return True
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
 
 
 def lib() -> ctypes.CDLL | None:

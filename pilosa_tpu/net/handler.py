@@ -174,13 +174,33 @@ class Handler:
             self.logger(f"handler error {req.method} {req.path}: {e}\n"
                         + traceback.format_exc())
             resp = Response.error(str(e), 500)
+        elapsed = time.monotonic() - t0
+        try:
+            self._observe(req, elapsed)
+        except Exception:  # noqa: BLE001 — metrics/logging never drop a response
+            pass
+        return resp
+
+    def _observe(self, req: Request, elapsed: float) -> None:
         if self.stats is not None:
             # per-endpoint latency histogram (reference: handler.go:140-167)
             self.stats.histogram(
-                f"http.{req.method}.{req.path.split('?')[0]}",
-                (time.monotonic() - t0) * 1000.0,
+                f"http.{req.method}.{req.path.split('?')[0]}", elapsed * 1000.0
             )
-        return resp
+        # slow-query log gated by cluster.long-query-time
+        # (reference: handler.go:158-163)
+        lqt = getattr(self.cluster, "long_query_time", 0.0) if self.cluster else 0.0
+        if float(lqt) > 0 and elapsed > float(lqt) and "/query" in req.path:
+            if req.header("Content-Type") == PROTOBUF:
+                try:
+                    pb = wire.QueryRequest()
+                    pb.ParseFromString(req.body)
+                    query_text = pb.Query
+                except Exception:  # noqa: BLE001 — logging only
+                    query_text = "<unparseable protobuf>"
+            else:
+                query_text = req.body[:512].decode(errors="replace")
+            self.logger(f"slow query {elapsed:.3f}s: {query_text[:512]}")
 
     # ------------------------------------------------------------------
     # introspection

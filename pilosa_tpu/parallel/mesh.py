@@ -60,8 +60,6 @@ def assemble_sharded_batch(blocks: list[jax.Array], mesh: Mesh) -> jax.Array:
     """Glue per-device blocks (block d committed to mesh device d, all
     the same shape) into one global array sharded P(slices) on axis 0
     — no device-to-device traffic."""
-    from jax.sharding import NamedSharding
-
     chunk = blocks[0].shape[0]
     shape = (len(blocks) * chunk,) + blocks[0].shape[1:]
     spec = P(AXIS_SLICES, *([None] * (len(shape) - 1)))
