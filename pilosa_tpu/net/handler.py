@@ -175,22 +175,35 @@ class Handler:
                         + traceback.format_exc())
             resp = Response.error(str(e), 500)
         elapsed = time.monotonic() - t0
+        # Metrics and logging never drop a response, and a failing stats
+        # backend must not silence the slow-query log: each observes
+        # independently.
         try:
-            self._observe(req, elapsed)
-        except Exception:  # noqa: BLE001 — metrics/logging never drop a response
+            self._observe_stats(req, elapsed)
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            self._observe_slow_query(req, elapsed)
+        except Exception:  # noqa: BLE001
             pass
         return resp
 
-    def _observe(self, req: Request, elapsed: float) -> None:
+    def _observe_stats(self, req: Request, elapsed: float) -> None:
         if self.stats is not None:
             # per-endpoint latency histogram (reference: handler.go:140-167)
             self.stats.histogram(
                 f"http.{req.method}.{req.path.split('?')[0]}", elapsed * 1000.0
             )
+
+    def _observe_slow_query(self, req: Request, elapsed: float) -> None:
         # slow-query log gated by cluster.long-query-time
-        # (reference: handler.go:158-163)
+        # (reference: handler.go:158-163); exact route match so frames
+        # legally named "query" don't trigger it
         lqt = getattr(self.cluster, "long_query_time", 0.0) if self.cluster else 0.0
-        if float(lqt) > 0 and elapsed > float(lqt) and "/query" in req.path:
+        is_query_route = req.method == "POST" and bool(
+            re.match(r"^/index/[^/]+/query$", req.path)
+        )
+        if float(lqt) > 0 and elapsed > float(lqt) and is_query_route:
             if req.header("Content-Type") == PROTOBUF:
                 try:
                     pb = wire.QueryRequest()

@@ -1,0 +1,174 @@
+"""Property-based tests — the analog of the reference's testing/quick
+suites (reference: server/server_test.go:43-122 TestMain_Set_Quick,
+roaring/roaring_test.go randomized tests): random operation sequences
+validated against a pure-Python set model.
+"""
+
+import numpy as np
+from hypothesis import HealthCheck, given, settings
+from hypothesis import strategies as st
+
+from pilosa_tpu.core.bitmap import RowBitmap
+from pilosa_tpu.ops import bitplane as bp
+from pilosa_tpu.ops import roaring
+
+QUICK = settings(
+    max_examples=25,
+    deadline=None,
+    suppress_health_check=[HealthCheck.function_scoped_fixture],
+)
+
+bit_positions = st.lists(
+    st.integers(min_value=0, max_value=2**20 - 1), min_size=0, max_size=300
+)
+
+
+# ---------------------------------------------------------------------------
+# roaring codec
+# ---------------------------------------------------------------------------
+
+
+container_dicts = st.dictionaries(
+    st.integers(min_value=0, max_value=500),
+    st.lists(st.integers(min_value=0, max_value=2**16 - 1), max_size=200),
+    max_size=8,
+)
+
+
+def _to_words(positions):
+    w = np.zeros(1024, dtype=np.uint64)
+    for p in positions:
+        w[p // 64] |= np.uint64(1) << np.uint64(p % 64)
+    return w
+
+
+class TestRoaringProperties:
+    @QUICK
+    @given(container_dicts)
+    def test_encode_decode_roundtrip(self, d):
+        containers = {k: _to_words(v) for k, v in d.items()}
+        nonempty = {k: w for k, w in containers.items() if w.any()}
+        back = roaring.decode(roaring.encode(containers))
+        assert sorted(back) == sorted(nonempty)
+        for k, w in nonempty.items():
+            np.testing.assert_array_equal(back[k], w)
+
+    @QUICK
+    @given(
+        container_dicts,
+        st.lists(
+            st.tuples(
+                st.sampled_from([roaring.OP_ADD, roaring.OP_REMOVE]),
+                st.integers(min_value=0, max_value=2**24),
+            ),
+            max_size=50,
+        ),
+    )
+    def test_oplog_replay_matches_model(self, d, ops):
+        containers = {k: _to_words(v) for k, v in d.items()}
+        data = roaring.encode(containers)
+        model = set()
+        for k, w in containers.items():
+            if not w.any():
+                continue
+            for p in np.nonzero(np.unpackbits(w.view(np.uint8), bitorder="little"))[0]:
+                model.add(k * 2**16 + int(p))
+        for typ, value in ops:
+            data += roaring.encode_op(typ, value)
+            if typ == roaring.OP_ADD:
+                model.add(value)
+            else:
+                model.discard(value)
+        back = roaring.decode(data)
+        got = set()
+        for k, w in back.items():
+            for p in np.nonzero(np.unpackbits(w.view(np.uint8), bitorder="little"))[0]:
+                got.add(k * 2**16 + int(p))
+        assert got == model
+
+
+# ---------------------------------------------------------------------------
+# RowBitmap algebra vs python sets
+# ---------------------------------------------------------------------------
+
+
+class TestRowBitmapProperties:
+    @QUICK
+    @given(bit_positions, bit_positions)
+    def test_algebra_matches_sets(self, a_bits, b_bits):
+        # spread across two slices to exercise the segment merge
+        a_bits = [b + (b % 2) * 2**20 for b in a_bits]
+        b_bits = [b + (b % 3 == 0) * 2**20 for b in b_bits]
+        a, b = RowBitmap.from_bits(a_bits), RowBitmap.from_bits(b_bits)
+        sa, sb = set(a_bits), set(b_bits)
+        from pilosa_tpu.net.codec import bitmap_to_json
+
+        assert bitmap_to_json(a.intersect(b))["bits"] == sorted(sa & sb)
+        assert bitmap_to_json(a.union(b))["bits"] == sorted(sa | sb)
+        assert bitmap_to_json(a.difference(b))["bits"] == sorted(sa - sb)
+        assert bitmap_to_json(a.xor(b))["bits"] == sorted(sa ^ sb)
+        assert a.count() == len(sa)
+
+
+# ---------------------------------------------------------------------------
+# executor vs model over random write sequences
+# (reference: TestMain_Set_Quick, server/server_test.go:43-122)
+# ---------------------------------------------------------------------------
+
+
+write_sequences = st.lists(
+    st.tuples(
+        st.booleans(),  # set vs clear
+        st.integers(min_value=0, max_value=5),  # row
+        st.integers(min_value=0, max_value=3 * 2**20 - 1),  # column (3 slices)
+    ),
+    min_size=1,
+    max_size=60,
+)
+
+
+class TestExecutorQuick:
+    @QUICK
+    @given(write_sequences)
+    def test_random_writes_match_model(self, tmp_path_factory, seq):
+        from pilosa_tpu.core.holder import Holder
+        from pilosa_tpu.exec.executor import Executor
+        from pilosa_tpu.net.codec import bitmap_to_json
+        from pilosa_tpu.pql.parser import parse_string
+
+        holder = Holder(str(tmp_path_factory.mktemp("quick")))
+        holder.open()
+        idx = holder.create_index("i")
+        idx.create_frame("f")
+        ex = Executor(holder=holder, host="local")
+
+        model: dict[int, set] = {}
+        calls = []
+        for is_set, row, col in seq:
+            verb = "SetBit" if is_set else "ClearBit"
+            calls.append(f'{verb}(frame="f", rowID={row}, columnID={col})')
+            if is_set:
+                model.setdefault(row, set()).add(col)
+            else:
+                model.setdefault(row, set()).discard(col)
+        ex.execute("i", parse_string(" ".join(calls)))
+
+        for row, want in model.items():
+            got = ex.execute(
+                "i", parse_string(f'Bitmap(frame="f", rowID={row})')
+            )[0]
+            assert bitmap_to_json(got)["bits"] == sorted(want)
+            n = ex.execute(
+                "i", parse_string(f'Count(Bitmap(frame="f", rowID={row}))')
+            )[0]
+            assert n == len(want)
+
+        # persistence: reopen and re-verify one row
+        holder.close()
+        holder2 = Holder(holder.path)
+        holder2.open()
+        ex2 = Executor(holder=holder2, host="local")
+        row = max(model)
+        got = ex2.execute("i", parse_string(f'Bitmap(frame="f", rowID={row})'))[0]
+        assert bitmap_to_json(got)["bits"] == sorted(model[row])
+        holder2.close()
