@@ -70,6 +70,28 @@ def main() -> None:
     dev_s = (time.perf_counter() - t0) / iters
     log(f"device fused Intersect+Count: {dev_s*1e3:.2f} ms/query (x{iters})")
 
+    # --- secondary: TopN(n=100) scoring latency (BASELINE configs[2]) ---
+    # 2048 candidate rows scored against a src row in one batched kernel;
+    # p50 over 20 queries, logged to stderr (the driver records only the
+    # primary metric line).
+    from pilosa_tpu.ops import bitplane as bpl
+
+    cand = jnp.asarray(
+        rng.integers(0, 2**32, size=(2048, bpl.WORDS_PER_SLICE), dtype=np.uint32)
+    )
+    src = jnp.asarray(leaves[0, 0])
+    warm = bpl.top_counts(cand, src)
+    jax.block_until_ready(bpl.top_k(warm, 100))  # compile both stages
+    lat = []
+    for _ in range(20):
+        t0 = time.perf_counter()
+        counts = bpl.top_counts(cand, src)
+        topc, topi = bpl.top_k(counts, 100)
+        jax.block_until_ready((topc, topi))
+        lat.append(time.perf_counter() - t0)
+    p50 = sorted(lat)[len(lat) // 2]
+    log(f"TopN(n=100) over 2048 rows: p50 {p50*1e3:.2f} ms")
+
     cols_per_s = total_columns / dev_s
     vs = host_s / dev_s
     print(

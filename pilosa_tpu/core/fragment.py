@@ -474,8 +474,10 @@ class Fragment:
             present = [p.id for p in candidates if p.id in self._slot_of]
             if not present:
                 return []
-            slots = np.asarray([self._slot_of[i] for i in present], dtype=np.int64)
-            sub = self._plane[slots]
+            slots = np.asarray([self._slot_of[i] for i in present], dtype=np.int32)
+            # Gather candidate rows from the HBM-resident plane — only the
+            # src row and the slot indices travel host->device.
+            sub = self.device_plane()[slots]
         counts = np.asarray(bp.top_counts(sub, np.asarray(src_seg, dtype=np.uint32)))
         by_id = dict(zip(present, (int(c) for c in counts)))
 

@@ -36,3 +36,12 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
+
+
+def positions_to_words(positions, n_words=1024):
+    """Pack bit positions into uint64 words — shared by the roaring,
+    native-parity, and property test suites."""
+    w = np.zeros(n_words, dtype=np.uint64)
+    for p in positions:
+        w[p // 64] |= np.uint64(1) << np.uint64(p % 64)
+    return w

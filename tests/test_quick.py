@@ -9,7 +9,6 @@ from hypothesis import HealthCheck, given, settings
 from hypothesis import strategies as st
 
 from pilosa_tpu.core.bitmap import RowBitmap
-from pilosa_tpu.ops import bitplane as bp
 from pilosa_tpu.ops import roaring
 
 QUICK = settings(
@@ -35,11 +34,7 @@ container_dicts = st.dictionaries(
 )
 
 
-def _to_words(positions):
-    w = np.zeros(1024, dtype=np.uint64)
-    for p in positions:
-        w[p // 64] |= np.uint64(1) << np.uint64(p % 64)
-    return w
+from tests.conftest import positions_to_words as _to_words
 
 
 class TestRoaringProperties:
