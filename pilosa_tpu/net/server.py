@@ -146,6 +146,7 @@ class Server:
             ("anti-entropy", self._tick_anti_entropy, self.anti_entropy_interval),
             ("max-slices", self._tick_max_slices, self.polling_interval),
             ("cache-flush", self._tick_cache_flush, self.cache_flush_interval),
+            ("runtime", self._tick_runtime, self.polling_interval),
         ):
             t = threading.Thread(
                 target=self._loop,
@@ -218,6 +219,29 @@ class Server:
 
     def _tick_cache_flush(self) -> None:
         self.holder.flush_caches()
+
+    def _tick_runtime(self) -> None:
+        """Runtime gauges — the analog of the reference's goroutine gauge
+        + GC notifications (reference: server.go:459-488)."""
+        if self.stats is None:
+            return
+        import gc
+
+        self.stats.gauge("threads", threading.active_count())
+        counts = gc.get_count()
+        self.stats.gauge("gc.gen0_pending", counts[0])
+        try:
+            import jax
+
+            for i, dev in enumerate(jax.local_devices()):
+                ms = getattr(dev, "memory_stats", None)
+                stats = ms() if callable(ms) else None
+                if stats and "bytes_in_use" in stats:
+                    self.stats.gauge(
+                        f"device.{i}.hbm_bytes_in_use", stats["bytes_in_use"]
+                    )
+        except Exception:  # noqa: BLE001 — device stats are best-effort
+            pass
 
     def _on_membership_change(self, items) -> None:
         """Merge NodeSet membership into cluster node *states*.  The node

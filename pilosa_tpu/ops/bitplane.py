@@ -167,6 +167,34 @@ def _popcount_sum(words: jnp.ndarray) -> jnp.ndarray:
     return jnp.sum(jax.lax.population_count(words).astype(jnp.int32))
 
 
+@functools.lru_cache(maxsize=8)
+def _parse_mesh_shape(shape: str) -> int | None:
+    """Device cap from a mesh-shape string ("4", "4x2", ...); None when
+    unset or malformed (malformed never silently disables sharding)."""
+    factors = shape.lower().replace("x", " ").split()
+    if not factors:
+        return None
+    try:
+        want = 1
+        for f in factors:
+            want *= int(f)
+        return max(1, want)
+    except ValueError:
+        return None
+
+
+def mesh_device_count() -> int:
+    """Local devices participating in slice placement and the slices
+    mesh.  The ``tpu.mesh-shape`` config (env ``PILOSA_TPU_MESH_SHAPE``,
+    e.g. "4" or "4x2" — the product of the factors) caps it; default
+    all local devices."""
+    n = len(jax.local_devices())
+    want = _parse_mesh_shape(os.environ.get("PILOSA_TPU_MESH_SHAPE", ""))
+    if want is not None:
+        n = min(n, want)
+    return n
+
+
 def home_device(slice_i: int):
     """The device that owns a slice's fragment planes: ``slice mod
     n_devices`` — the in-host analog of the reference's slice->node
@@ -174,7 +202,7 @@ def home_device(slice_i: int):
     parallel/) so the storage layer can pin planes without pulling in
     the mesh/planner machinery; parallel/mesh.py builds its sharded
     batches around the same mapping."""
-    devs = jax.local_devices()
+    devs = jax.local_devices()[: mesh_device_count()]
     return devs[slice_i % len(devs)]
 
 

@@ -41,19 +41,23 @@ _slices_mesh: Mesh | None = None
 
 
 def default_slices_mesh() -> Mesh | None:
-    """A 1-D slices mesh over all local devices; None on single-device
-    hosts (the executor then uses the plain vmapped path)."""
+    """A 1-D slices mesh over the participating local devices; None on
+    single-device hosts (the executor then uses the plain vmapped
+    path)."""
     global _slices_mesh
-    devs = jax.local_devices()
-    if len(devs) < 2:
+    n = mesh_device_count()
+    if n < 2:
         return None
-    if _slices_mesh is None or _slices_mesh.devices.size != len(devs):
+    devs = jax.local_devices()[:n]
+    if _slices_mesh is None or _slices_mesh.devices.size != n:
         _slices_mesh = Mesh(np.array(devs), (AXIS_SLICES,))
     return _slices_mesh
 
 
-from pilosa_tpu.ops.bitplane import home_device  # noqa: E402 — re-export;
-# placement policy lives with the kernels so core/ never imports this module.
+from pilosa_tpu.ops.bitplane import (  # noqa: E402 — re-export; placement
+    home_device,  # policy lives with the kernels so core/ never imports
+    mesh_device_count,  # this module.
+)
 
 
 def assemble_sharded_batch(blocks: list[jax.Array], mesh: Mesh) -> jax.Array:

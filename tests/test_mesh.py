@@ -164,3 +164,24 @@ class TestShardedExecutor:
         h, ex, parse = self._exec(tmp_path, n_slices=11)
         q = parse('Count(Bitmap(frame="f", rowID=1))')
         assert ex.execute("i", q) == [11]
+
+
+def test_mesh_shape_config_caps_devices(monkeypatch):
+    from pilosa_tpu.ops import bitplane as bp
+    from pilosa_tpu.parallel import mesh as pmesh
+
+    monkeypatch.setenv("PILOSA_TPU_MESH_SHAPE", "2x2")
+    assert bp.mesh_device_count() == 4
+    # placement stays within the capped mesh
+    import jax
+
+    devs = jax.local_devices()[:4]
+    for s in range(8):
+        assert bp.home_device(s) == devs[s % 4]
+    monkeypatch.setenv("PILOSA_TPU_MESH_SHAPE", "1")
+    assert bp.mesh_device_count() == 1
+    # malformed values never silently disable sharding
+    monkeypatch.setenv("PILOSA_TPU_MESH_SHAPE", "bogus")
+    assert bp.mesh_device_count() == 8
+    monkeypatch.setenv("PILOSA_TPU_MESH_SHAPE", "x")
+    assert bp.mesh_device_count() == 8
