@@ -1,0 +1,30 @@
+"""pilosa_tpu — a TPU-native distributed bitmap index.
+
+A brand-new framework with the capabilities of Pilosa (reference:
+dingguitao/pilosa): a huge sparse boolean matrix sharded into 2^20-column
+"slices", queried through PQL (Bitmap/Union/Intersect/Difference/Count/
+TopN/Range + SetBit/ClearBit/attr writes) over an HTTP+protobuf API.
+
+Where the reference executes bitmap algebra with Go roaring containers and
+amd64 POPCNT assembly (reference: roaring/roaring.go, roaring/assembly_amd64.s),
+this framework keeps fragments as dense HBM-resident bit-planes and compiles
+the container ops (AND/OR/XOR/ANDNOT + popcount) to XLA, with Pallas kernels
+for the fused popcount reductions, and reduces across a TPU mesh with XLA
+collectives (Count -> psum, Union -> OR-reduce) instead of HTTP fan-in.
+
+Layer map (mirrors SURVEY.md §1):
+  ops/       bitmap kernel layer (bit-planes, Pallas kernels, roaring codec)
+  core/      Bitmap row type, Fragment, caches, View/Frame/Index/Holder, attrs
+  pql/       the PQL query language (lexer/parser/AST)
+  exec/      the distributed query executor (map/reduce)
+  parallel/  slice -> TPU-device sharding, mesh collectives
+  cluster/   topology: partitioning, jump-hash placement, membership, broadcast
+  net/       HTTP API handler, internal client, wire schema
+  cli/       command line: server/import/export/backup/restore/check/...
+"""
+
+__version__ = "0.1.0"
+
+from pilosa_tpu.ops.bitplane import SLICE_WIDTH
+
+__all__ = ["SLICE_WIDTH", "__version__"]

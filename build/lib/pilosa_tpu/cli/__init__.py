@@ -1,0 +1,11 @@
+"""Command line interface.
+
+Subcommand surface matches the reference CLI (reference: cmd/*.go +
+ctl/*.go): server, import, export, backup, restore, check, inspect,
+bench, sort, config, generate-config.  Run as ``python -m
+pilosa_tpu.cli <subcommand>``.
+"""
+
+from pilosa_tpu.cli.main import main
+
+__all__ = ["main"]

@@ -1,0 +1,398 @@
+"""Command logic for the CLI (reference: ctl/*.go, server/server.go).
+
+Each ``run_*`` takes the parsed argparse namespace.  Separated from the
+flag definitions the way the reference splits ``ctl/`` from ``cmd/``.
+"""
+
+from __future__ import annotations
+
+import csv
+import io
+import os
+import sys
+import time
+from datetime import datetime, timezone
+
+from pilosa_tpu import config as config_mod
+from pilosa_tpu.ops import roaring
+from pilosa_tpu.ops.bitplane import SLICE_WIDTH
+
+# reference: pilosa.go:107-108
+TIME_FORMAT = "%Y-%m-%dT%H:%M"
+
+
+class CommandError(RuntimeError):
+    pass
+
+
+def _client(host: str):
+    from pilosa_tpu.net.client import InternalClient
+
+    return InternalClient(host, timeout=60.0)
+
+
+def _out(args, attr="output_file"):
+    path = getattr(args, attr, "") or ""
+    if path:
+        return open(path, "wb")
+    return sys.stdout.buffer
+
+
+# ---------------------------------------------------------------------------
+# server (reference: server/server.go:49-203)
+# ---------------------------------------------------------------------------
+
+
+def build_server(cfg: config_mod.Config):
+    """Config -> wired Server (the reference's SetupServer)."""
+    from pilosa_tpu.cluster import broadcast as bc
+    from pilosa_tpu.cluster.topology import Cluster
+    from pilosa_tpu.net.server import Server
+    from pilosa_tpu.obs.stats import new_stats_client
+
+    # Kernel toggle consumed by ops/bitplane._use_pallas.
+    if not cfg.tpu.use_pallas:
+        os.environ["PILOSA_TPU_DISABLE_PALLAS"] = "1"
+    if cfg.tpu.mesh_shape:
+        os.environ["PILOSA_TPU_MESH_SHAPE"] = cfg.tpu.mesh_shape
+
+    # Logging: log-path file or stderr (reference: server/server.go:125-133).
+    if cfg.log_path:
+        log_file = open(os.path.expanduser(cfg.log_path), "a", buffering=1)
+
+        def logger(msg: str) -> None:
+            log_file.write(msg.rstrip() + "\n")
+    else:
+
+        def logger(msg: str) -> None:
+            print(msg, file=sys.stderr)
+
+    cluster = Cluster(
+        replica_n=cfg.cluster.replicas,
+        long_query_time=cfg.cluster.long_query_time,
+    )
+    for host in cfg.cluster.hosts:
+        cluster.add_node(host)
+
+    broadcaster = bc.NopBroadcaster()
+    receiver = bc.NopBroadcastReceiver()
+    if cfg.cluster.type == "http":
+        peers = [h for h in cfg.cluster.internal_hosts]
+        broadcaster = bc.HTTPBroadcaster(peers)
+        bind = cfg.host.split(":")[0] or "0.0.0.0"
+        receiver = bc.HTTPBroadcastReceiver(bind, cfg.cluster.internal_port)
+    elif cfg.cluster.type == "gossip":
+        from pilosa_tpu.cluster.gossip import GossipNodeSet
+
+        nodeset = GossipNodeSet(
+            host=cfg.host,
+            seed=cfg.cluster.gossip_seed,
+            logger=logger,
+        )
+        broadcaster = nodeset
+        receiver = nodeset
+        cluster.node_set = nodeset
+
+    return Server(
+        data_dir=os.path.expanduser(cfg.data_dir),
+        host=cfg.host,
+        cluster=cluster,
+        broadcaster=broadcaster,
+        broadcast_receiver=receiver,
+        anti_entropy_interval=cfg.anti_entropy_interval,
+        polling_interval=cfg.cluster.polling_interval,
+        max_writes_per_request=cfg.max_writes_per_request,
+        logger=logger,
+        stats=new_stats_client(cfg.metrics.service, cfg.metrics.host),
+    )
+
+
+def run_server(args) -> int:
+    overrides = {}
+    if args.data_dir:
+        overrides["data_dir"] = args.data_dir
+    if args.bind:
+        overrides["host"] = args.bind
+    cfg = config_mod.load(args.config or None, overrides=overrides)
+    server = build_server(cfg)
+    if args.dry_run:
+        print("dry-run: config ok", file=sys.stderr)
+        return 0
+    server.open()
+    print(f"listening on http://{server.host}", file=sys.stderr)
+    try:
+        while True:
+            time.sleep(3600)
+    finally:
+        server.close()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# import (reference: ctl/import.go:30-195)
+# ---------------------------------------------------------------------------
+
+
+def run_import(args) -> int:
+    client = _client(args.host)
+    for path in args.paths:
+        _import_path(client, args, path)
+    return 0
+
+
+# Native CSV fast path reads the file in blocks of this many bytes, so
+# memory stays bounded regardless of file size.
+_CSV_BLOCK = 64 << 20
+
+
+def _import_path(client, args, path: str) -> None:
+    if path == "-":
+        _import_reader(client, args, sys.stdin)
+        return
+    # Fast path: the native CSV parser handles plain "row,col" files,
+    # streamed block-by-block (split at the last newline); anything it
+    # can't parse (timestamps, quoting) falls back to Python csv.  A
+    # fallback after a partially imported file is safe: imports are
+    # idempotent bit-sets, so re-importing earlier records is a no-op.
+    if _import_native(client, args, path):
+        return
+    with open(path, newline="") as f:
+        _import_reader(client, args, f)
+
+
+def _import_native(client, args, path: str) -> bool:
+    from pilosa_tpu import native
+
+    if not native.available():
+        return False
+    with open(path, "rb") as fb:
+        carry = b""
+        while True:
+            block = fb.read(_CSV_BLOCK)
+            if not block:
+                break
+            block = carry + block
+            cut = block.rfind(b"\n") + 1
+            if cut == 0:
+                carry, block = b"", block  # no newline: final partial line
+            else:
+                carry, block = block[cut:], block[:cut]
+            if not _import_parsed_block(client, args, block):
+                return False
+        if carry and not _import_parsed_block(client, args, carry):
+            return False
+    return True
+
+
+def _import_parsed_block(client, args, block: bytes) -> bool:
+    from pilosa_tpu import native
+
+    if not block:
+        return True
+    parsed = native.parse_csv(block)
+    if parsed is None:
+        return False
+    rows, cols = parsed
+    # Chunk on the numpy arrays so at most buffer_size records are ever
+    # materialized as Python objects at once.
+    for lo in range(0, len(rows), args.buffer_size):
+        chunk = [
+            (int(r), int(c), 0)
+            for r, c in zip(rows[lo : lo + args.buffer_size],
+                            cols[lo : lo + args.buffer_size])
+        ]
+        _flush_bits(client, args, chunk)
+    return True
+
+
+def _import_reader(client, args, f) -> None:
+    buf: list[tuple[int, int, int]] = []
+    for rnum, record in enumerate(csv.reader(f), start=1):
+        if not record or record[0] == "":
+            continue
+        if len(record) < 2:
+            raise CommandError(f"bad column count on row {rnum}")
+        try:
+            row_id = int(record[0])
+        except ValueError:
+            raise CommandError(f"invalid row id on row {rnum}: {record[0]!r}")
+        try:
+            col_id = int(record[1])
+        except ValueError:
+            raise CommandError(f"invalid column id on row {rnum}: {record[1]!r}")
+        ts = 0
+        if len(record) > 2 and record[2]:
+            try:
+                dt = datetime.strptime(record[2], TIME_FORMAT)
+            except ValueError:
+                raise CommandError(
+                    f"invalid timestamp on row {rnum}: {record[2]!r}"
+                )
+            # wire carries unix nanoseconds (reference: ctl/import.go:157)
+            ts = int(dt.replace(tzinfo=timezone.utc).timestamp() * 1e9)
+        buf.append((row_id, col_id, ts))
+        if len(buf) >= args.buffer_size:
+            _flush_bits(client, args, buf)
+            buf.clear()
+    _flush_bits(client, args, buf)
+
+
+def _flush_bits(client, args, bits: list[tuple[int, int, int]]) -> None:
+    if not bits:
+        return
+    by_slice: dict[int, list] = {}
+    for b in bits:
+        by_slice.setdefault(b[1] // SLICE_WIDTH, []).append(b)
+    for slice_i in sorted(by_slice):
+        print(
+            f"importing slice: {slice_i}, n={len(by_slice[slice_i])}",
+            file=sys.stderr,
+        )
+        client.import_bits(args.index, args.frame, slice_i, by_slice[slice_i])
+
+
+# ---------------------------------------------------------------------------
+# export / backup / restore (reference: ctl/export.go, backup.go, restore.go)
+# ---------------------------------------------------------------------------
+
+
+def run_export(args) -> int:
+    client = _client(args.host)
+    w = _out(args)
+    try:
+        max_slices = client.max_slice_by_index()
+        for slice_i in range(max_slices.get(args.index, 0) + 1):
+            csv_text = client.export_csv(args.index, args.frame, args.view, slice_i)
+            w.write(csv_text.encode())
+    finally:
+        if w is not sys.stdout.buffer:
+            w.close()
+    return 0
+
+
+def run_backup(args) -> int:
+    client = _client(args.host)
+    w = _out(args)
+    try:
+        client.backup_to(w, args.index, args.frame, args.view)
+    finally:
+        if w is not sys.stdout.buffer:
+            w.close()
+    return 0
+
+
+def run_restore(args) -> int:
+    client = _client(args.host)
+    with open(args.input_file, "rb") as r:
+        client.restore_from(r, args.index, args.frame, args.view)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# check / inspect (reference: ctl/check.go:46-125, ctl/inspect.go)
+# ---------------------------------------------------------------------------
+
+
+def run_check(args) -> int:
+    """Offline consistency check of roaring data files; skips .cache and
+    .snapshotting files like the reference."""
+    ok = True
+    for path in args.paths:
+        if path.endswith(".cache") or path.endswith(".snapshotting"):
+            print(f"skipping: {path}", file=sys.stderr)
+            continue
+        with open(path, "rb") as f:
+            data = f.read()
+        try:
+            problems = roaring.check(data)
+        except roaring.CorruptError as e:
+            problems = [str(e)]
+        if problems:
+            ok = False
+            for p in problems:
+                print(f"{path}: {p}")
+        else:
+            print(f"{path}: ok", file=sys.stderr)
+    return 0 if ok else 1
+
+
+def run_inspect(args) -> int:
+    for path in args.paths:
+        with open(path, "rb") as f:
+            data = f.read()
+        bi = roaring.info(data)
+        print(f"{path}:")
+        print(f"  containers: {len(bi.containers)}")
+        print(f"  bits: {sum(c.n for c in bi.containers)}")
+        print(f"  ops: {bi.ops}")
+        for c in bi.containers:
+            print(f"  container key={c.key} type={c.type} n={c.n}")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# bench (reference: ctl/bench.go:52-102)
+# ---------------------------------------------------------------------------
+
+
+def run_bench(args) -> int:
+    import random
+
+    client = _client(args.host)
+    n = args.num
+    if n <= 0:
+        raise CommandError("--num must be > 0")
+    # Mirror of the reference's random set-bit workload
+    # (reference: ctl/bench.go:70-102): rowID in [0,1000), columnID in
+    # [0,100000).
+    t0 = time.monotonic()
+    batch = []
+    for _ in range(n):
+        row = random.randrange(1000)
+        col = random.randrange(100000)
+        batch.append(f'SetBit(frame="{args.frame}", rowID={row}, columnID={col})')
+        if len(batch) == 1000:
+            client.execute_query(args.index, "\n".join(batch))
+            batch.clear()
+    if batch:
+        client.execute_query(args.index, "\n".join(batch))
+    elapsed = time.monotonic() - t0
+    print(f"executed {n} operations in {elapsed:.3f}s ({n / elapsed:.0f} op/sec)")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# sort (reference: ctl/sort.go)
+# ---------------------------------------------------------------------------
+
+
+def run_sort(args) -> int:
+    if args.path == "-":
+        rows = list(csv.reader(sys.stdin))
+    else:
+        with open(args.path, newline="") as f:
+            rows = list(csv.reader(f))
+    rows = [r for r in rows if r and r[0] != ""]
+    try:
+        rows.sort(key=lambda r: (int(r[1]) // SLICE_WIDTH, int(r[0]), int(r[1])))
+    except (ValueError, IndexError) as e:
+        raise CommandError(f"bad csv row: {e}")
+    w = csv.writer(sys.stdout)
+    w.writerows(rows)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# config / generate-config (reference: ctl/config.go, generate_config.go)
+# ---------------------------------------------------------------------------
+
+
+def run_config(args) -> int:
+    cfg = config_mod.load(args.config or None)
+    sys.stdout.write(cfg.to_toml())
+    return 0
+
+
+def run_generate_config(args) -> int:
+    sys.stdout.write(config_mod.Config().to_toml())
+    return 0

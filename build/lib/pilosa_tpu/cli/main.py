@@ -1,0 +1,117 @@
+"""CLI entry point — argparse subcommands over ctl command logic.
+
+Flag names and defaults mirror the reference (reference: cmd/backup.go:
+44-49, cmd/bench.go:44-49, cmd/export.go:51-57, cmd/import.go:52-56,
+cmd/restore.go:45-50, cmd/root.go:65-67); command logic lives in
+pilosa_tpu/cli/ctl.py the way the reference splits cmd/ from ctl/.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from pilosa_tpu import __version__
+from pilosa_tpu.cli import ctl
+
+
+def _add_host(p: argparse.ArgumentParser) -> None:
+    p.add_argument(
+        "--host", default="localhost:10101", help="host:port of the server"
+    )
+
+
+def build_parser() -> argparse.ArgumentParser:
+    root = argparse.ArgumentParser(
+        prog="pilosa-tpu",
+        description="TPU-native distributed bitmap index",
+    )
+    root.add_argument("--version", action="version", version=__version__)
+    sub = root.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("server", help="run a node daemon")
+    p.add_argument("-c", "--config", default="", help="TOML config file")
+    p.add_argument("-d", "--data-dir", default=None, help="data directory")
+    p.add_argument("--bind", default=None, help="host:port to bind (overrides config host)")
+    p.add_argument("--dry-run", action="store_true", help="stop before serving")
+    p.set_defaults(fn=ctl.run_server)
+
+    p = sub.add_parser("import", help="bulk-import CSV bits (row,col[,ts])")
+    _add_host(p)
+    p.add_argument("-i", "--index", required=True)
+    p.add_argument("-f", "--frame", required=True)
+    p.add_argument(
+        "-s", "--buffer-size", type=int, default=10_000_000,
+        help="bits to buffer/sort before importing",
+    )
+    p.add_argument("paths", nargs="+", help="CSV files ('-' = stdin)")
+    p.set_defaults(fn=ctl.run_import)
+
+    p = sub.add_parser("export", help="export a frame as CSV")
+    _add_host(p)
+    p.add_argument("-i", "--index", required=True)
+    p.add_argument("-f", "--frame", required=True)
+    p.add_argument("-v", "--view", default="standard")
+    p.add_argument("-o", "--output-file", default="", help="default stdout")
+    p.set_defaults(fn=ctl.run_export)
+
+    p = sub.add_parser("backup", help="backup a view to a tar archive")
+    _add_host(p)
+    p.add_argument("-i", "--index", required=True)
+    p.add_argument("-f", "--frame", required=True)
+    p.add_argument("-v", "--view", default="standard")
+    p.add_argument("-o", "--output-file", default="", help="default stdout")
+    p.set_defaults(fn=ctl.run_backup)
+
+    p = sub.add_parser("restore", help="restore a view from a tar archive")
+    _add_host(p)
+    p.add_argument("-i", "--index", required=True)
+    p.add_argument("-f", "--frame", required=True)
+    p.add_argument("-v", "--view", default="standard")
+    p.add_argument("-d", "--input-file", required=True)
+    p.set_defaults(fn=ctl.run_restore)
+
+    p = sub.add_parser("check", help="offline consistency check of data files")
+    p.add_argument("paths", nargs="+")
+    p.set_defaults(fn=ctl.run_check)
+
+    p = sub.add_parser("inspect", help="dump container stats of a data file")
+    p.add_argument("paths", nargs="+")
+    p.set_defaults(fn=ctl.run_inspect)
+
+    p = sub.add_parser("bench", help="benchmark operations against a server")
+    _add_host(p)
+    p.add_argument("-i", "--index", required=True)
+    p.add_argument("-f", "--frame", required=True)
+    p.add_argument(
+        "-o", "--operation", default="set-bit", choices=["set-bit"],
+    )
+    p.add_argument("-n", "--num", type=int, default=0, help="operations to run")
+    p.set_defaults(fn=ctl.run_bench)
+
+    p = sub.add_parser("sort", help="sort a CSV file by slice for import")
+    p.add_argument("path", help="CSV file ('-' = stdin)")
+    p.set_defaults(fn=ctl.run_sort)
+
+    p = sub.add_parser("config", help="validate and print a config file")
+    p.add_argument("-c", "--config", default="", help="TOML config file")
+    p.set_defaults(fn=ctl.run_config)
+
+    p = sub.add_parser("generate-config", help="print the default config")
+    p.set_defaults(fn=ctl.run_generate_config)
+
+    return root
+
+
+def main(argv: list[str] | None = None) -> int:
+    from pilosa_tpu.config import ConfigError
+    from pilosa_tpu.net.client import ClientError
+
+    args = build_parser().parse_args(argv)
+    try:
+        return args.fn(args) or 0
+    except (ctl.CommandError, ConfigError, ClientError, OSError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    except KeyboardInterrupt:
+        return 130

@@ -1,0 +1,22 @@
+"""Cluster layer: topology, membership, broadcast.
+
+reference: cluster.go, broadcast.go, gossip/, httpbroadcast/
+"""
+
+from pilosa_tpu.cluster.topology import (
+    DEFAULT_PARTITION_N,
+    DEFAULT_REPLICA_N,
+    Cluster,
+    Node,
+    fnv64a,
+    jump_hash,
+)
+
+__all__ = [
+    "Cluster",
+    "Node",
+    "fnv64a",
+    "jump_hash",
+    "DEFAULT_PARTITION_N",
+    "DEFAULT_REPLICA_N",
+]

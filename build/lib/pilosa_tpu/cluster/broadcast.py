@@ -1,0 +1,262 @@
+"""Cluster messaging — schema broadcast + membership abstractions.
+
+The control plane carries five schema messages between nodes so every
+node can route queries for indexes/frames it has never written
+(reference: broadcast.go:26-166):
+
+  CreateSliceMessage  — a view grew a new max slice
+  CreateIndexMessage / DeleteIndexMessage
+  CreateFrameMessage / DeleteFrameMessage
+
+Messages travel as a 1-byte type tag + protobuf payload.  Three
+transports, selected by ``cluster.type`` config:
+
+  static — no-op broadcaster, fixed node list (single node / tests)
+  http   — POST the envelope to every peer's internal listener
+           (reference: httpbroadcast/)
+  gossip — UDP gossip membership + TCP sync broadcast
+           (reference: gossip/ on hashicorp/memberlist); see
+           cluster/gossip.py
+"""
+
+from __future__ import annotations
+
+from typing import Protocol
+
+from pilosa_tpu.net import wire_pb2 as wire
+
+# Message type bytes (reference: broadcast.go:109-124)
+MSG_CREATE_SLICE = 1
+MSG_CREATE_INDEX = 2
+MSG_DELETE_INDEX = 3
+MSG_CREATE_FRAME = 4
+MSG_DELETE_FRAME = 5
+
+_TYPE_OF = {
+    wire.CreateSliceMessage: MSG_CREATE_SLICE,
+    wire.CreateIndexMessage: MSG_CREATE_INDEX,
+    wire.DeleteIndexMessage: MSG_DELETE_INDEX,
+    wire.CreateFrameMessage: MSG_CREATE_FRAME,
+    wire.DeleteFrameMessage: MSG_DELETE_FRAME,
+}
+
+_CLASS_OF = {v: k for k, v in _TYPE_OF.items()}
+
+
+def marshal_message(msg) -> bytes:
+    """type byte + protobuf payload (reference: broadcast.go:126-146)."""
+    typ = _TYPE_OF.get(type(msg))
+    if typ is None:
+        raise ValueError(f"message type not implemented: {type(msg).__name__}")
+    return bytes([typ]) + msg.SerializeToString()
+
+
+def unmarshal_message(data: bytes):
+    """reference: broadcast.go:148-166"""
+    if not data:
+        raise ValueError("empty message")
+    cls = _CLASS_OF.get(data[0])
+    if cls is None:
+        raise ValueError(f"invalid message type: {data[0]}")
+    msg = cls()
+    msg.ParseFromString(data[1:])
+    return msg
+
+
+class Broadcaster(Protocol):
+    """reference: broadcast.go:61-64"""
+
+    def send_sync(self, msg) -> None: ...
+    def send_async(self, msg) -> None: ...
+
+
+class BroadcastHandler(Protocol):
+    """Implemented by Server (reference: broadcast.go:87-89)."""
+
+    def receive_message(self, msg) -> None: ...
+
+
+class BroadcastReceiver(Protocol):
+    """reference: broadcast.go:96-100"""
+
+    def start(self, handler: BroadcastHandler) -> None: ...
+
+
+class NodeSet(Protocol):
+    """Membership view (reference: broadcast.go:26-32)."""
+
+    def nodes(self) -> list[str]: ...
+    def open(self) -> None: ...
+
+
+# ---------------------------------------------------------------------------
+# static (single node / tests) — reference: broadcast.go:34-58,70-107
+# ---------------------------------------------------------------------------
+
+
+class NopBroadcaster:
+    def send_sync(self, msg) -> None:
+        pass
+
+    def send_async(self, msg) -> None:
+        pass
+
+
+class NopBroadcastReceiver:
+    def start(self, handler) -> None:
+        pass
+
+
+class StaticNodeSet:
+    """Fixed host list from config."""
+
+    def __init__(self, hosts: list[str] | None = None):
+        self._hosts = list(hosts or [])
+
+    def nodes(self) -> list[str]:
+        return list(self._hosts)
+
+    def open(self) -> None:
+        pass
+
+    def join(self, hosts: list[str]) -> None:
+        for h in hosts:
+            if h not in self._hosts:
+                self._hosts.append(h)
+
+
+# ---------------------------------------------------------------------------
+# http broadcast — reference: httpbroadcast/messenger.go
+# ---------------------------------------------------------------------------
+
+
+class HTTPBroadcaster:
+    """POST the message envelope to every peer's internal endpoint
+    (reference: httpbroadcast/messenger.go:43-122).  Peers run an
+    HTTPBroadcastReceiver on ``internal_host``."""
+
+    def __init__(self, internal_hosts: list[str], timeout: float = 10.0):
+        self.internal_hosts = list(internal_hosts)
+        self.timeout = timeout
+
+    def _post(self, host: str, payload: bytes) -> None:
+        import http.client
+
+        conn = http.client.HTTPConnection(host, timeout=self.timeout)
+        try:
+            conn.request(
+                "POST",
+                "/messages",
+                body=payload,
+                headers={"Content-Type": "application/octet-stream"},
+            )
+            resp = conn.getresponse()
+            resp.read()
+            if resp.status >= 400:
+                raise RuntimeError(f"broadcast to {host}: http {resp.status}")
+        finally:
+            conn.close()
+
+    def send_sync(self, msg) -> None:
+        payload = marshal_message(msg)
+        errors = []
+        for host in self.internal_hosts:
+            try:
+                self._post(host, payload)
+            except Exception as e:  # noqa: BLE001 — collect per-peer errors
+                errors.append(f"{host}: {e}")
+        if errors:
+            raise RuntimeError("; ".join(errors))
+
+    def send_async(self, msg) -> None:
+        import threading
+
+        payload = marshal_message(msg)
+        for host in self.internal_hosts:
+            threading.Thread(
+                target=lambda h=host: self._safe_post(h, payload), daemon=True
+            ).start()
+
+    def _safe_post(self, host: str, payload: bytes) -> None:
+        try:
+            self._post(host, payload)
+        except Exception:  # noqa: BLE001 — async is best-effort
+            pass
+
+
+class HTTPBroadcastReceiver:
+    """Second HTTP listener for inter-node messages (reference:
+    httpbroadcast/messenger.go:139-175; default internal port 14000)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, logger=None):
+        self.host = host
+        self.port = port
+        self.logger = logger or (lambda m: None)
+        self._server = None
+        self._thread = None
+
+    @property
+    def bound_host(self) -> str:
+        if self._server is None:
+            return f"{self.host}:{self.port}"
+        addr = self._server.server_address
+        return f"{addr[0]}:{addr[1]}"
+
+    def start(self, handler) -> None:
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+        import threading
+
+        logger = self.logger
+
+        class _Receiver(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def do_POST(self):
+                if self.path != "/messages":
+                    self.send_error(404)
+                    return
+                length = int(self.headers.get("Content-Length") or 0)
+                data = self.rfile.read(length)
+                try:
+                    msg = unmarshal_message(data)
+                    handler.receive_message(msg)
+                except Exception as e:  # noqa: BLE001 — peer boundary
+                    logger(f"receive message error: {e}")
+                    self.send_error(500)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            def log_message(self, fmt, *args):
+                pass
+
+        self._server = ThreadingHTTPServer((self.host, self.port), _Receiver)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+
+
+class HTTPNodeSet:
+    """Static membership for the http cluster type (reference:
+    httpbroadcast/messenger.go:177-201)."""
+
+    def __init__(self, hosts: list[str] | None = None):
+        self._hosts = list(hosts or [])
+
+    def nodes(self) -> list[str]:
+        return list(self._hosts)
+
+    def open(self) -> None:
+        pass
+
+    def join(self, hosts: list[str]) -> None:
+        for h in hosts:
+            if h not in self._hosts:
+                self._hosts.append(h)

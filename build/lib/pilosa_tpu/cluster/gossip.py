@@ -1,0 +1,348 @@
+"""Gossip membership — UDP gossip NodeSet + broadcaster.
+
+The counterpart of the reference's memberlist-based gossip backend
+(reference: gossip/gossip.go:31-222 on hashicorp/memberlist), built on a
+small UDP protocol instead of an external library:
+
+  JOIN      → seed replies JOIN-ACK with its member list
+  PING      → periodic probe to a random member, piggybacking the local
+              member list and (optionally) serialized node state; the
+              receiver merges both and replies ACK
+  USER      → application messages (the 5 schema broadcast messages,
+              type-byte envelope from cluster/broadcast.py)
+
+send_sync delivers a USER datagram to every live member (reference:
+SendSync via errgroup TCP, gossip.go:124-149); send_async sends to
+``gossip_fanout`` random members and relies on periodic exchange for
+convergence (reference: TransmitLimitedQueue, gossip.go:152-164).
+Liveness: members not heard from within ``suspect_after`` are marked
+DOWN (reference surface: memberlist NotifyLeave → node state DOWN,
+cluster.go:161-173).
+
+State sync piggybacks a ``state_provider()`` blob on PING/ACK and feeds
+received blobs to ``state_merger(blob)`` — the server wires these to
+LocalStatus/HandleRemoteStatus so schemas replicate like the
+reference's LocalState/MergeRemoteState (reference: gossip.go:191-222,
+server.go:382-412).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import random
+import socket
+import threading
+import time
+
+
+def gossip_port_for(host: str, offset: int = 1000) -> int:
+    """Default gossip port: HTTP port + offset."""
+    _, _, port = host.partition(":")
+    return int(port or 10101) + offset
+
+
+class GossipNodeSet:
+    """NodeSet + Broadcaster + BroadcastReceiver in one object, like the
+    reference's GossipNodeSet (reference: gossip/gossip.go:31-45)."""
+
+    def __init__(
+        self,
+        host: str,
+        bind: str = "",
+        seed: str = "",
+        gossip_interval: float = 1.0,
+        suspect_after: float = 5.0,
+        gossip_fanout: int = 3,
+        state_provider=None,
+        state_merger=None,
+        logger=None,
+    ):
+        self.host = host  # the node's HTTP host:port (cluster identity)
+        if bind:
+            addr, _, port = bind.partition(":")
+            self.bind = (addr or "0.0.0.0", int(port))
+        else:
+            # Listen on all interfaces; peers must be able to reach us
+            # cross-machine.
+            self.bind = ("0.0.0.0", gossip_port_for(host))
+        # Address advertised in join/ping envelopes: the node's public
+        # hostname (from its HTTP identity) + the gossip port — never
+        # the wildcard/loopback bind address.
+        adv_host = host.partition(":")[0] or "127.0.0.1"
+        self.advertise = (adv_host, self.bind[1])
+        self.seed = seed  # seed gossip addr "a.b.c.d:port"
+        self.gossip_interval = gossip_interval
+        self.suspect_after = suspect_after
+        self.gossip_fanout = gossip_fanout
+        self.state_provider = state_provider
+        self.state_merger = state_merger
+        self.logger = logger or (lambda m: None)
+
+        self._handler = None  # BroadcastHandler (the server)
+        self._sock: socket.socket | None = None
+        self._closing = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._mu = threading.Lock()
+        # member -> {addr: (ip, port), last_seen: float, state: UP|DOWN}
+        self._members: dict[str, dict] = {}
+        self.on_membership_change = None  # callback(list[(host, state)])
+
+    # ------------------------------------------------------------------
+    # NodeSet
+    # ------------------------------------------------------------------
+
+    def nodes(self) -> list[str]:
+        """Live members only — presence here means UP (the
+        broadcast.NodeSet contract consumed by Cluster.node_states)."""
+        with self._mu:
+            return sorted(h for h, m in self._members.items() if m["state"] == "UP")
+
+    def member_states(self) -> dict[str, str]:
+        with self._mu:
+            return {h: m["state"] for h, m in self._members.items()}
+
+    def open(self) -> None:
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.bind(self.bind)
+        self._sock.settimeout(0.2)
+        self.advertise = (self.advertise[0], self.bind[1])
+        self._register(self.host, self.advertise)
+        for name, fn in (("gossip-rx", self._rx_loop), ("gossip-tick", self._tick_loop)):
+            t = threading.Thread(target=fn, daemon=True, name=f"{name}:{self.host}")
+            t.start()
+            self._threads.append(t)
+        if self.seed:
+            self._send(
+                _parse_addr(self.seed),
+                {"t": "join", "from": self.host, "gaddr": _fmt_addr(self.advertise)},
+            )
+
+    def close(self) -> None:
+        self._closing.set()
+        if self._sock is not None:
+            self._sock.close()
+
+    # ------------------------------------------------------------------
+    # Broadcaster
+    # ------------------------------------------------------------------
+
+    def send_sync(self, msg) -> None:
+        from pilosa_tpu.cluster.broadcast import marshal_message
+
+        payload = base64.b64encode(marshal_message(msg)).decode()
+        errors = []
+        for host, member in self._snapshot().items():
+            if host == self.host or member["state"] != "UP":
+                continue
+            try:
+                self._send(
+                    member["addr"], {"t": "user", "from": self.host, "p": payload}
+                )
+            except OSError as e:
+                errors.append(f"{host}: {e}")
+        if errors:
+            raise RuntimeError("; ".join(errors))
+
+    def send_async(self, msg) -> None:
+        from pilosa_tpu.cluster.broadcast import marshal_message
+
+        payload = base64.b64encode(marshal_message(msg)).decode()
+        peers = [
+            m
+            for h, m in self._snapshot().items()
+            if h != self.host and m["state"] == "UP"
+        ]
+        random.shuffle(peers)
+        for member in peers[: self.gossip_fanout]:
+            try:
+                self._send(
+                    member["addr"], {"t": "user", "from": self.host, "p": payload}
+                )
+            except OSError:
+                pass
+
+    # ------------------------------------------------------------------
+    # BroadcastReceiver
+    # ------------------------------------------------------------------
+
+    def start(self, handler) -> None:
+        self._handler = handler
+
+    # ------------------------------------------------------------------
+    # protocol internals
+    # ------------------------------------------------------------------
+
+    def _snapshot(self) -> dict[str, dict]:
+        with self._mu:
+            return {h: dict(m) for h, m in self._members.items()}
+
+    def _register(self, host: str, addr) -> None:
+        changed = False
+        with self._mu:
+            m = self._members.get(host)
+            if m is None:
+                self._members[host] = {
+                    "addr": tuple(addr),
+                    "last_seen": time.monotonic(),
+                    "state": "UP",
+                }
+                changed = True
+            else:
+                m["addr"] = tuple(addr)
+                m["last_seen"] = time.monotonic()
+                if m["state"] != "UP":
+                    m["state"] = "UP"
+                    changed = True
+        if changed:
+            self._notify()
+
+    def _notify(self) -> None:
+        if self.on_membership_change is not None:
+            states = self.member_states()
+            try:
+                self.on_membership_change(sorted(states.items()))
+            except Exception as e:  # noqa: BLE001
+                self.logger(f"membership callback error: {e}")
+
+    def _send(self, addr, obj: dict) -> None:
+        if self._sock is not None:
+            self._sock.sendto(json.dumps(obj).encode(), tuple(addr))
+
+    def _member_list(self) -> list[dict]:
+        return [
+            {"host": h, "gaddr": _fmt_addr(m["addr"]), "state": m["state"]}
+            for h, m in self._snapshot().items()
+        ]
+
+    def _merge_members(self, members: list[dict]) -> None:
+        """Adopt third-party liveness reports: a peer vouching UP for a
+        member refreshes its last_seen, so liveness scales with cluster
+        size instead of requiring direct contact with every node each
+        suspect window (memberlist-style indirect confirmation)."""
+        for m in members:
+            if m.get("state") == "UP" and m["host"] != self.host:
+                self._register(m["host"], _parse_addr(m["gaddr"]))
+
+    def _rx_loop(self) -> None:
+        while not self._closing.is_set():
+            try:
+                data, addr = self._sock.recvfrom(65536)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                obj = json.loads(data)
+            except json.JSONDecodeError:
+                continue
+            try:
+                self._handle(obj, addr)
+            except Exception as e:  # noqa: BLE001 — peer boundary
+                self.logger(f"gossip rx error: {e}")
+
+    def _handle(self, obj: dict, addr) -> None:
+        typ = obj.get("t")
+        sender = obj.get("from", "")
+        if typ == "join":
+            self._register(sender, _parse_addr(obj["gaddr"]))
+            self._send(
+                _parse_addr(obj["gaddr"]),
+                {
+                    "t": "join-ack",
+                    "from": self.host,
+                    "members": self._member_list(),
+                },
+            )
+        elif typ == "join-ack":
+            self._merge_members(obj.get("members", []))
+        elif typ == "ping":
+            self._register(sender, _parse_addr(obj["gaddr"]))
+            self._merge_members(obj.get("members", []))
+            self._merge_state(obj)
+            self._send(
+                _parse_addr(obj["gaddr"]),
+                {
+                    "t": "ack",
+                    "from": self.host,
+                    "gaddr": _fmt_addr(self.advertise),
+                    "members": self._member_list(),
+                    **self._state_field(),
+                },
+            )
+        elif typ == "ack":
+            self._register(sender, _parse_addr(obj["gaddr"]))
+            self._merge_members(obj.get("members", []))
+            self._merge_state(obj)
+        elif typ == "user":
+            if self._handler is not None:
+                from pilosa_tpu.cluster.broadcast import unmarshal_message
+
+                msg = unmarshal_message(base64.b64decode(obj["p"]))
+                self._handler.receive_message(msg)
+
+    def _state_field(self) -> dict:
+        if self.state_provider is None:
+            return {}
+        try:
+            blob = self.state_provider()
+        except Exception as e:  # noqa: BLE001
+            self.logger(f"state provider error: {e}")
+            return {}
+        if not blob:
+            return {}
+        return {"state_blob": base64.b64encode(blob).decode()}
+
+    def _merge_state(self, obj: dict) -> None:
+        blob = obj.get("state_blob")
+        if blob and self.state_merger is not None:
+            try:
+                self.state_merger(base64.b64decode(blob))
+            except Exception as e:  # noqa: BLE001
+                self.logger(f"state merge error: {e}")
+
+    def _tick_loop(self) -> None:
+        while not self._closing.wait(self.gossip_interval):
+            # probe a random live peer
+            peers = [
+                (h, m)
+                for h, m in self._snapshot().items()
+                if h != self.host
+            ]
+            if peers:
+                host, member = random.choice(peers)
+                try:
+                    self._send(
+                        member["addr"],
+                        {
+                            "t": "ping",
+                            "from": self.host,
+                            "gaddr": _fmt_addr(self.advertise),
+                            "members": self._member_list(),
+                            **self._state_field(),
+                        },
+                    )
+                except OSError:
+                    pass
+            # suspect timeouts
+            now = time.monotonic()
+            changed = False
+            with self._mu:
+                for h, m in self._members.items():
+                    if h == self.host:
+                        m["last_seen"] = now
+                        continue
+                    if m["state"] == "UP" and now - m["last_seen"] > self.suspect_after:
+                        m["state"] = "DOWN"
+                        changed = True
+            if changed:
+                self._notify()
+
+
+def _parse_addr(s: str) -> tuple[str, int]:
+    addr, _, port = s.partition(":")
+    return (addr or "127.0.0.1", int(port))
+
+
+def _fmt_addr(addr) -> str:
+    return f"{addr[0]}:{addr[1]}"

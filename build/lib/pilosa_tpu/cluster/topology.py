@@ -1,0 +1,178 @@
+"""Cluster topology: slice -> partition -> node placement.
+
+Placement must be *hash-identical* to the reference so that data laid out
+by one implementation is found by the other (reference: cluster.go:200-281):
+
+* ``partition(index, slice) = fnv64a(index || slice_be8) % PartitionN``
+* primary node = jump consistent hash (Lamping-Veach) of the partition id
+  over the node list; replicas are the next ``ReplicaN-1`` nodes around
+  the ring.
+
+In the TPU-native design the same function also places slices onto
+*devices within a node*: a node owns a set of slices, and those slices are
+sharded round-robin over the local TPU mesh (see
+:mod:`pilosa_tpu.parallel.mesh`), so the cluster-level map stays
+compatible while intra-node reduces ride ICI collectives.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+# reference: cluster.go:22-31
+DEFAULT_PARTITION_N = 256
+DEFAULT_REPLICA_N = 1
+
+# reference: cluster.go:33-37
+NODE_STATE_UP = "UP"
+NODE_STATE_DOWN = "DOWN"
+
+_FNV64_OFFSET = 0xCBF29CE484222325
+_FNV64_PRIME = 0x100000001B3
+_MASK64 = (1 << 64) - 1
+
+
+def fnv64a(data: bytes) -> int:
+    """64-bit FNV-1a (stdlib-free, matches Go's hash/fnv)."""
+    h = _FNV64_OFFSET
+    for b in data:
+        h ^= b
+        h = (h * _FNV64_PRIME) & _MASK64
+    return h
+
+
+def jump_hash(key: int, n: int) -> int:
+    """Jump consistent hash (Lamping & Veach 2014) — maps ``key`` to a
+    bucket in [0, n).  Same constants as the reference's jmphasher
+    (reference: cluster.go:268-281)."""
+    b, j = -1, 0
+    key &= _MASK64
+    while j < n:
+        b = j
+        key = (key * 2862933555777941757 + 1) & _MASK64
+        j = int(float(b + 1) * (float(1 << 31) / float((key >> 33) + 1)))
+    return b
+
+
+@dataclass
+class Node:
+    """One cluster member (reference: cluster.go:40-45)."""
+
+    host: str
+    internal_host: str = ""
+    state: str = NODE_STATE_DOWN
+
+    def set_state(self, s: str) -> None:
+        self.state = s
+
+    def to_dict(self) -> dict:
+        return {"host": self.host, "internalHost": self.internal_host}
+
+
+class Cluster:
+    """Node list + placement functions (reference: cluster.go:122-258)."""
+
+    def __init__(
+        self,
+        nodes: list[Node] | None = None,
+        partition_n: int = DEFAULT_PARTITION_N,
+        replica_n: int = DEFAULT_REPLICA_N,
+        long_query_time: float = 0.0,
+    ):
+        self.nodes: list[Node] = nodes or []
+        self.partition_n = partition_n
+        self.replica_n = replica_n
+        self.long_query_time = long_query_time
+        self.node_set = None  # membership backend; wired by the server
+        self._mu = threading.Lock()
+
+    # --- membership -----------------------------------------------------
+
+    def node_by_host(self, host: str) -> Node | None:
+        for n in self.nodes:
+            if n.host == host:
+                return n
+        return None
+
+    def add_node(self, host: str) -> Node:
+        """Idempotently register a host, keeping the list sorted so every
+        member computes the same ring (reference: cluster.go:176-187)."""
+        with self._mu:
+            n = self.node_by_host(host)
+            if n is not None:
+                return n
+            n = Node(host=host)
+            self.nodes.append(n)
+            self.nodes.sort(key=lambda x: x.host)
+            return n
+
+    def node_states(self) -> dict[str, str]:
+        """Merge node states from the membership backend: a node is UP iff
+        the NodeSet currently sees it (reference: cluster.go:149-173)."""
+        up = set()
+        if self.node_set is not None:
+            # NodeSet.nodes() yields host strings (broadcast.NodeSet
+            # protocol); tolerate Node objects too.
+            for n in self.node_set.nodes():
+                up.add(n if isinstance(n, str) else n.host)
+        out = {}
+        for n in self.nodes:
+            n.state = NODE_STATE_UP if n.host in up else NODE_STATE_DOWN
+            out[n.host] = n.state
+        return out
+
+    def hosts(self) -> list[str]:
+        return [n.host for n in self.nodes]
+
+    # --- placement (reference: cluster.go:200-258) ----------------------
+
+    def partition(self, index: str, slice_i: int) -> int:
+        data = index.encode() + slice_i.to_bytes(8, "big")
+        return fnv64a(data) % self.partition_n
+
+    def partition_nodes(self, partition_id: int) -> list[Node]:
+        replica_n = self.replica_n
+        if replica_n > len(self.nodes):
+            replica_n = len(self.nodes)
+        elif replica_n == 0:
+            replica_n = 1
+        node_index = jump_hash(partition_id, len(self.nodes))
+        return [
+            self.nodes[(node_index + i) % len(self.nodes)] for i in range(replica_n)
+        ]
+
+    def fragment_nodes(self, index: str, slice_i: int) -> list[Node]:
+        return self.partition_nodes(self.partition(index, slice_i))
+
+    def owns_fragment(self, host: str, index: str, slice_i: int) -> bool:
+        return any(n.host == host for n in self.fragment_nodes(index, slice_i))
+
+    def owns_slices(self, index: str, max_slice: int, host: str) -> list[int]:
+        """Slices whose *primary* owner is ``host`` (reference:
+        cluster.go:246-258)."""
+        out = []
+        for i in range(max_slice + 1):
+            p = self.partition(index, i)
+            node_index = jump_hash(p, len(self.nodes))
+            if self.nodes[node_index].host == host:
+                out.append(i)
+        return out
+
+    def status_dict(self) -> dict:
+        self.node_states()
+        return {
+            "nodes": [
+                {"host": n.host, "internalHost": n.internal_host, "state": n.state}
+                for n in self.nodes
+            ]
+        }
+
+
+def new_cluster(n: int) -> Cluster:
+    """Test helper mirroring the reference's fixture: n fake ``host%d:0``
+    nodes (reference: cluster_test.go:146-176)."""
+    c = Cluster()
+    for i in range(n):
+        c.nodes.append(Node(host=f"host{i}:0"))
+    return c

@@ -1,0 +1,244 @@
+"""Configuration — TOML file + environment + flag layering.
+
+Schema matches the reference's TOML config (reference: config.go:19-90):
+data-dir, host, cluster{replicas, type, hosts, internal-hosts,
+polling-interval, internal-port, long-query-time}, anti-entropy.interval,
+max-writes-per-request, log-path, metrics{service, host}, plus TPU-mesh
+settings that are new here.  Precedence is flag > env (PILOSA_*) > file >
+default (reference: cmd/root.go:85-150), and unknown keys in the file
+are rejected (reference: cmd/root.go:113-118).
+"""
+
+from __future__ import annotations
+
+import os
+import tomllib
+from dataclasses import dataclass, field
+
+# reference: server.go:33-36, config.go:19-58
+DEFAULT_HOST = "localhost:10101"
+DEFAULT_INTERNAL_PORT = 14000
+DEFAULT_DATA_DIR = "~/.pilosa_tpu"
+DEFAULT_ANTI_ENTROPY_INTERVAL = 600
+DEFAULT_POLLING_INTERVAL = 60
+DEFAULT_MAX_WRITES = 5000
+
+CLUSTER_TYPES = ("static", "http", "gossip")
+
+_KNOWN_KEYS = {
+    "data-dir",
+    "host",
+    "log-path",
+    "max-writes-per-request",
+    "cluster",
+    "cluster.replicas",
+    "cluster.type",
+    "cluster.hosts",
+    "cluster.internal-hosts",
+    "cluster.polling-interval",
+    "cluster.internal-port",
+    "cluster.gossip-seed",
+    "cluster.long-query-time",
+    "anti-entropy",
+    "anti-entropy.interval",
+    "metrics",
+    "metrics.service",
+    "metrics.host",
+    "tpu",
+    "tpu.mesh-shape",
+    "tpu.use-pallas",
+}
+
+
+class ConfigError(ValueError):
+    pass
+
+
+@dataclass
+class ClusterConfig:
+    replicas: int = 1
+    type: str = "static"
+    hosts: list[str] = field(default_factory=list)
+    internal_hosts: list[str] = field(default_factory=list)
+    polling_interval: int = DEFAULT_POLLING_INTERVAL
+    internal_port: int = DEFAULT_INTERNAL_PORT
+    gossip_seed: str = ""
+    long_query_time: float = 0.0
+
+
+@dataclass
+class MetricsConfig:
+    service: str = "nop"  # nop | expvar | statsd
+    host: str = ""
+
+
+@dataclass
+class TPUConfig:
+    """TPU-native additions (no reference counterpart)."""
+
+    mesh_shape: str = ""  # e.g. "8" or "4x2"; empty = all local devices
+    use_pallas: bool = True
+
+
+@dataclass
+class Config:
+    data_dir: str = DEFAULT_DATA_DIR
+    host: str = DEFAULT_HOST
+    log_path: str = ""
+    max_writes_per_request: int = DEFAULT_MAX_WRITES
+    anti_entropy_interval: int = DEFAULT_ANTI_ENTROPY_INTERVAL
+    cluster: ClusterConfig = field(default_factory=ClusterConfig)
+    metrics: MetricsConfig = field(default_factory=MetricsConfig)
+    tpu: TPUConfig = field(default_factory=TPUConfig)
+
+    def validate(self) -> None:
+        if self.cluster.type not in CLUSTER_TYPES:
+            raise ConfigError(f"invalid cluster type: {self.cluster.type!r}")
+        if self.cluster.replicas < 1:
+            raise ConfigError("cluster replicas must be >= 1")
+
+    def to_toml(self) -> str:
+        """Canonical TOML rendering (generate-config parity,
+        reference: ctl/generate_config.go)."""
+        lines = [
+            f'data-dir = "{self.data_dir}"',
+            f'host = "{self.host}"',
+            f'log-path = "{self.log_path}"',
+            f"max-writes-per-request = {self.max_writes_per_request}",
+            "",
+            "[cluster]",
+            f"  replicas = {self.cluster.replicas}",
+            f'  type = "{self.cluster.type}"',
+            f"  hosts = {_toml_list(self.cluster.hosts)}",
+            f"  internal-hosts = {_toml_list(self.cluster.internal_hosts)}",
+            f"  polling-interval = {self.cluster.polling_interval}",
+            f"  internal-port = {self.cluster.internal_port}",
+            f'  gossip-seed = "{self.cluster.gossip_seed}"',
+            f"  long-query-time = {self.cluster.long_query_time}",
+            "",
+            "[anti-entropy]",
+            f"  interval = {self.anti_entropy_interval}",
+            "",
+            "[metrics]",
+            f'  service = "{self.metrics.service}"',
+            f'  host = "{self.metrics.host}"',
+            "",
+            "[tpu]",
+            f'  mesh-shape = "{self.tpu.mesh_shape}"',
+            f"  use-pallas = {str(self.tpu.use_pallas).lower()}",
+        ]
+        return "\n".join(lines) + "\n"
+
+
+def _toml_list(items: list[str]) -> str:
+    return "[" + ", ".join(f'"{i}"' for i in items) + "]"
+
+
+def _reject_unknown(doc: dict, prefix: str = "") -> None:
+    for key, value in doc.items():
+        dotted = f"{prefix}{key}"
+        if dotted not in _KNOWN_KEYS:
+            raise ConfigError(f"unknown config key: {dotted!r}")
+        if isinstance(value, dict):
+            _reject_unknown(value, prefix=dotted + ".")
+
+
+def from_toml(text: str) -> Config:
+    try:
+        doc = tomllib.loads(text)
+    except tomllib.TOMLDecodeError as e:
+        raise ConfigError(str(e)) from e
+    _reject_unknown(doc)
+    cfg = Config()
+    cfg.data_dir = doc.get("data-dir", cfg.data_dir)
+    cfg.host = doc.get("host", cfg.host)
+    cfg.log_path = doc.get("log-path", cfg.log_path)
+    cfg.max_writes_per_request = doc.get(
+        "max-writes-per-request", cfg.max_writes_per_request
+    )
+    cl = doc.get("cluster", {})
+    cfg.cluster.replicas = cl.get("replicas", cfg.cluster.replicas)
+    cfg.cluster.type = cl.get("type", cfg.cluster.type)
+    cfg.cluster.hosts = list(cl.get("hosts", cfg.cluster.hosts))
+    cfg.cluster.internal_hosts = list(
+        cl.get("internal-hosts", cfg.cluster.internal_hosts)
+    )
+    cfg.cluster.polling_interval = cl.get(
+        "polling-interval", cfg.cluster.polling_interval
+    )
+    cfg.cluster.internal_port = cl.get("internal-port", cfg.cluster.internal_port)
+    cfg.cluster.gossip_seed = cl.get("gossip-seed", cfg.cluster.gossip_seed)
+    cfg.cluster.long_query_time = cl.get(
+        "long-query-time", cfg.cluster.long_query_time
+    )
+    ae = doc.get("anti-entropy", {})
+    cfg.anti_entropy_interval = ae.get("interval", cfg.anti_entropy_interval)
+    mt = doc.get("metrics", {})
+    cfg.metrics.service = mt.get("service", cfg.metrics.service)
+    cfg.metrics.host = mt.get("host", cfg.metrics.host)
+    tp = doc.get("tpu", {})
+    cfg.tpu.mesh_shape = tp.get("mesh-shape", cfg.tpu.mesh_shape)
+    cfg.tpu.use_pallas = tp.get("use-pallas", cfg.tpu.use_pallas)
+    return cfg
+
+
+_ENV_MAP = {
+    "PILOSA_DATA_DIR": ("data_dir", str),
+    "PILOSA_HOST": ("host", str),
+    "PILOSA_LOG_PATH": ("log_path", str),
+    "PILOSA_MAX_WRITES_PER_REQUEST": ("max_writes_per_request", int),
+    "PILOSA_CLUSTER_REPLICAS": ("cluster.replicas", int),
+    "PILOSA_CLUSTER_TYPE": ("cluster.type", str),
+    "PILOSA_CLUSTER_HOSTS": ("cluster.hosts", "csv"),
+    "PILOSA_CLUSTER_INTERNAL_HOSTS": ("cluster.internal_hosts", "csv"),
+    "PILOSA_CLUSTER_POLLING_INTERVAL": ("cluster.polling_interval", int),
+    "PILOSA_CLUSTER_INTERNAL_PORT": ("cluster.internal_port", int),
+    "PILOSA_CLUSTER_GOSSIP_SEED": ("cluster.gossip_seed", str),
+    "PILOSA_CLUSTER_LONG_QUERY_TIME": ("cluster.long_query_time", float),
+    "PILOSA_ANTI_ENTROPY_INTERVAL": ("anti_entropy_interval", int),
+    "PILOSA_METRICS_SERVICE": ("metrics.service", str),
+    "PILOSA_METRICS_HOST": ("metrics.host", str),
+    "PILOSA_TPU_MESH_SHAPE": ("tpu.mesh_shape", str),
+    "PILOSA_TPU_USE_PALLAS": ("tpu.use_pallas", "bool"),
+}
+
+
+def _set_dotted(cfg: Config, dotted: str, value) -> None:
+    obj = cfg
+    *parents, leaf = dotted.split(".")
+    for p in parents:
+        obj = getattr(obj, p)
+    setattr(obj, leaf, value)
+
+
+def apply_env(cfg: Config, environ=None) -> Config:
+    """PILOSA_* environment overlay (reference: cmd/root.go:85-112 uses
+    viper's PILOSA prefix)."""
+    environ = environ if environ is not None else os.environ
+    for env_key, (dotted, typ) in _ENV_MAP.items():
+        raw = environ.get(env_key)
+        if raw is None:
+            continue
+        if typ == "csv":
+            value = [s.strip() for s in raw.split(",") if s.strip()]
+        elif typ == "bool":
+            value = raw.lower() in ("1", "true", "yes", "on")
+        else:
+            value = typ(raw)
+        _set_dotted(cfg, dotted, value)
+    return cfg
+
+
+def load(path: str | None = None, environ=None, overrides: dict | None = None) -> Config:
+    """flag > env > file > default."""
+    if path:
+        with open(path, "rb") as f:
+            cfg = from_toml(f.read().decode())
+    else:
+        cfg = Config()
+    apply_env(cfg, environ)
+    for dotted, value in (overrides or {}).items():
+        if value is not None:
+            _set_dotted(cfg, dotted, value)
+    cfg.validate()
+    return cfg

@@ -1,0 +1,9 @@
+"""Core storage hierarchy: Holder -> Index -> Frame -> View -> Fragment.
+
+Mirrors the reference's data model (reference: docs/glossary.md): an
+**Index** is a database; a **Frame** is a row namespace; a **View** is a
+physical layout (standard / inverse / time-generated); a **Fragment** is
+the intersection of one frame-view and one 2^20-column **slice** — here a
+dense uint32 bit-plane that lives on host RAM authoritatively and is
+mirrored into TPU HBM for query execution.
+"""

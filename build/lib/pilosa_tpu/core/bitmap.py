@@ -1,0 +1,170 @@
+"""RowBitmap — a query-result row spanning many slices.
+
+The reference's ``pilosa.Bitmap`` walks two sorted lists of per-slice
+roaring segments with a merge iterator (reference: bitmap.go:28-134,
+282-437).  Here a row result is a dict of ``slice -> uint32[32768]``
+dense segments; set algebra is a dict merge with vectorized word ops, and
+counts are memoized per segment like the reference's cached ``n``.
+
+Segments may be numpy (host) or jax (device) arrays — ops use the ``^|&``
+operators which dispatch correctly for both; ``.bits()`` and JSON/proto
+conversion force a host copy.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable
+
+import numpy as np
+
+from pilosa_tpu.ops import bitplane as bp
+
+
+class RowBitmap:
+    """Segmented row bitmap with per-segment cached counts and row
+    attributes (reference: bitmap.go:24-43)."""
+
+    __slots__ = ("segments", "_counts", "attrs")
+
+    def __init__(self):
+        self.segments: dict[int, np.ndarray] = {}
+        self._counts: dict[int, int] = {}
+        self.attrs: dict[str, Any] = {}
+
+    # --- construction ---
+
+    @classmethod
+    def from_segment(cls, slice_i: int, words, count: int | None = None) -> "RowBitmap":
+        b = cls()
+        b.set_segment(slice_i, words, count)
+        return b
+
+    @classmethod
+    def from_bits(cls, bits: Iterable[int]) -> "RowBitmap":
+        """Build from absolute column IDs (reference: bitmap.go:258-268
+        decoding the protobuf flat bit list)."""
+        b = cls()
+        by_slice: dict[int, list[int]] = {}
+        for col in bits:
+            by_slice.setdefault(col // bp.SLICE_WIDTH, []).append(
+                col % bp.SLICE_WIDTH
+            )
+        for s, offs in by_slice.items():
+            b.segments[s] = bp.np_columns_to_row(np.asarray(offs, dtype=np.uint64))
+        return b
+
+    def set_segment(self, slice_i: int, words, count: int | None = None) -> None:
+        self.segments[slice_i] = words
+        if count is not None:
+            self._counts[slice_i] = count
+        else:
+            self._counts.pop(slice_i, None)
+
+    # --- set algebra (reference: bitmap.go:45-134) ---
+
+    def _binary(self, other: "RowBitmap", op, keep: str) -> "RowBitmap":
+        out = RowBitmap()
+        if keep == "intersection":
+            keys = self.segments.keys() & other.segments.keys()
+            for s in keys:
+                out.segments[s] = op(self.segments[s], other.segments[s])
+        else:  # union of key sets; missing side = zeros
+            for s in self.segments.keys() | other.segments.keys():
+                a = self.segments.get(s)
+                c = other.segments.get(s)
+                if a is None:
+                    a = np.zeros_like(c)
+                if c is None:
+                    c = np.zeros_like(a)
+                out.segments[s] = op(a, c)
+        return out
+
+    def intersect(self, other: "RowBitmap") -> "RowBitmap":
+        return self._binary(other, lambda a, c: a & c, "intersection")
+
+    def union(self, other: "RowBitmap") -> "RowBitmap":
+        return self._binary(other, lambda a, c: a | c, "union")
+
+    def difference(self, other: "RowBitmap") -> "RowBitmap":
+        return self._binary(other, lambda a, c: a & ~c, "difference")
+
+    def xor(self, other: "RowBitmap") -> "RowBitmap":
+        return self._binary(other, lambda a, c: a ^ c, "union")
+
+    def merge(self, other: "RowBitmap") -> None:
+        """In-place union used by the map/reduce combiner (reference:
+        Bitmap.Merge, bitmap.go:137-156)."""
+        for s, words in other.segments.items():
+            if s in self.segments:
+                self.segments[s] = self.segments[s] | words
+                self._counts.pop(s, None)
+            else:
+                self.segments[s] = words
+                if s in other._counts:
+                    self._counts[s] = other._counts[s]
+
+    # --- counts (reference: bitmap.go:159-217) ---
+
+    def segment_count(self, slice_i: int) -> int:
+        n = self._counts.get(slice_i)
+        if n is None:
+            n = int(bp.count(self.segments[slice_i]))
+            self._counts[slice_i] = n
+        return n
+
+    def count(self) -> int:
+        return sum(self.segment_count(s) for s in self.segments)
+
+    def intersection_count(self, other: "RowBitmap") -> int:
+        """Count-only AND without materializing (reference:
+        bitmap.go:74-83 -> roaring.IntersectionCount)."""
+        total = 0
+        for s in self.segments.keys() & other.segments.keys():
+            total += int(bp.count_and(self.segments[s], other.segments[s]))
+        return total
+
+    def invalidate_count(self) -> None:
+        self._counts.clear()
+
+    # --- materialization ---
+
+    def _host_segment(self, slice_i: int) -> np.ndarray:
+        return np.asarray(self.segments[slice_i], dtype=np.uint32)
+
+    def bits(self) -> list[int]:
+        """Sorted absolute column IDs (reference: Bitmap.Bits,
+        bitmap.go:236-242)."""
+        out: list[int] = []
+        for s in sorted(self.segments):
+            offs = bp.np_row_to_columns(self._host_segment(s))
+            base = s * bp.SLICE_WIDTH
+            out.extend(int(o) + base for o in offs)
+        return out
+
+    def set_bit(self, col: int) -> bool:
+        """Host-side single-bit set, used when assembling results
+        (reference: bitmap.go:166-173)."""
+        s, off = divmod(col, bp.SLICE_WIDTH)
+        if s not in self.segments:
+            self.segments[s] = bp.empty_row()
+        seg = np.asarray(self.segments[s], dtype=np.uint32).copy()
+        word, shift = divmod(off, bp.WORD_BITS)
+        mask = np.uint32(1 << shift)
+        changed = not (seg[word] & mask)
+        seg[word] |= mask
+        self.segments[s] = seg
+        if changed and s in self._counts:
+            self._counts[s] += 1
+        return changed
+
+    def to_json_dict(self) -> dict:
+        """{"attrs": ..., "bits": ...} (reference: bitmap.go:220-233)."""
+        return {"attrs": self.attrs or {}, "bits": self.bits()}
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, RowBitmap):
+            return NotImplemented
+        return self.bits() == other.bits()
+
+    def __repr__(self) -> str:
+        return f"RowBitmap(n={self.count()}, slices={sorted(self.segments)})"

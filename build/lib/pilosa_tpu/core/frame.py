@@ -1,0 +1,273 @@
+"""Frame — a row namespace with per-frame config, views, and row attrs.
+
+Reference behavior (reference: frame.go): owns views (standard/inverse/
+time sub-views), a row AttrStore at ``<frame>/.data``, and persisted meta
+(rowLabel, cacheType, cacheSize, inverseEnabled, timeQuantum —
+reference: frame.go:33-67,278-334; meta here is JSON rather than
+protobuf, the file name and fields are the same).  ``set_bit`` writes
+the named view plus one generated view per time-quantum unit
+(reference: frame.go:443-483); ``import_bulk`` groups bits by
+(view, slice) including reversed row/col pairs for inverse views
+(reference: frame.go:527-604).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from datetime import datetime
+
+from pilosa_tpu.core import cache as cache_mod
+from pilosa_tpu.core import timequantum as tq
+from pilosa_tpu.core.attr import AttrStore
+from pilosa_tpu.core.names import ValidationError, validate_label, validate_name
+from pilosa_tpu.core.view import (
+    VIEW_INVERSE,
+    VIEW_STANDARD,
+    View,
+    is_inverse_view,
+    is_valid_view,
+)
+from pilosa_tpu.ops.bitplane import SLICE_WIDTH
+
+# reference: frame.go:40-46
+DEFAULT_ROW_LABEL = "rowID"
+DEFAULT_CACHE_TYPE = cache_mod.TYPE_RANKED
+DEFAULT_CACHE_SIZE = cache_mod.DEFAULT_CACHE_SIZE
+
+
+class FrameError(RuntimeError):
+    pass
+
+
+class Frame:
+    def __init__(self, path: str, index: str, name: str):
+        validate_name(name)
+        self.path = path
+        self.index = index
+        self.name = name
+        self._mu = threading.RLock()
+        self._views: dict[str, View] = {}
+        self.row_label = DEFAULT_ROW_LABEL
+        self.cache_type = DEFAULT_CACHE_TYPE
+        self.cache_size = DEFAULT_CACHE_SIZE
+        self.inverse_enabled = False
+        self.time_quantum = ""
+        self.row_attr_store = AttrStore(os.path.join(path, ".data"))
+        self.on_create_slice = None  # wired by Index/Holder
+
+    # --- lifecycle (reference: frame.go:218-334) ---
+
+    @property
+    def meta_path(self) -> str:
+        return os.path.join(self.path, ".meta")
+
+    def open(self) -> None:
+        with self._mu:
+            os.makedirs(self.path, exist_ok=True)
+            self._load_meta()
+            self.row_attr_store.open()
+            views_path = os.path.join(self.path, "views")
+            os.makedirs(views_path, exist_ok=True)
+            for entry in sorted(os.listdir(views_path)):
+                view = self._new_view(entry)
+                view.open()
+                self._views[entry] = view
+
+    def close(self) -> None:
+        with self._mu:
+            self.row_attr_store.close()
+            for view in self._views.values():
+                view.close()
+            self._views.clear()
+
+    def _load_meta(self) -> None:
+        try:
+            with open(self.meta_path) as fh:
+                meta = json.load(fh)
+        except FileNotFoundError:
+            return
+        self.row_label = meta.get("rowLabel", DEFAULT_ROW_LABEL)
+        self.cache_type = meta.get("cacheType", DEFAULT_CACHE_TYPE)
+        self.cache_size = meta.get("cacheSize", DEFAULT_CACHE_SIZE)
+        self.inverse_enabled = meta.get("inverseEnabled", False)
+        self.time_quantum = meta.get("timeQuantum", "")
+
+    def save_meta(self) -> None:
+        with self._mu:
+            os.makedirs(self.path, exist_ok=True)
+            tmp = self.meta_path + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump(
+                    {
+                        "rowLabel": self.row_label,
+                        "cacheType": self.cache_type,
+                        "cacheSize": self.cache_size,
+                        "inverseEnabled": self.inverse_enabled,
+                        "timeQuantum": self.time_quantum,
+                    },
+                    fh,
+                )
+            os.replace(tmp, self.meta_path)
+
+    def set_options(
+        self,
+        row_label: str | None = None,
+        cache_type: str | None = None,
+        cache_size: int | None = None,
+        inverse_enabled: bool | None = None,
+        time_quantum: str | None = None,
+    ) -> None:
+        with self._mu:
+            if row_label is not None:
+                validate_label(row_label)
+                self.row_label = row_label
+            if cache_type is not None:
+                if cache_type not in (cache_mod.TYPE_RANKED, cache_mod.TYPE_LRU):
+                    raise ValidationError(f"invalid cache type: {cache_type!r}")
+                self.cache_type = cache_type
+            if cache_size is not None:
+                self.cache_size = cache_size
+            if inverse_enabled is not None:
+                self.inverse_enabled = inverse_enabled
+            if time_quantum is not None:
+                self.time_quantum = tq.parse_time_quantum(time_quantum)
+            self.save_meta()
+
+    def set_time_quantum(self, q: str) -> None:
+        """reference: frame.go:397-414"""
+        with self._mu:
+            self.time_quantum = tq.parse_time_quantum(q)
+            self.save_meta()
+
+    # --- views (reference: frame.go:336-395) ---
+
+    def _new_view(self, name: str) -> View:
+        return View(
+            os.path.join(self.path, "views", name),
+            self.index,
+            self.name,
+            name,
+            cache_type=self.cache_type,
+            cache_size=self.cache_size,
+            row_attr_store=self.row_attr_store,
+            on_create_slice=self.on_create_slice,
+        )
+
+    def view(self, name: str) -> View | None:
+        with self._mu:
+            return self._views.get(name)
+
+    def views(self) -> dict[str, View]:
+        with self._mu:
+            return dict(self._views)
+
+    def create_view_if_not_exists(self, name: str) -> View:
+        with self._mu:
+            v = self._views.get(name)
+            if v is None:
+                v = self._new_view(name)
+                v.open()
+                self._views[name] = v
+            return v
+
+    def delete_view(self, name: str) -> None:
+        with self._mu:
+            v = self._views.pop(name, None)
+            if v is not None:
+                v.close()
+                import shutil
+
+                shutil.rmtree(v.path, ignore_errors=True)
+
+    # --- slices ---
+
+    def max_slice(self) -> int:
+        """Max slice over non-inverse views (reference: frame.go:169-186)."""
+        with self._mu:
+            return max(
+                (v.max_slice() for n, v in self._views.items() if not is_inverse_view(n)),
+                default=0,
+            )
+
+    def max_inverse_slice(self) -> int:
+        with self._mu:
+            return max(
+                (v.max_slice() for n, v in self._views.items() if is_inverse_view(n)),
+                default=0,
+            )
+
+    # --- writes (reference: frame.go:443-525) ---
+
+    def set_bit(
+        self, view_name: str, row_id: int, col_id: int, t: datetime | None = None
+    ) -> bool:
+        if not is_valid_view(view_name):
+            raise FrameError(f"invalid view: {view_name!r}")
+        view = self.create_view_if_not_exists(view_name)
+        changed = view.set_bit(row_id, col_id)
+        if t is None:
+            return changed
+        for subname in tq.views_by_time(view_name, t, self.time_quantum):
+            sub = self.create_view_if_not_exists(subname)
+            if sub.set_bit(row_id, col_id):
+                changed = True
+        return changed
+
+    def clear_bit(self, view_name: str, row_id: int, col_id: int) -> bool:
+        """reference: frame.go:485-506 (standard view only; no time fanout)"""
+        if not is_valid_view(view_name):
+            raise FrameError(f"invalid view: {view_name!r}")
+        view = self.create_view_if_not_exists(view_name)
+        return view.clear_bit(row_id, col_id)
+
+    def import_bulk(
+        self,
+        row_ids,
+        column_ids,
+        timestamps=None,
+    ) -> None:
+        """Bulk import grouped by (view, slice) (reference:
+        frame.go:527-604)."""
+        n = len(row_ids)
+        timestamps = timestamps if timestamps is not None else [None] * n
+        if self.time_quantum == "" and any(t is not None for t in timestamps):
+            raise FrameError("time quantum not set in either index or frame")
+
+        by_fragment: dict[tuple[str, int], tuple[list[int], list[int]]] = {}
+
+        def attach(view_name: str, slice_i: int, r: int, c: int):
+            rows, cols = by_fragment.setdefault((view_name, slice_i), ([], []))
+            rows.append(r)
+            cols.append(c)
+
+        for i in range(n):
+            row_id, col_id, ts = row_ids[i], column_ids[i], timestamps[i]
+            if ts is None:
+                standard = [VIEW_STANDARD]
+                inverse = [VIEW_INVERSE]
+            else:
+                standard = tq.views_by_time(VIEW_STANDARD, ts, self.time_quantum)
+                standard.append(VIEW_STANDARD)
+                inverse = tq.views_by_time(VIEW_INVERSE, ts, self.time_quantum)
+            for name in standard:
+                attach(name, col_id // SLICE_WIDTH, row_id, col_id)
+            if self.inverse_enabled:
+                for name in inverse:
+                    attach(name, row_id // SLICE_WIDTH, col_id, row_id)
+
+        for (view_name, slice_i), (rows, cols) in by_fragment.items():
+            view = self.create_view_if_not_exists(view_name)
+            frag = view.create_fragment_if_not_exists(slice_i)
+            frag.import_bulk(rows, cols)
+
+    def schema_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "rowLabel": self.row_label,
+            "cacheType": self.cache_type,
+            "cacheSize": self.cache_size,
+            "inverseEnabled": self.inverse_enabled,
+            "timeQuantum": self.time_quantum,
+        }

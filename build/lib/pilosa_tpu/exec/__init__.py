@@ -1,0 +1,24 @@
+"""Execution layer: the distributed PQL query engine.
+
+reference: executor.go
+"""
+
+from pilosa_tpu.exec.executor import (
+    ExecOptions,
+    Executor,
+    ExecutorError,
+    FrameNotFoundError,
+    IndexNotFoundError,
+    SliceUnavailableError,
+    TooManyWritesError,
+)
+
+__all__ = [
+    "Executor",
+    "ExecOptions",
+    "ExecutorError",
+    "IndexNotFoundError",
+    "FrameNotFoundError",
+    "TooManyWritesError",
+    "SliceUnavailableError",
+]

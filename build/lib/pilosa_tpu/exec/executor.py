@@ -1,0 +1,830 @@
+"""Executor — the distributed PQL control plane.
+
+Behavior parity with the reference executor (reference: executor.go):
+per-call dispatch, slice-list construction from the index's max slice,
+map/reduce over cluster nodes with replica failover, write fan-out to all
+replicas, two-phase TopN, bulk-SetRowAttrs fast path, attr broadcast.
+
+TPU-native execution differs in structure, not results:
+
+* A bitmap call tree is compiled to **one fused XLA program per tree
+  shape** (exec/plan.py); per slice the leaves are device rows gathered
+  from fragment HBM planes, so ``Count(Intersect(a, b))`` runs as a
+  single fused bitwise+popcount kernel with no intermediate rows —
+  replacing the reference's per-container roaring merges
+  (reference: executor.go:438-505 + roaring kernels).
+* The local "mapper" batches all local slices' leaves into one stacked
+  device array and evaluates the tree **vmapped over slices** in a
+  single device program, instead of a goroutine per slice
+  (reference: executor.go:1246-1282 mapperLocal).
+* Cross-node fan-out keeps the reference's HTTP+protobuf shape via an
+  injectable client; intra-host multi-device reduces ride ICI
+  collectives (parallel/mesh.py).
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from datetime import datetime
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pilosa_tpu.cluster.topology import Cluster, Node
+from pilosa_tpu.parallel import mesh as pmesh
+from pilosa_tpu.core import cache as cache_mod
+from pilosa_tpu.core import timequantum as tq
+from pilosa_tpu.core.bitmap import RowBitmap
+from pilosa_tpu.core.cache import Pair
+from pilosa_tpu.core.fragment import TopOptions
+from pilosa_tpu.core.view import VIEW_INVERSE, VIEW_STANDARD
+from pilosa_tpu.exec import plan
+from pilosa_tpu.ops import bitplane as bp
+from pilosa_tpu.pql.parser import Call, Query
+
+# reference: executor.go:33-40
+DEFAULT_FRAME = "general"
+MIN_THRESHOLD = 1
+# reference: pilosa.go:107-108
+TIME_FORMAT = "%Y-%m-%dT%H:%M"
+# reference: config.go (max-writes-per-request default)
+DEFAULT_MAX_WRITES_PER_REQUEST = 5000
+
+WRITE_CALLS = frozenset({"SetBit", "ClearBit", "SetRowAttrs", "SetColumnAttrs"})
+
+
+class ExecutorError(RuntimeError):
+    pass
+
+
+class IndexNotFoundError(ExecutorError):
+    def __init__(self):
+        super().__init__("index not found")
+
+
+class FrameNotFoundError(ExecutorError):
+    def __init__(self):
+        super().__init__("frame not found")
+
+
+class TooManyWritesError(ExecutorError):
+    def __init__(self):
+        super().__init__("too many write commands")
+
+
+class SliceUnavailableError(ExecutorError):
+    def __init__(self):
+        super().__init__("slice unavailable")
+
+
+@dataclass
+class ExecOptions:
+    """reference: executor.go:1302-1304"""
+
+    remote: bool = False
+
+
+@dataclass
+class _MapResponse:
+    node: Node | None = None
+    slices: list[int] = field(default_factory=list)
+    result: object = None
+    error: Exception | None = None
+
+
+def needs_slices(calls: list[Call]) -> bool:
+    """reference: executor.go:1326-1343"""
+    if not calls:
+        return False
+    return any(c.name not in WRITE_CALLS for c in calls)
+
+
+class Executor:
+    """Executes PQL queries against a holder, fanning out across a cluster.
+
+    ``client_factory(node) -> client`` supplies the inter-node data plane;
+    the client must expose ``execute_query(index, query, slices, remote)
+    -> list`` (see net/client.py).  Single-node setups never invoke it.
+    """
+
+    def __init__(
+        self,
+        holder,
+        host: str = "",
+        cluster: Cluster | None = None,
+        client_factory=None,
+        max_writes_per_request: int = DEFAULT_MAX_WRITES_PER_REQUEST,
+    ):
+        self.holder = holder
+        self.host = host
+        self.cluster = cluster or Cluster(nodes=[Node(host=host)])
+        self.client_factory = client_factory
+        self.max_writes_per_request = max_writes_per_request
+        self._pool = ThreadPoolExecutor(max_workers=16)
+        self._zero_rows: dict = {}  # device -> cached all-zero leaf row
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False, cancel_futures=True)
+
+    # ------------------------------------------------------------------
+    # entry point (reference: executor.go:65-151)
+    # ------------------------------------------------------------------
+
+    def execute(
+        self,
+        index: str,
+        q: Query,
+        slices: list[int] | None = None,
+        opt: ExecOptions | None = None,
+    ) -> list:
+        if not index:
+            raise ExecutorError("index required")
+        if (
+            self.max_writes_per_request > 0
+            and q.write_call_n() > self.max_writes_per_request
+        ):
+            raise TooManyWritesError()
+        opt = opt or ExecOptions()
+
+        slices = list(slices) if slices else []
+        inverse_slices: list[int] = []
+        column_label = "columnID"
+        want_slices = needs_slices(q.calls)
+        # Inverse orientation only swaps in the inverse slice list when this
+        # node computed the lists itself; a coordinator-provided list (remote
+        # leg) already has the right orientation and must be used as-is.
+        computed_lists = False
+        if not slices and want_slices:
+            idx = self.holder.index(index)
+            if idx is None:
+                raise IndexNotFoundError()
+            slices = list(range(idx.max_slice() + 1))
+            inverse_slices = list(range(idx.max_inverse_slice() + 1))
+            column_label = idx.column_label
+            computed_lists = True
+
+        # Bulk attribute-insert fast path (reference: executor.go:119-122).
+        if q.calls and all(c.name == "SetRowAttrs" for c in q.calls):
+            return self._execute_bulk_set_row_attrs(index, q.calls, opt)
+
+        results = []
+        for call in q.calls:
+            call_slices = slices
+            if call.supports_inverse() and want_slices and computed_lists:
+                frame = call.args.get("frame") or DEFAULT_FRAME
+                f = self.holder.frame(index, frame)
+                if f is None:
+                    raise FrameNotFoundError()
+                if call.is_inverse(f.row_label, column_label):
+                    call_slices = inverse_slices
+            results.append(self._execute_call(index, call, call_slices, opt))
+        return results
+
+    # ------------------------------------------------------------------
+    # dispatch (reference: executor.go:156-182)
+    # ------------------------------------------------------------------
+
+    def _execute_call(self, index: str, c: Call, slices: list[int], opt: ExecOptions):
+        name = c.name
+        if name == "ClearBit":
+            return self._execute_clear_bit(index, c, opt)
+        if name == "SetBit":
+            return self._execute_set_bit(index, c, opt)
+        if name == "SetRowAttrs":
+            self._execute_set_row_attrs(index, c, opt)
+            return None
+        if name == "SetColumnAttrs":
+            self._execute_set_column_attrs(index, c, opt)
+            return None
+        if name == "Count":
+            return self._execute_count(index, c, slices, opt)
+        if name == "TopN":
+            return self._execute_topn(index, c, slices, opt)
+        return self._execute_bitmap_call(index, c, slices, opt)
+
+    # ------------------------------------------------------------------
+    # bitmap call trees — fused device programs
+    # ------------------------------------------------------------------
+
+    def _leaf_row_device(self, index: str, c: Call, slice_i: int):
+        """Fetch one leaf row as a device (or None=empty) uint32[32768]."""
+        if c.name == "Bitmap":
+            frag, row_id = self._resolve_bitmap_leaf(index, c, slice_i)
+            if frag is None:
+                return None
+            return frag.device_row(row_id)
+        if c.name == "Range":
+            return self._range_row_device(index, c, slice_i)
+        raise plan.PlanError(f"unknown call: {c.name}")
+
+    def _resolve_bitmap_leaf(self, index: str, c: Call, slice_i: int):
+        """Frame/row/orientation resolution for a Bitmap() leaf
+        (reference: executor.go:438-484 executeBitmapSlice)."""
+        idx = self.holder.index(index)
+        if idx is None:
+            raise IndexNotFoundError()
+        column_label = idx.column_label
+        frame = c.args.get("frame") or DEFAULT_FRAME
+        f = self.holder.frame(index, frame)
+        if f is None:
+            raise FrameNotFoundError()
+        row_label = f.row_label
+
+        row_id, row_ok = _uint_arg(c, row_label)
+        col_id, col_ok = _uint_arg(c, column_label)
+        if row_ok and col_ok:
+            raise ExecutorError(
+                f"Bitmap() cannot specify both {row_label} and {column_label} values"
+            )
+        if not row_ok and not col_ok:
+            raise ExecutorError(
+                f"Bitmap() must specify either {row_label} or {column_label} values"
+            )
+        view, id_ = VIEW_STANDARD, row_id
+        if col_ok:
+            view, id_ = VIEW_INVERSE, col_id
+            if not f.inverse_enabled:
+                raise ExecutorError(
+                    "Bitmap() cannot retrieve columns unless inverse storage enabled"
+                )
+        frag = self.holder.fragment(index, frame, view, slice_i)
+        return frag, id_
+
+    def _range_row_device(self, index: str, c: Call, slice_i: int):
+        """Union of rows across time views (reference: executor.go:507-589)."""
+        frame = c.args.get("frame") or DEFAULT_FRAME
+        idx = self.holder.index(index)
+        if idx is None:
+            raise IndexNotFoundError()
+        column_label = idx.column_label
+        f = idx.frame(frame)
+        if f is None:
+            raise FrameNotFoundError()
+        row_label = f.row_label
+
+        col_id, col_ok = _uint_arg(c, column_label)
+        row_id, row_ok = _uint_arg(c, row_label)
+        if col_ok and row_ok:
+            raise ExecutorError(
+                f'Range() cannot contain both "{column_label}" and "{row_label}"'
+            )
+        if not col_ok and not row_ok:
+            raise ExecutorError(
+                f'Range() must specify either "{column_label}" or "{row_label}"'
+            )
+        view_name, id_ = (VIEW_INVERSE, col_id) if col_ok else (VIEW_STANDARD, row_id)
+
+        start = _time_arg(c, "start")
+        end = _time_arg(c, "end")
+        quantum = f.time_quantum
+        if not quantum:
+            return None
+
+        acc = None
+        for view in tq.views_by_time_range(view_name, start, end, quantum):
+            frag = self.holder.fragment(index, frame, view, slice_i)
+            if frag is None:
+                continue
+            row = frag.device_row(id_)
+            if row is None:
+                continue
+            acc = row if acc is None else (acc | row)
+        return acc
+
+    def _eval_tree_slices(
+        self, index: str, c: Call, slices: list[int], reduce: str
+    ) -> dict[int, object]:
+        """Evaluate a bitmap call tree over local slices as one batched
+        device program: leaves for all slices stack into a
+        uint32[n_slices, n_leaves, 32768] array and the jitted tree fn is
+        vmapped over the slice axis — the TPU-shaped replacement for the
+        reference's goroutine-per-slice mapperLocal."""
+        expr, leaves = plan.decompose(c)
+        out: dict[int, object] = {}
+        if not slices:
+            return out
+
+        stacks = []
+        kept_slices = []
+        empties = []
+        for s in slices:
+            rows = []
+            any_set = False
+            for leaf in leaves:
+                r = self._leaf_row_device(index, leaf, s)
+                if r is None:
+                    r = self._zero_row(s)
+                else:
+                    any_set = True
+                rows.append(r)
+            if not leaves:
+                empties.append(s)
+                continue
+            if not any_set:
+                empties.append(s)
+                continue
+            # All of a slice's leaves live on its home device, so this
+            # stack stays device-local.
+            stacks.append(jnp.stack(rows))
+            kept_slices.append(s)
+
+        for s in empties:
+            out[s] = 0 if reduce == "count" else None
+
+        if not kept_slices:
+            return out
+
+        mesh = pmesh.default_slices_mesh()
+        if mesh is not None and len(kept_slices) > 1:
+            out.update(self._eval_sharded(expr, reduce, kept_slices, stacks, mesh))
+            return out
+
+        # Single device: pad the slice axis to a power of two — one
+        # compiled program per (tree shape, bucket) instead of per slice
+        # count (SURVEY.md §7 "dynamic shapes" — shape bucketing).
+        n = len(stacks)
+        bucket = 1 << (n - 1).bit_length()
+        if bucket != n:
+            pad = jnp.zeros_like(stacks[0])
+            stacks = stacks + [pad] * (bucket - n)
+        batched = plan.compiled_batched(expr, reduce)
+        res = batched(jnp.stack(stacks))
+        for i, s in enumerate(kept_slices):
+            out[s] = res[i]
+        return out
+
+    def _eval_sharded(
+        self, expr, reduce, kept_slices, stacks, mesh
+    ) -> dict[int, object]:
+        """Evaluate the batched tree over a multi-device slices mesh.
+
+        Slices are grouped by home device (slice mod n_devices, matching
+        fragment plane placement), per-device blocks are padded to one
+        power-of-two chunk, and the global batch is assembled shard-local
+        (parallel/mesh.assemble_sharded_batch) — the jitted tree program
+        then runs SPMD over the mesh, the in-host analog of the
+        reference's slice->node map/reduce (reference:
+        executor.go:1149-1243), with the reduce riding ICI instead of
+        HTTP fan-in."""
+        n_dev = int(mesh.devices.size)
+        groups: dict[int, list[tuple[int, object]]] = {}
+        for s, st in zip(kept_slices, stacks):
+            groups.setdefault(s % n_dev, []).append((s, st))
+        longest = max(len(g) for g in groups.values())
+        chunk = 1 << (longest - 1).bit_length()
+
+        blocks = []
+        pos_of: dict[int, int] = {}
+        for d in range(n_dev):
+            g = groups.get(d, [])
+            entries = [st for _, st in g]
+            if len(entries) < chunk:
+                zero_stack = jnp.stack(
+                    [self._zero_row_on(mesh.devices.flat[d])] * stacks[0].shape[0]
+                )
+                entries = entries + [zero_stack] * (chunk - len(entries))
+            blocks.append(jnp.stack(entries))
+            for i, (s, _) in enumerate(g):
+                pos_of[s] = d * chunk + i
+
+        batch = pmesh.assemble_sharded_batch(blocks, mesh)
+        res = plan.compiled_batched(expr, reduce)(batch)
+        res = jax.device_get(res)
+        return {s: res[p] for s, p in pos_of.items()}
+
+    def _zero_row(self, slice_i: int):
+        """An all-zero leaf row on a slice's home device."""
+        return self._zero_row_on(pmesh.home_device(slice_i))
+
+    def _zero_row_on(self, dev):
+        """An all-zero leaf row committed to ``dev`` (cached per device)."""
+        z = self._zero_rows.get(dev)
+        if z is None:
+            z = jax.device_put(
+                np.zeros(bp.WORDS_PER_SLICE, dtype=np.uint32), dev
+            )
+            self._zero_rows[dev] = z
+        return z
+
+    def _execute_bitmap_call(
+        self, index: str, c: Call, slices: list[int], opt: ExecOptions
+    ) -> RowBitmap:
+        """reference: executor.go:203-261"""
+
+        def map_fn(local_slices: list[int]):
+            rows = self._eval_tree_slices(index, c, local_slices, "row")
+            bm = RowBitmap()
+            for s, row in rows.items():
+                if row is not None:
+                    bm.set_segment(s, row)
+            return bm
+
+        def reduce_fn(prev, v):
+            if prev is None:
+                prev = RowBitmap()
+            prev.merge(v)
+            return prev
+
+        bm = self._map_reduce(index, slices, c, opt, map_fn, reduce_fn)
+        if bm is None:
+            bm = RowBitmap()
+
+        # Attach attributes for Bitmap() calls (reference: executor.go:226-258).
+        if c.name == "Bitmap":
+            idx = self.holder.index(index)
+            if idx is not None:
+                column_label = idx.column_label
+                col_id, col_ok = _uint_arg(c, column_label)
+                if col_ok:
+                    bm.attrs = idx.column_attr_store.attrs(col_id)
+                else:
+                    # Raw frame arg, NOT defaulted: with frame omitted the
+                    # reference attaches no row attrs (executor.go:244-258).
+                    frame = c.args.get("frame") or ""
+                    f = idx.frame(frame) if frame else None
+                    if f is not None:
+                        row_id, row_ok = _uint_arg(c, f.row_label)
+                        if row_ok and f.row_attr_store is not None:
+                            bm.attrs = f.row_attr_store.attrs(row_id)
+        return bm
+
+    def _execute_count(
+        self, index: str, c: Call, slices: list[int], opt: ExecOptions
+    ) -> int:
+        """reference: executor.go:611-639"""
+        if len(c.children) == 0:
+            raise ExecutorError("Count() requires an input bitmap")
+        if len(c.children) > 1:
+            raise ExecutorError("Count() only accepts a single bitmap input")
+        child = c.children[0]
+
+        def map_fn(local_slices: list[int]):
+            counts = self._eval_tree_slices(index, child, local_slices, "count")
+            return sum(int(v) for v in counts.values())
+
+        def reduce_fn(prev, v):
+            return (prev or 0) + v
+
+        n = self._map_reduce(index, slices, c, opt, map_fn, reduce_fn)
+        return int(n or 0)
+
+    # ------------------------------------------------------------------
+    # TopN (reference: executor.go:281-415) — two-phase
+    # ------------------------------------------------------------------
+
+    def _execute_topn(
+        self, index: str, c: Call, slices: list[int], opt: ExecOptions
+    ) -> list[Pair]:
+        ids_arg = _uint_slice_arg(c, "ids")
+        n = _uint_arg(c, "n")[0]
+
+        pairs = self._execute_topn_slices(index, c, slices, opt)
+        # Phase 2 refetch only on the originating node (reference:
+        # executor.go:301-321).
+        if not pairs or ids_arg or opt.remote:
+            return pairs
+        other = c.clone()
+        other.args["ids"] = sorted({p.id for p in pairs})
+        trimmed = self._execute_topn_slices(index, other, slices, opt)
+        if n and n < len(trimmed):
+            trimmed = trimmed[:n]
+        return trimmed
+
+    def _execute_topn_slices(
+        self, index: str, c: Call, slices: list[int], opt: ExecOptions
+    ) -> list[Pair]:
+        def map_fn(local_slices: list[int]):
+            acc: list[Pair] = []
+            for s in local_slices:
+                acc = cache_mod.add_pairs(acc, self._execute_topn_slice(index, c, s))
+            return acc
+
+        def reduce_fn(prev, v):
+            return cache_mod.add_pairs(prev or [], v)
+
+        pairs = self._map_reduce(index, slices, c, opt, map_fn, reduce_fn) or []
+        return cache_mod.sort_pairs(pairs)
+
+    def _execute_topn_slice(self, index: str, c: Call, slice_i: int) -> list[Pair]:
+        """reference: executor.go:346-415"""
+        frame = c.args.get("frame") or DEFAULT_FRAME
+        inverse = bool(c.args.get("inverse", False))
+        n = _uint_arg(c, "n")[0]
+        fld = c.args.get("field", "") or ""
+        row_ids = _uint_slice_arg(c, "ids")
+        min_threshold = _uint_arg(c, "threshold")[0]
+        filters = c.args.get("filters")
+        tanimoto = _uint_arg(c, "tanimotoThreshold")[0]
+
+        src = None
+        if len(c.children) == 1:
+            rows = self._eval_tree_slices(index, c.children[0], [slice_i], "row")
+            src = RowBitmap()
+            row = rows.get(slice_i)
+            if row is not None:
+                src.set_segment(slice_i, np.asarray(row))
+        elif len(c.children) > 1:
+            raise ExecutorError("TopN() can only have one input bitmap")
+
+        view = VIEW_INVERSE if inverse else VIEW_STANDARD
+        f = self.holder.fragment(index, frame, view, slice_i)
+        if f is None:
+            return []
+        if min_threshold <= 0:
+            min_threshold = MIN_THRESHOLD
+        if tanimoto > 100:
+            raise ExecutorError("Tanimoto Threshold is from 1 to 100 only")
+        return f.top(
+            TopOptions(
+                n=n,
+                src=src,
+                row_ids=row_ids,
+                filter_field=fld,
+                filter_values=list(filters) if filters else None,
+                min_threshold=min_threshold,
+                tanimoto_threshold=tanimoto,
+            )
+        )
+
+    # ------------------------------------------------------------------
+    # writes (reference: executor.go:642-840)
+    # ------------------------------------------------------------------
+
+    def _resolve_write(self, index: str, c: Call, verb: str):
+        frame_name = c.args.get("frame")
+        if not isinstance(frame_name, str):
+            raise ExecutorError(f"{verb}() field required: frame")
+        idx = self.holder.index(index)
+        if idx is None:
+            raise IndexNotFoundError()
+        f = idx.frame(frame_name)
+        if f is None:
+            raise FrameNotFoundError()
+        row_label = f.row_label
+        column_label = idx.column_label
+        row_id, ok = _uint_arg(c, row_label)
+        if not ok:
+            raise ExecutorError(f"{verb}() row field '{row_label}' required")
+        col_id, ok = _uint_arg(c, column_label)
+        if not ok:
+            raise ExecutorError(f"{verb}() column field '{column_label}' required")
+        return f, row_id, col_id
+
+    def _execute_set_bit(self, index: str, c: Call, opt: ExecOptions) -> bool:
+        view = c.args.get("view", "") or ""
+        f, row_id, col_id = self._resolve_write(index, c, "SetBit")
+
+        timestamp = None
+        ts = c.args.get("timestamp")
+        if isinstance(ts, str):
+            try:
+                timestamp = datetime.strptime(ts, TIME_FORMAT)
+            except ValueError:
+                raise ExecutorError(f"invalid date: {ts}")
+
+        return self._write_views(
+            index, c, opt, view, f,
+            lambda vw, r, cl: f.set_bit(vw, r, cl, timestamp),
+            row_id, col_id,
+        )
+
+    def _execute_clear_bit(self, index: str, c: Call, opt: ExecOptions) -> bool:
+        view = c.args.get("view", "") or ""
+        f, row_id, col_id = self._resolve_write(index, c, "ClearBit")
+        return self._write_views(
+            index, c, opt, view, f,
+            lambda vw, r, cl: f.clear_bit(vw, r, cl),
+            row_id, col_id,
+        )
+
+    def _write_views(
+        self, index, c, opt, view, frame, write_fn, row_id, col_id
+    ) -> bool:
+        """Write to standard and/or inverse views with replica fan-out
+        (reference: executor.go:679-734,783-840).  For the inverse view
+        the row/column roles transpose: the slice is derived from the
+        rowID and the stored (row, col) swap."""
+        if view == VIEW_STANDARD:
+            return self._write_one_view(index, c, opt, VIEW_STANDARD, write_fn, row_id, col_id)
+        if view == VIEW_INVERSE:
+            return self._write_one_view(index, c, opt, VIEW_INVERSE, write_fn, col_id, row_id)
+        if view == "":
+            ret = self._write_one_view(index, c, opt, VIEW_STANDARD, write_fn, row_id, col_id)
+            if frame.inverse_enabled:
+                if self._write_one_view(index, c, opt, VIEW_INVERSE, write_fn, col_id, row_id):
+                    ret = True
+            return ret
+        raise ExecutorError(f"invalid view: {view}")
+
+    def _write_one_view(
+        self, index, c, opt, view, write_fn, row_id, col_id
+    ) -> bool:
+        slice_i = col_id // bp.SLICE_WIDTH
+        ret = False
+        for node in self.cluster.fragment_nodes(index, slice_i):
+            if node.host == self.host:
+                if write_fn(view, row_id, col_id):
+                    ret = True
+                continue
+            if opt.remote:
+                continue
+            res = self._exec_remote(node, index, Query(calls=[c]), None, opt)
+            if res and res[0]:
+                ret = True
+        return ret
+
+    # ------------------------------------------------------------------
+    # attribute writes (reference: executor.go:843-1040)
+    # ------------------------------------------------------------------
+
+    def _execute_set_row_attrs(self, index: str, c: Call, opt: ExecOptions) -> None:
+        frame_name = c.args.get("frame")
+        if not isinstance(frame_name, str):
+            raise ExecutorError("SetRowAttrs() frame required")
+        frame = self.holder.frame(index, frame_name)
+        if frame is None:
+            raise FrameNotFoundError()
+        row_label = frame.row_label
+        row_id, ok = _uint_arg(c, row_label)
+        if not ok:
+            raise ExecutorError(f"SetRowAttrs() row field '{row_label}' required")
+        attrs = dict(c.args)
+        attrs.pop("frame", None)
+        attrs.pop(row_label, None)
+        frame.row_attr_store.set_attrs(row_id, attrs)
+        if opt.remote:
+            return
+        self._broadcast_query(index, Query(calls=[c]), opt)
+
+    def _execute_bulk_set_row_attrs(
+        self, index: str, calls: list[Call], opt: ExecOptions
+    ) -> list:
+        """reference: executor.go:905-985"""
+        by_frame: dict[str, dict[int, dict]] = {}
+        for c in calls:
+            frame_name = c.args.get("frame")
+            if not isinstance(frame_name, str):
+                raise ExecutorError("SetRowAttrs() frame required")
+            f = self.holder.frame(index, frame_name)
+            if f is None:
+                raise FrameNotFoundError()
+            row_label = f.row_label
+            row_id, ok = _uint_arg(c, row_label)
+            if not ok:
+                raise ExecutorError(f"SetRowAttrs row field '{row_label}' required")
+            attrs = dict(c.args)
+            attrs.pop("frame", None)
+            attrs.pop(row_label, None)
+            by_frame.setdefault(frame_name, {}).setdefault(row_id, {}).update(attrs)
+        for frame_name, attr_sets in by_frame.items():
+            f = self.holder.frame(index, frame_name)
+            f.row_attr_store.set_bulk_attrs(attr_sets)
+        if not opt.remote:
+            self._broadcast_query(index, Query(calls=calls), opt)
+        return [None] * len(calls)
+
+    def _execute_set_column_attrs(self, index: str, c: Call, opt: ExecOptions) -> None:
+        idx = self.holder.index(index)
+        if idx is None:
+            raise IndexNotFoundError()
+        id_, ok = _uint_arg(c, "id")
+        col_name = "id"
+        if not ok:
+            id_, ok = _uint_arg(c, idx.column_label)
+            if not ok:
+                raise ExecutorError("SetColumnAttrs() id required")
+            col_name = idx.column_label
+        attrs = dict(c.args)
+        attrs.pop(col_name, None)
+        idx.column_attr_store.set_attrs(id_, attrs)
+        if opt.remote:
+            return
+        self._broadcast_query(index, Query(calls=[c]), opt)
+
+    def _broadcast_query(self, index: str, q: Query, opt: ExecOptions) -> None:
+        """Forward a query to every other node in parallel; first error
+        wins (reference: executor.go:966-985)."""
+        others = [n for n in self.cluster.nodes if n.host != self.host]
+        if not others:
+            return
+        futures = [
+            self._pool.submit(self._exec_remote, n, index, q, None, opt)
+            for n in others
+        ]
+        for fut in futures:
+            fut.result()
+
+    # ------------------------------------------------------------------
+    # map/reduce over the cluster (reference: executor.go:1131-1283)
+    # ------------------------------------------------------------------
+
+    def _slices_by_node(
+        self, nodes: list[Node], index: str, slices: list[int]
+    ) -> dict[str, tuple[Node, list[int]]]:
+        m: dict[str, tuple[Node, list[int]]] = {}
+        node_hosts = {n.host for n in nodes}
+        for s in slices:
+            for owner in self.cluster.fragment_nodes(index, s):
+                if owner.host in node_hosts:
+                    m.setdefault(owner.host, (owner, []))[1].append(s)
+                    break
+            else:
+                raise SliceUnavailableError()
+        return m
+
+    def _map_reduce(self, index, slices, c, opt, map_fn, reduce_fn):
+        """Map slices over owning nodes, reduce as responses arrive, and
+        retry a failed node's slices on replicas (reference:
+        executor.go:1149-1243)."""
+        if not opt.remote:
+            nodes = list(self.cluster.nodes)
+        else:
+            me = self.cluster.node_by_host(self.host)
+            nodes = [me] if me is not None else [Node(host=self.host)]
+        if not nodes:
+            nodes = [Node(host=self.host)]
+
+        result = None
+        pending = [(nodes, slices)]
+        while pending:
+            nodes, want = pending.pop()
+            if not want and not slices:
+                # Sliceless execution still runs locally once.
+                resp = self._map_node(Node(host=self.host), [], index, c, opt, map_fn)
+                if resp.error:
+                    raise resp.error
+                result = reduce_fn(result, resp.result)
+                break
+            m = self._slices_by_node(nodes, index, want)
+            futures = {
+                self._pool.submit(self._map_node, node, node_slices, index, c, opt, map_fn)
+                for _, (node, node_slices) in m.items()
+            }
+            for fut in futures:
+                resp = fut.result()
+                if resp.error is not None:
+                    remaining = [n for n in nodes if n.host != resp.node.host]
+                    try:
+                        self._slices_by_node(remaining, index, resp.slices)
+                    except SliceUnavailableError:
+                        raise resp.error
+                    pending.append((remaining, resp.slices))
+                    continue
+                result = reduce_fn(result, resp.result)
+        return result
+
+    def _map_node(self, node, node_slices, index, c, opt, map_fn) -> _MapResponse:
+        resp = _MapResponse(node=node, slices=node_slices)
+        try:
+            if node.host == self.host:
+                resp.result = map_fn(node_slices)
+            else:
+                results = self._exec_remote(
+                    node, index, Query(calls=[c]), node_slices, opt
+                )
+                resp.result = results[0] if results else None
+        except Exception as e:  # noqa: BLE001 — failover boundary
+            resp.error = e
+        return resp
+
+    def _exec_remote(self, node, index, q, slices, opt) -> list:
+        """Forward a query to a peer (reference: executor.go:1045-1129)."""
+        if self.client_factory is None:
+            raise ExecutorError(f"no client for remote node {node.host}")
+        client = self.client_factory(node)
+        return client.execute_query(index, str(q), slices, remote=True)
+
+
+# ---------------------------------------------------------------------------
+
+
+def _uint_arg(c: Call, key: str) -> tuple[int, bool]:
+    """(value, present) via Call.uint_arg (negative int64s wrap to
+    uint64, so e.g. rowID=-1 reads an empty astronomically-high row
+    instead of erroring), with type errors normalized to ExecutorError
+    at the API boundary."""
+    try:
+        v = c.uint_arg(key)
+    except TypeError as e:
+        raise ExecutorError(str(e)) from e
+    return (0, False) if v is None else (v, True)
+
+
+def _uint_slice_arg(c: Call, key: str) -> list[int] | None:
+    try:
+        return c.uint_slice_arg(key)
+    except TypeError as e:
+        raise ExecutorError(str(e)) from e
+
+
+def _time_arg(c: Call, key: str) -> datetime:
+    v = c.args.get(key)
+    if not isinstance(v, str):
+        raise ExecutorError(f"Range() {key} time required")
+    try:
+        return datetime.strptime(v, TIME_FORMAT)
+    except ValueError:
+        raise ExecutorError(f"cannot parse Range() {key} time")

@@ -1,0 +1,107 @@
+"""Query planning: PQL call trees -> fused XLA programs.
+
+The reference interprets a call tree per slice, materializing a roaring
+bitmap at every node and dispatching per-container merge kernels
+(reference: executor.go:263-278 executeBitmapCallSlice and the roaring
+kernels under it).  On TPU that structure would bounce every intermediate
+through HBM; instead each *tree shape* compiles once to a single jitted
+function over a stack of leaf rows:
+
+    Count(Intersect(Bitmap(a), Bitmap(b)))
+      -> fn(leaves: uint32[2, 32768]) = popcount_sum(leaves[0] & leaves[1])
+
+XLA fuses the whole expression (bitwise ops + popcount + reduce) into one
+kernel, so no intermediate row ever materializes.  Shapes are static:
+every leaf is one slice-row (32768 uint32 words), so one compilation per
+(tree-shape, reduce-kind) serves every slice and every rowID — query
+shape bucketing per SURVEY.md §7 "dynamic shapes".
+
+Leaf calls are ``Bitmap`` and ``Range`` (row fetches); interior calls are
+``Intersect``/``Union``/``Difference`` (left-fold, reference:
+executor.go:418-434,486-505,621-637).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from pilosa_tpu.pql.parser import Call
+
+# Calls that fetch rows (leaves of a bitmap expression).
+LEAF_CALLS = frozenset({"Bitmap", "Range"})
+# Interior set-algebra calls and their fold ops.
+FOLD_CALLS = frozenset({"Intersect", "Union", "Difference", "Xor"})
+
+
+class PlanError(ValueError):
+    pass
+
+
+def decompose(call: Call) -> tuple[tuple, list[Call]]:
+    """Flatten a bitmap call tree into a hashable structure + leaf calls.
+
+    Returns ``(expr, leaves)`` where ``expr`` is a nested tuple — ``("leaf",
+    i)`` referencing ``leaves[i]``, or ``(op, child_exprs...)`` — usable as
+    a jit cache key.
+    """
+    leaves: list[Call] = []
+
+    def rec(c: Call) -> tuple:
+        if c.name in LEAF_CALLS:
+            idx = len(leaves)
+            leaves.append(c)
+            return ("leaf", idx)
+        if c.name not in FOLD_CALLS:
+            raise PlanError(f"unknown call: {c.name}")
+        if c.name in ("Intersect", "Difference") and not c.children:
+            raise PlanError(f"empty {c.name} query is currently not supported")
+        return (c.name,) + tuple(rec(ch) for ch in c.children)
+
+    return rec(call), leaves
+
+
+def _eval_expr(expr: tuple, leaves):
+    if expr[0] == "leaf":
+        return leaves[expr[1]]
+    name = expr[0]
+    children = [_eval_expr(e, leaves) for e in expr[1:]]
+    if name == "Union" and not children:
+        return jnp.zeros(leaves.shape[1:], dtype=leaves.dtype)
+    acc = children[0]
+    for nxt in children[1:]:
+        if name == "Intersect":
+            acc = acc & nxt
+        elif name == "Union":
+            acc = acc | nxt
+        elif name == "Difference":
+            acc = acc & ~nxt
+        elif name == "Xor":
+            acc = acc ^ nxt
+    return acc
+
+
+def _make_fn(expr: tuple, reduce: str):
+    """``reduce``: ``"row"`` returns the uint32[32768] result row;
+    ``"count"`` returns the int32 popcount of the result (never
+    materializing it)."""
+
+    def fn(leaf_stack):
+        out = _eval_expr(expr, leaf_stack)
+        if reduce == "count":
+            return jnp.sum(jax.lax.population_count(out).astype(jnp.int32))
+        return out
+
+    return fn
+
+
+@functools.lru_cache(maxsize=512)
+def compiled_batched(expr: tuple, reduce: str):
+    """One jitted program per (tree shape, reduce kind), vmapped over a
+    leading slice axis — input uint32[n_slices, n_leaves, 32768].  All of
+    a node's local slices evaluate in ONE device program (the TPU-shaped
+    equivalent of the reference's goroutine-per-slice mapperLocal,
+    reference: executor.go:1246-1282)."""
+    return jax.jit(jax.vmap(_make_fn(expr, reduce)))

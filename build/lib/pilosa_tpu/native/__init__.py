@@ -1,0 +1,209 @@
+"""Native runtime components — build + ctypes bindings.
+
+Compiles ``roaring_native.cpp`` into a shared library on first use
+(g++ -O3, rebuilt when the source is newer than the binary) and exposes
+ctypes wrappers.  Everything here has a pure-Python fallback in
+``pilosa_tpu/ops/roaring.py``; parity tests keep the two byte-identical.
+
+``PILOSA_TPU_DISABLE_NATIVE=1`` forces the Python paths.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "roaring_native.cpp")
+_SO = os.path.join(_DIR, "libpilosa_native.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_failed = False
+
+
+def _build() -> bool:
+    # Per-process temp name: concurrent builders (server + ctl import on
+    # a fresh checkout) must not interleave writes before the atomic
+    # rename.
+    tmp = f"{_SO}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", tmp, _SRC]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO)
+        return True
+    except (subprocess.SubprocessError, OSError):
+        return False
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+
+def lib() -> ctypes.CDLL | None:
+    """The loaded native library, building it if needed; None when
+    disabled or the toolchain is unavailable."""
+    global _lib, _failed
+    if _lib is not None:
+        return _lib
+    if _failed or os.environ.get("PILOSA_TPU_DISABLE_NATIVE"):
+        return None
+    with _lock:
+        if _lib is not None or _failed:
+            return _lib
+        try:
+            stale = (
+                not os.path.exists(_SO)
+                or os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+            )
+            if stale and not _build():
+                _failed = True
+                return None
+            l = ctypes.CDLL(_SO)
+        except OSError:
+            _failed = True
+            return None
+        l.ptpu_decode.restype = ctypes.c_void_p
+        l.ptpu_decode.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+        l.ptpu_error.restype = ctypes.c_char_p
+        l.ptpu_error.argtypes = [ctypes.c_void_p]
+        l.ptpu_nkeys.restype = ctypes.c_int64
+        l.ptpu_nkeys.argtypes = [ctypes.c_void_p]
+        l.ptpu_ops.restype = ctypes.c_int64
+        l.ptpu_ops.argtypes = [ctypes.c_void_p]
+        l.ptpu_extract.restype = None
+        l.ptpu_extract.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        l.ptpu_free.restype = None
+        l.ptpu_free.argtypes = [ctypes.c_void_p]
+        l.ptpu_encode_size.restype = ctypes.c_int64
+        l.ptpu_encode_size.argtypes = [
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_int64,
+        ]
+        l.ptpu_encode.restype = ctypes.c_int64
+        l.ptpu_encode.argtypes = [
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_int64,
+            ctypes.c_char_p,
+            ctypes.c_int64,
+        ]
+        l.ptpu_encode_op.restype = None
+        l.ptpu_encode_op.argtypes = [
+            ctypes.c_uint8,
+            ctypes.c_uint64,
+            ctypes.c_char_p,
+        ]
+        l.ptpu_parse_csv.restype = ctypes.c_int64
+        l.ptpu_parse_csv.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_int64,
+        ]
+        _lib = l
+        return _lib
+
+
+def available() -> bool:
+    return lib() is not None
+
+
+# ---------------------------------------------------------------------------
+# high-level wrappers (None return = use the Python fallback)
+# ---------------------------------------------------------------------------
+
+
+class NativeCorruptError(ValueError):
+    pass
+
+
+def decode(data: bytes):
+    """Roaring file -> ({key: uint64[1024]}, op_count) or None."""
+    l = lib()
+    if l is None:
+        return None
+    h = l.ptpu_decode(data, len(data))
+    try:
+        err = l.ptpu_error(h)
+        if err is not None:
+            raise NativeCorruptError(err.decode())
+        nkeys = l.ptpu_nkeys(h)
+        ops = l.ptpu_ops(h)
+        keys = np.zeros(nkeys, dtype=np.uint64)
+        words = np.zeros(nkeys * 1024, dtype=np.uint64)
+        if nkeys:
+            l.ptpu_extract(
+                h,
+                keys.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                words.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            )
+        containers = {
+            int(keys[i]): words[i * 1024 : (i + 1) * 1024] for i in range(nkeys)
+        }
+        return containers, int(ops)
+    finally:
+        l.ptpu_free(h)
+
+
+def encode(containers: dict[int, np.ndarray]) -> bytes | None:
+    l = lib()
+    if l is None:
+        return None
+    keys = np.array(sorted(containers), dtype=np.uint64)
+    nkeys = len(keys)
+    words = np.zeros(nkeys * 1024, dtype=np.uint64)
+    for i, k in enumerate(keys):
+        words[i * 1024 : (i + 1) * 1024] = np.asarray(
+            containers[int(k)], dtype=np.uint64
+        )
+    kp = keys.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))
+    wp = words.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))
+    size = l.ptpu_encode_size(kp, wp, nkeys)
+    out = ctypes.create_string_buffer(max(size, 1))
+    n = l.ptpu_encode(kp, wp, nkeys, out, size)
+    if n < 0:
+        return None
+    return out.raw[:n]
+
+
+def encode_op(typ: int, value: int) -> bytes | None:
+    l = lib()
+    if l is None:
+        return None
+    out = ctypes.create_string_buffer(13)
+    l.ptpu_encode_op(typ, value, out)
+    return out.raw
+
+
+def parse_csv(data: bytes):
+    """Parse 2-column \"row,col\" CSV -> (rows u64[], cols u64[]) or
+    None (unavailable / has timestamps / malformed -> Python csv)."""
+    l = lib()
+    if l is None:
+        return None
+    cap = data.count(b"\n") + 2
+    rows = np.zeros(cap, dtype=np.uint64)
+    cols = np.zeros(cap, dtype=np.uint64)
+    n = l.ptpu_parse_csv(
+        data,
+        len(data),
+        rows.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        cols.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        cap,
+    )
+    if n < 0:
+        return None
+    return rows[:n], cols[:n]

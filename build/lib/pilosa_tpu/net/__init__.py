@@ -1,0 +1,7 @@
+"""net — the HTTP+protobuf surface: handler, internal client, server.
+
+External compatibility layer: the route table, wire messages, and JSON
+shapes match the reference server (reference: handler.go, client.go,
+internal/*.proto), so existing clients and multi-node deployments keep
+working while the data plane underneath runs on XLA.
+"""

@@ -1,0 +1,189 @@
+"""Wire codecs — internal types <-> protobuf / JSON.
+
+Converts between the framework's result types (RowBitmap, Pair, attrs)
+and the HTTP API's two content types, reproducing the reference's
+polymorphic QueryResult encoding (reference: handler.go:1380-1470,
+bitmap.go:220-268, attr.go:256-303).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from pilosa_tpu.core.bitmap import RowBitmap
+from pilosa_tpu.core.cache import Pair
+from pilosa_tpu.net import wire_pb2 as wire
+from pilosa_tpu.ops import bitplane as bp
+
+# Attr value type tags (reference: attr.go:34-40)
+ATTR_TYPE_STRING = 1
+ATTR_TYPE_INT = 2
+ATTR_TYPE_BOOL = 3
+ATTR_TYPE_FLOAT = 4
+
+_U64_MASK = (1 << 64) - 1
+
+
+def _u64(v: int) -> int:
+    return v & _U64_MASK
+
+
+# ---------------------------------------------------------------------------
+# attrs
+# ---------------------------------------------------------------------------
+
+
+def attrs_to_proto(attrs: dict[str, Any]) -> list[wire.Attr]:
+    """Sorted-by-key Attr list (reference: attr.go:256-276)."""
+    out = []
+    for k in sorted(attrs):
+        v = attrs[k]
+        a = wire.Attr(Key=k)
+        # bool must be tested before int (bool subclasses int in Python).
+        if isinstance(v, bool):
+            a.Type = ATTR_TYPE_BOOL
+            a.BoolValue = v
+        elif isinstance(v, str):
+            a.Type = ATTR_TYPE_STRING
+            a.StringValue = v
+        elif isinstance(v, int):
+            a.Type = ATTR_TYPE_INT
+            a.IntValue = v
+        elif isinstance(v, float):
+            a.Type = ATTR_TYPE_FLOAT
+            a.FloatValue = v
+        else:
+            raise TypeError(f"unrecognized attribute type: {type(v).__name__}")
+        out.append(a)
+    return out
+
+
+def attrs_from_proto(pb_attrs) -> dict[str, Any]:
+    """reference: attr.go:279-303"""
+    out: dict[str, Any] = {}
+    for a in pb_attrs:
+        if a.Type == ATTR_TYPE_STRING:
+            out[a.Key] = a.StringValue
+        elif a.Type == ATTR_TYPE_INT:
+            out[a.Key] = a.IntValue
+        elif a.Type == ATTR_TYPE_BOOL:
+            out[a.Key] = a.BoolValue
+        elif a.Type == ATTR_TYPE_FLOAT:
+            out[a.Key] = a.FloatValue
+    return out
+
+
+# ---------------------------------------------------------------------------
+# RowBitmap
+# ---------------------------------------------------------------------------
+
+
+def bitmap_to_proto(b: RowBitmap) -> wire.Bitmap:
+    """Flat absolute-column bit list (reference: bitmap.go:245-255)."""
+    pb = wire.Bitmap()
+    for s in sorted(b.segments):
+        offs = bp.np_row_to_columns(np.asarray(b.segments[s]))
+        base = s * bp.SLICE_WIDTH
+        pb.Bits.extend(int(o) + base for o in offs)
+    if b.attrs:
+        pb.Attrs.extend(attrs_to_proto(b.attrs))
+    return pb
+
+
+def bitmap_from_proto(pb: wire.Bitmap) -> RowBitmap:
+    """reference: bitmap.go:258-268"""
+    b = RowBitmap.from_bits(pb.Bits)
+    b.attrs = attrs_from_proto(pb.Attrs)
+    return b
+
+
+def bitmap_to_json(b: RowBitmap) -> dict:
+    """JSON shape {"attrs": {...}, "bits": [...]} (reference:
+    bitmap.go:220-233)."""
+    bits: list[int] = []
+    for s in sorted(b.segments):
+        offs = bp.np_row_to_columns(np.asarray(b.segments[s]))
+        base = s * bp.SLICE_WIDTH
+        bits.extend(int(o) + base for o in offs)
+    return {"attrs": b.attrs or {}, "bits": bits}
+
+
+# ---------------------------------------------------------------------------
+# QueryResult / QueryResponse
+# ---------------------------------------------------------------------------
+
+
+def result_to_proto(result: Any) -> wire.QueryResult:
+    """Polymorphic result encode (reference: handler.go:1444-1470).
+
+    RowBitmap -> Bitmap; [Pair] -> Pairs; int -> N; bool -> Changed;
+    None -> empty result.
+    """
+    pb = wire.QueryResult()
+    if isinstance(result, RowBitmap):
+        pb.Bitmap.CopyFrom(bitmap_to_proto(result))
+    elif isinstance(result, bool):
+        pb.Changed = result
+    elif isinstance(result, (int, np.integer)):
+        pb.N = _u64(int(result))
+    elif isinstance(result, list):
+        for p in result:
+            pb.Pairs.append(wire.Pair(Key=_u64(p.id), Count=_u64(p.count)))
+    elif result is not None:
+        raise TypeError(f"unknown query result type: {type(result).__name__}")
+    return pb
+
+
+def result_from_proto(pb: wire.QueryResult) -> Any:
+    """Inverse of result_to_proto (reference: client.go:283-301)."""
+    if pb.HasField("Bitmap"):
+        return bitmap_from_proto(pb.Bitmap)
+    if pb.Pairs:
+        return [Pair(id=p.Key, count=p.Count) for p in pb.Pairs]
+    if pb.Changed:
+        return True
+    if pb.N:
+        return int(pb.N)
+    # Ambiguity of the reference's sparse encoding: an absent field set
+    # means 0 / False / nil; prefer 0 (counts dominate reads).
+    return 0 if not pb.HasField("Bitmap") else None
+
+
+def result_to_json(result: Any) -> Any:
+    if isinstance(result, RowBitmap):
+        return bitmap_to_json(result)
+    if isinstance(result, list):
+        return [{"id": _u64(p.id), "count": _u64(p.count)} for p in result]
+    if isinstance(result, (int, np.integer)) and not isinstance(result, bool):
+        return int(result)
+    return result
+
+
+def response_to_proto(
+    results: list[Any],
+    column_attr_sets: list[tuple[int, dict[str, Any]]] | None = None,
+    err: str = "",
+) -> wire.QueryResponse:
+    pb = wire.QueryResponse(Err=err)
+    for r in results or []:
+        pb.Results.append(result_to_proto(r))
+    for id_, attrs in column_attr_sets or []:
+        pb.ColumnAttrSets.append(
+            wire.ColumnAttrSet(ID=_u64(id_), Attrs=attrs_to_proto(attrs))
+        )
+    return pb
+
+
+def response_to_json(
+    results: list[Any],
+    column_attr_sets: list[tuple[int, dict[str, Any]]] | None = None,
+) -> dict:
+    """reference: handler.go:216-280 JSON shape."""
+    out: dict[str, Any] = {"results": [result_to_json(r) for r in results or []]}
+    if column_attr_sets is not None:
+        out["columnAttrs"] = [
+            {"id": _u64(id_), "attrs": attrs} for id_, attrs in column_attr_sets
+        ]
+    return out

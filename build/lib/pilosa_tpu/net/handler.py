@@ -1,0 +1,778 @@
+"""HTTP API — the full REST surface of the framework.
+
+Route table and response shapes reproduce the reference's handler
+(reference: handler.go:93-133 router, :1380-1470 codecs) so external
+clients of the reference server work unchanged:
+
+  GET    /                                  web console
+  GET    /assets/{file}                     console assets
+  GET    /schema | /index                   schema listing
+  GET    /status /hosts /version            introspection
+  GET    /slices/max                        per-index max slice (json|proto)
+  GET/POST/DELETE /index/{i}                index CRUD
+  POST   /index/{i}/query                   PQL execution (body = raw PQL
+                                            or protobuf QueryRequest)
+  PATCH  /index/{i}/time-quantum
+  POST   /index/{i}/attr/diff               column-attr anti-entropy
+  POST/DELETE /index/{i}/frame/{f}          frame CRUD
+  PATCH  /index/{i}/frame/{f}/time-quantum
+  GET    /index/{i}/frame/{f}/views
+  POST   /index/{i}/frame/{f}/attr/diff     row-attr anti-entropy
+  POST   /index/{i}/frame/{f}/restore       pull frame from another cluster
+  POST   /import                            protobuf bulk import
+  GET    /export                            CSV fragment export
+  GET    /fragment/nodes                    owners of a slice
+  GET/POST /fragment/data                   fragment tar backup/restore
+  GET    /fragment/blocks /fragment/block/data   sync checksums / block dump
+  GET    /debug/vars /debug/pprof/          expvar metrics / profiling info
+
+The handler itself is transport-independent: ``Handler.dispatch`` maps a
+parsed request to a ``Response``; ``serve`` mounts it on a stdlib
+ThreadingHTTPServer (the reference rides net/http + gorilla/mux).
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+import json
+import re
+import sys
+import threading
+import time
+import traceback
+import urllib.parse
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable
+
+import numpy as np
+
+from pilosa_tpu import __version__
+from pilosa_tpu.core import attr as attr_mod
+from pilosa_tpu.core import timequantum as tq
+from pilosa_tpu.core.bitmap import RowBitmap
+from pilosa_tpu.core.fragment import PairSet
+from pilosa_tpu.exec.executor import ExecOptions, TooManyWritesError
+from pilosa_tpu.net import codec
+from pilosa_tpu.net import wire_pb2 as wire
+from pilosa_tpu.ops import bitplane as bp
+from pilosa_tpu.pql.parser import parse_string
+
+PROTOBUF = "application/x-protobuf"
+JSON = "application/json"
+
+
+@dataclass
+class Request:
+    method: str
+    path: str
+    query: dict[str, str] = field(default_factory=dict)
+    headers: dict[str, str] = field(default_factory=dict)
+    body: bytes = b""
+
+    def header(self, key: str) -> str:
+        return self.headers.get(key.lower(), "")
+
+
+@dataclass
+class Response:
+    status: int = 200
+    body: bytes = b""
+    content_type: str = JSON
+
+    @classmethod
+    def json(cls, obj: Any, status: int = 200) -> "Response":
+        return cls(status=status, body=(json.dumps(obj) + "\n").encode())
+
+    @classmethod
+    def proto(cls, msg, status: int = 200) -> "Response":
+        return cls(status=status, body=msg.SerializeToString(), content_type=PROTOBUF)
+
+    @classmethod
+    def error(cls, message: str, status: int) -> "Response":
+        # reference uses http.Error (text/plain); we keep a JSON body and
+        # the same status codes.
+        return cls.json({"error": message}, status=status)
+
+
+class Handler:
+    """Routes requests to the holder/executor/cluster underneath."""
+
+    def __init__(
+        self,
+        holder=None,
+        executor=None,
+        cluster=None,
+        broadcaster=None,
+        client_factory=None,
+        version: str = __version__,
+        logger=None,
+        stats=None,
+    ):
+        self.holder = holder
+        self.executor = executor
+        self.cluster = cluster
+        self.broadcaster = broadcaster
+        self.client_factory = client_factory
+        self.version = version
+        self.logger = logger or (lambda msg: print(msg, file=sys.stderr))
+        self.stats = stats
+        # (method, compiled-regex, fn) — order matters, first match wins
+        # (reference: handler.go:93-133).
+        self._routes: list[tuple[str, re.Pattern, Callable]] = [
+            ("GET", r"/", self.handle_webui),
+            ("GET", r"/assets/(?P<file>[^/]+)", self.handle_webui_asset),
+            ("GET", r"/schema", self.handle_get_schema),
+            ("GET", r"/status", self.handle_get_status),
+            ("GET", r"/hosts", self.handle_get_hosts),
+            ("GET", r"/version", self.handle_get_version),
+            ("GET", r"/slices/max", self.handle_get_slice_max),
+            ("GET", r"/index", self.handle_get_indexes),
+            ("GET", r"/index/(?P<index>[^/]+)", self.handle_get_index),
+            ("POST", r"/index/(?P<index>[^/]+)", self.handle_post_index),
+            ("DELETE", r"/index/(?P<index>[^/]+)", self.handle_delete_index),
+            ("POST", r"/index/(?P<index>[^/]+)/query", self.handle_post_query),
+            ("PATCH", r"/index/(?P<index>[^/]+)/time-quantum", self.handle_patch_index_time_quantum),
+            ("POST", r"/index/(?P<index>[^/]+)/attr/diff", self.handle_post_index_attr_diff),
+            ("POST", r"/index/(?P<index>[^/]+)/frame/(?P<frame>[^/]+)", self.handle_post_frame),
+            ("DELETE", r"/index/(?P<index>[^/]+)/frame/(?P<frame>[^/]+)", self.handle_delete_frame),
+            ("PATCH", r"/index/(?P<index>[^/]+)/frame/(?P<frame>[^/]+)/time-quantum", self.handle_patch_frame_time_quantum),
+            ("GET", r"/index/(?P<index>[^/]+)/frame/(?P<frame>[^/]+)/views", self.handle_get_frame_views),
+            ("POST", r"/index/(?P<index>[^/]+)/frame/(?P<frame>[^/]+)/attr/diff", self.handle_post_frame_attr_diff),
+            ("POST", r"/index/(?P<index>[^/]+)/frame/(?P<frame>[^/]+)/restore", self.handle_post_frame_restore),
+            ("POST", r"/import", self.handle_post_import),
+            ("GET", r"/export", self.handle_get_export),
+            ("GET", r"/fragment/nodes", self.handle_get_fragment_nodes),
+            ("GET", r"/fragment/data", self.handle_get_fragment_data),
+            ("POST", r"/fragment/data", self.handle_post_fragment_data),
+            ("GET", r"/fragment/blocks", self.handle_get_fragment_blocks),
+            ("GET", r"/fragment/block/data", self.handle_get_fragment_block_data),
+            ("GET", r"/debug/vars", self.handle_get_vars),
+            ("GET", r"/debug/pprof(?P<rest>/.*)?", self.handle_get_pprof),
+        ]
+        self._compiled = [
+            (m, re.compile("^" + p + "$"), fn) for m, p, fn in self._routes
+        ]
+        self._start_time = time.time()
+
+    # ------------------------------------------------------------------
+    # dispatch
+    # ------------------------------------------------------------------
+
+    def dispatch(self, req: Request) -> Response:
+        t0 = time.monotonic()
+        try:
+            for method, pattern, fn in self._compiled:
+                m = pattern.match(req.path.rstrip("/") or "/")
+                if m and method == req.method:
+                    resp = fn(req, **m.groupdict())
+                    break
+            else:
+                resp = Response.error("not found", 404)
+        except Exception as e:  # noqa: BLE001 — API boundary
+            self.logger(f"handler error {req.method} {req.path}: {e}\n"
+                        + traceback.format_exc())
+            resp = Response.error(str(e), 500)
+        elapsed = time.monotonic() - t0
+        # Metrics and logging never drop a response, and a failing stats
+        # backend must not silence the slow-query log: each observes
+        # independently.
+        try:
+            self._observe_stats(req, elapsed)
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            self._observe_slow_query(req, elapsed)
+        except Exception:  # noqa: BLE001
+            pass
+        return resp
+
+    def _observe_stats(self, req: Request, elapsed: float) -> None:
+        if self.stats is not None:
+            # per-endpoint latency histogram (reference: handler.go:140-167)
+            self.stats.histogram(
+                f"http.{req.method}.{req.path.split('?')[0]}", elapsed * 1000.0
+            )
+
+    def _observe_slow_query(self, req: Request, elapsed: float) -> None:
+        # slow-query log gated by cluster.long-query-time
+        # (reference: handler.go:158-163); exact route match so frames
+        # legally named "query" don't trigger it
+        lqt = getattr(self.cluster, "long_query_time", 0.0) if self.cluster else 0.0
+        is_query_route = req.method == "POST" and bool(
+            re.match(r"^/index/[^/]+/query$", req.path)
+        )
+        if float(lqt) > 0 and elapsed > float(lqt) and is_query_route:
+            if req.header("Content-Type") == PROTOBUF:
+                try:
+                    pb = wire.QueryRequest()
+                    pb.ParseFromString(req.body)
+                    query_text = pb.Query
+                except Exception:  # noqa: BLE001 — logging only
+                    query_text = "<unparseable protobuf>"
+            else:
+                query_text = req.body[:512].decode(errors="replace")
+            self.logger(f"slow query {elapsed:.3f}s: {query_text[:512]}")
+
+    # ------------------------------------------------------------------
+    # introspection
+    # ------------------------------------------------------------------
+
+    def handle_webui(self, req: Request) -> Response:
+        from pilosa_tpu.net import webui
+
+        return Response(body=webui.INDEX_HTML.encode(), content_type="text/html")
+
+    def handle_webui_asset(self, req: Request, file: str) -> Response:
+        from pilosa_tpu.net import webui
+
+        asset = webui.ASSETS.get(file)
+        if asset is None:
+            return Response.error("not found", 404)
+        body, ctype = asset
+        return Response(body=body.encode(), content_type=ctype)
+
+    def handle_get_schema(self, req: Request) -> Response:
+        return Response.json({"indexes": self.holder.schema()})
+
+    def handle_get_indexes(self, req: Request) -> Response:
+        return self.handle_get_schema(req)
+
+    def handle_get_status(self, req: Request) -> Response:
+        status = {
+            "Nodes": [
+                {
+                    "Host": n.host,
+                    "State": n.state,
+                    "Indexes": self.holder.schema() if n.host == getattr(self.executor, "host", None) else [],
+                }
+                for n in (self.cluster.nodes if self.cluster else [])
+            ]
+        }
+        return Response.json({"status": status})
+
+    def handle_get_hosts(self, req: Request) -> Response:
+        return Response.json([n.to_dict() for n in self.cluster.nodes])
+
+    def handle_get_version(self, req: Request) -> Response:
+        return Response.json({"version": self.version})
+
+    def handle_get_slice_max(self, req: Request) -> Response:
+        inverse = req.query.get("inverse") == "true"
+        ms = (
+            self.holder.max_inverse_slices()
+            if inverse
+            else self.holder.max_slices()
+        )
+        if PROTOBUF in req.header("Accept"):
+            pb = wire.MaxSlicesResponse()
+            for k, v in ms.items():
+                pb.MaxSlices[k] = v
+            return Response.proto(pb)
+        return Response.json({"maxSlices": ms})
+
+    # ------------------------------------------------------------------
+    # index CRUD
+    # ------------------------------------------------------------------
+
+    def handle_get_index(self, req: Request, index: str) -> Response:
+        idx = self.holder.index(index)
+        if idx is None:
+            return Response.error("index not found", 404)
+        return Response.json({"index": {"name": idx.name}})
+
+    def handle_post_index(self, req: Request, index: str) -> Response:
+        options = {}
+        if req.body:
+            try:
+                payload = json.loads(req.body)
+            except json.JSONDecodeError as e:
+                return Response.error(str(e), 400)
+            options = payload.get("options", {}) or {}
+        kwargs = {}
+        if "columnLabel" in options:
+            kwargs["column_label"] = options["columnLabel"]
+        if "timeQuantum" in options:
+            kwargs["time_quantum"] = options["timeQuantum"]
+        if self.holder.index(index) is not None:
+            return Response.error("index already exists", 409)
+        try:
+            idx = self.holder.create_index(index, **kwargs)
+        except ValueError as e:
+            return Response.error(str(e), 400)
+        self._broadcast(
+            wire.CreateIndexMessage(
+                Index=index,
+                Meta=wire.IndexMeta(
+                    ColumnLabel=idx.column_label, TimeQuantum=idx.time_quantum
+                ),
+            )
+        )
+        return Response.json({})
+
+    def handle_delete_index(self, req: Request, index: str) -> Response:
+        self.holder.delete_index(index)
+        self._broadcast(wire.DeleteIndexMessage(Index=index))
+        return Response.json({})
+
+    def handle_patch_index_time_quantum(self, req: Request, index: str) -> Response:
+        try:
+            payload = json.loads(req.body)
+        except json.JSONDecodeError as e:
+            return Response.error(str(e), 400)
+        try:
+            q = tq.parse_time_quantum(payload.get("timeQuantum", ""))
+        except ValueError:
+            return Response.error("invalid time quantum", 400)
+        idx = self.holder.index(index)
+        if idx is None:
+            return Response.error("index not found", 404)
+        idx.set_time_quantum(q)
+        return Response.json({})
+
+    def handle_post_index_attr_diff(self, req: Request, index: str) -> Response:
+        idx = self.holder.index(index)
+        if idx is None:
+            return Response.error("index not found", 404)
+        return self._attr_diff(req, idx.column_attr_store)
+
+    # ------------------------------------------------------------------
+    # frame CRUD
+    # ------------------------------------------------------------------
+
+    def handle_post_frame(self, req: Request, index: str, frame: str) -> Response:
+        idx = self.holder.index(index)
+        if idx is None:
+            return Response.error("index not found", 404)
+        options = {}
+        if req.body:
+            try:
+                payload = json.loads(req.body)
+            except json.JSONDecodeError as e:
+                return Response.error(str(e), 400)
+            options = payload.get("options", {}) or {}
+        kwargs = {}
+        for json_key, py_key in (
+            ("rowLabel", "row_label"),
+            ("inverseEnabled", "inverse_enabled"),
+            ("cacheType", "cache_type"),
+            ("cacheSize", "cache_size"),
+            ("timeQuantum", "time_quantum"),
+        ):
+            if json_key in options:
+                kwargs[py_key] = options[json_key]
+        if idx.frame(frame) is not None:
+            return Response.error("frame already exists", 409)
+        try:
+            f = idx.create_frame(frame, **kwargs)
+        except (ValueError, RuntimeError) as e:
+            return Response.error(str(e), 400)
+        self._broadcast(
+            wire.CreateFrameMessage(
+                Index=index, Frame=frame, Meta=_frame_meta_proto(f)
+            )
+        )
+        return Response.json({})
+
+    def handle_delete_frame(self, req: Request, index: str, frame: str) -> Response:
+        idx = self.holder.index(index)
+        if idx is None:
+            return Response.error("index not found", 404)
+        idx.delete_frame(frame)
+        self._broadcast(wire.DeleteFrameMessage(Index=index, Frame=frame))
+        return Response.json({})
+
+    def handle_patch_frame_time_quantum(
+        self, req: Request, index: str, frame: str
+    ) -> Response:
+        try:
+            payload = json.loads(req.body)
+        except json.JSONDecodeError as e:
+            return Response.error(str(e), 400)
+        try:
+            q = tq.parse_time_quantum(payload.get("timeQuantum", ""))
+        except ValueError:
+            return Response.error("invalid time quantum", 400)
+        f = self.holder.frame(index, frame)
+        if f is None:
+            return Response.error("frame not found", 404)
+        f.set_time_quantum(q)
+        return Response.json({})
+
+    def handle_get_frame_views(self, req: Request, index: str, frame: str) -> Response:
+        f = self.holder.frame(index, frame)
+        if f is None:
+            return Response.error("frame not found", 404)
+        return Response.json({"views": sorted(f.views().keys())})
+
+    def handle_post_frame_attr_diff(
+        self, req: Request, index: str, frame: str
+    ) -> Response:
+        f = self.holder.frame(index, frame)
+        if f is None:
+            return Response.error("frame not found", 404)
+        return self._attr_diff(req, f.row_attr_store)
+
+    def handle_post_frame_restore(
+        self, req: Request, index: str, frame: str
+    ) -> Response:
+        """Pull every slice of a frame from a remote cluster
+        (reference: handler.go:1253-1341)."""
+        host = req.query.get("host")
+        if not host:
+            return Response.error("host required", 400)
+        f = self.holder.frame(index, frame)
+        if f is None:
+            return Response.error("frame not found", 404)
+        if self.client_factory is None:
+            return Response.error("no client", 500)
+        client = self.client_factory(host)
+        max_slices = client.max_slice_by_index()
+        max_inverse = client.max_slice_by_index(inverse=True)
+        for view_name in client.frame_views(index, frame):
+            from pilosa_tpu.core.view import is_inverse_view
+
+            ms = (
+                max_inverse.get(index, 0)
+                if is_inverse_view(view_name)
+                else max_slices.get(index, 0)
+            )
+            for slice_i in range(ms + 1):
+                view = f.create_view_if_not_exists(view_name)
+                frag = view.create_fragment_if_not_exists(slice_i)
+                data = client.backup_slice(index, frame, view_name, slice_i)
+                if data is None:
+                    continue
+                frag.read_from(io.BytesIO(data))
+        return Response.json({})
+
+    # ------------------------------------------------------------------
+    # query
+    # ------------------------------------------------------------------
+
+    def handle_post_query(self, req: Request, index: str) -> Response:
+        try:
+            qreq = self._read_query_request(req)
+        except ValueError as e:
+            return self._query_error(req, str(e), 400)
+        try:
+            q = parse_string(qreq["query"])
+        except Exception as e:  # parser error
+            return self._query_error(req, str(e), 400)
+        opt = ExecOptions(remote=qreq["remote"])
+        try:
+            results = self.executor.execute(index, q, qreq["slices"], opt)
+        except TooManyWritesError as e:
+            return self._query_error(req, str(e), 413)
+        except Exception as e:  # noqa: BLE001 — executor boundary
+            return self._query_error(req, str(e), 500)
+
+        column_attr_sets = None
+        if qreq["column_attrs"]:
+            idx = self.holder.index(index)
+            column_ids: list[int] = []
+            for r in results:
+                if isinstance(r, RowBitmap):
+                    bits = codec.bitmap_to_json(r)["bits"]
+                    column_ids = sorted(set(column_ids) | set(bits))
+            column_attr_sets = []
+            if idx is not None:
+                for cid in column_ids:
+                    attrs = idx.column_attr_store.attrs(cid)
+                    if attrs:
+                        column_attr_sets.append((cid, attrs))
+
+        if PROTOBUF in req.header("Accept"):
+            return Response.proto(
+                codec.response_to_proto(results, column_attr_sets)
+            )
+        return Response.json(codec.response_to_json(results, column_attr_sets))
+
+    def _read_query_request(self, req: Request) -> dict:
+        """reference: handler.go:863-944"""
+        if req.header("Content-Type") == PROTOBUF:
+            pb = wire.QueryRequest()
+            pb.ParseFromString(req.body)
+            return {
+                "query": pb.Query,
+                "slices": list(pb.Slices) or None,
+                "column_attrs": pb.ColumnAttrs,
+                "quantum": pb.Quantum or "YMDH",
+                "remote": pb.Remote,
+            }
+        valid = {"slices", "columnAttrs", "time_granularity"}
+        for key in req.query:
+            if key not in valid:
+                raise ValueError("invalid query params")
+        slices = None
+        if req.query.get("slices"):
+            try:
+                slices = [int(s) for s in req.query["slices"].split(",")]
+            except ValueError:
+                raise ValueError("invalid slice argument")
+        quantum = "YMDH"
+        if req.query.get("time_granularity"):
+            try:
+                quantum = tq.parse_time_quantum(req.query["time_granularity"])
+            except ValueError:
+                raise ValueError("invalid time granularity")
+        return {
+            "query": req.body.decode(),
+            "slices": slices,
+            "column_attrs": req.query.get("columnAttrs") == "true",
+            "quantum": quantum,
+            "remote": False,
+        }
+
+    def _query_error(self, req: Request, message: str, status: int) -> Response:
+        if PROTOBUF in req.header("Accept"):
+            return Response.proto(wire.QueryResponse(Err=message), status=status)
+        return Response.json({"error": message}, status=status)
+
+    # ------------------------------------------------------------------
+    # import / export
+    # ------------------------------------------------------------------
+
+    def handle_post_import(self, req: Request) -> Response:
+        """reference: handler.go:969-1046"""
+        pb = wire.ImportRequest()
+        try:
+            pb.ParseFromString(req.body)
+        except Exception as e:  # noqa: BLE001
+            return Response.error(str(e), 400)
+        # Ownership guard (reference: handler.go:1004).
+        if self.cluster is not None and self.executor is not None:
+            owners = {
+                n.host for n in self.cluster.fragment_nodes(pb.Index, pb.Slice)
+            }
+            if self.executor.host not in owners:
+                return Response.error(
+                    f"host does not own slice {self.executor.host}"
+                    f" slice={pb.Slice}",
+                    412,
+                )
+        f = self.holder.frame(pb.Index, pb.Frame)
+        if f is None:
+            return Response.error("frame not found", 404)
+        timestamps = [
+            None if ts == 0 else _dt_from_unix(ts) for ts in pb.Timestamps
+        ] if pb.Timestamps else None
+        try:
+            f.import_bulk(list(pb.RowIDs), list(pb.ColumnIDs), timestamps)
+        except Exception as e:  # noqa: BLE001
+            return Response.proto(wire.ImportResponse(Err=str(e)), status=500)
+        return Response.proto(wire.ImportResponse())
+
+    def handle_get_export(self, req: Request) -> Response:
+        """CSV export of one fragment (reference: handler.go:1049-1098)."""
+        if "text/csv" not in req.header("Accept"):
+            return Response.error("not acceptable", 406)
+        index = req.query.get("index", "")
+        frame = req.query.get("frame", "")
+        view = req.query.get("view", "")
+        try:
+            slice_i = int(req.query.get("slice", ""))
+        except ValueError:
+            return Response.error("invalid slice", 400)
+        if self.cluster is not None and self.executor is not None:
+            owners = {n.host for n in self.cluster.fragment_nodes(index, slice_i)}
+            if self.executor.host not in owners:
+                return Response.error("host does not own slice", 412)
+        frag = self.holder.fragment(index, frame, view, slice_i)
+        if frag is None:
+            return Response.error("fragment not found", 404)
+        buf = io.StringIO()
+        for row_id, col_id in frag.for_each_bit():
+            buf.write(f"{row_id},{col_id}\n")
+        return Response(body=buf.getvalue().encode(), content_type="text/csv")
+
+    # ------------------------------------------------------------------
+    # fragment internals (sync/backup data plane)
+    # ------------------------------------------------------------------
+
+    def handle_get_fragment_nodes(self, req: Request) -> Response:
+        index = req.query.get("index", "")
+        try:
+            slice_i = int(req.query.get("slice", ""))
+        except ValueError:
+            return Response.error("invalid slice", 400)
+        nodes = self.cluster.fragment_nodes(index, slice_i)
+        return Response.json([n.to_dict() for n in nodes])
+
+    def _fragment_from_query(self, req: Request):
+        index = req.query.get("index", "")
+        frame = req.query.get("frame", "")
+        view = req.query.get("view", "")
+        slice_s = req.query.get("slice", "")
+        if not slice_s.isdigit():
+            return None, Response.error("slice required", 400)
+        frag = self.holder.fragment(index, frame, view, int(slice_s))
+        if frag is None:
+            return None, Response.error("fragment not found", 404)
+        return frag, None
+
+    def handle_get_fragment_data(self, req: Request) -> Response:
+        frag, err = self._fragment_from_query(req)
+        if err:
+            return err
+        buf = io.BytesIO()
+        frag.write_to(buf)
+        return Response(body=buf.getvalue(), content_type="application/octet-stream")
+
+    def handle_post_fragment_data(self, req: Request) -> Response:
+        index = req.query.get("index", "")
+        frame = req.query.get("frame", "")
+        view = req.query.get("view", "")
+        slice_s = req.query.get("slice", "")
+        if not slice_s.isdigit():
+            return Response.error("slice required", 400)
+        f = self.holder.frame(index, frame)
+        if f is None:
+            return Response.error("frame not found", 404)
+        vw = f.create_view_if_not_exists(view)
+        frag = vw.create_fragment_if_not_exists(int(slice_s))
+        frag.read_from(io.BytesIO(req.body))
+        return Response.json({})
+
+    def handle_get_fragment_blocks(self, req: Request) -> Response:
+        frag, err = self._fragment_from_query(req)
+        if err:
+            return err
+        blocks = [
+            {"id": bid, "checksum": base64.b64encode(chk).decode()}
+            for bid, chk in frag.blocks()
+        ]
+        return Response.json({"blocks": blocks})
+
+    def handle_get_fragment_block_data(self, req: Request) -> Response:
+        """protobuf in/out (reference: handler.go:1213-1246)."""
+        pb = wire.BlockDataRequest()
+        try:
+            pb.ParseFromString(req.body)
+        except Exception as e:  # noqa: BLE001
+            return Response.error(str(e), 400)
+        frag = self.holder.fragment(pb.Index, pb.Frame, pb.View, pb.Slice)
+        if frag is None:
+            return Response.error("fragment not found", 404)
+        ps = frag.block_data(pb.Block)
+        out = wire.BlockDataResponse()
+        out.RowIDs.extend(int(r) for r in ps.row_ids)
+        out.ColumnIDs.extend(int(c) for c in ps.column_ids)
+        return Response.proto(out)
+
+    # ------------------------------------------------------------------
+    # debug
+    # ------------------------------------------------------------------
+
+    def handle_get_vars(self, req: Request) -> Response:
+        """expvar equivalent (reference: handler.go:1360-1374)."""
+        payload: dict[str, Any] = {
+            "uptime_seconds": time.time() - self._start_time,
+            "version": self.version,
+            "threads": threading.active_count(),
+        }
+        if self.stats is not None and hasattr(self.stats, "snapshot"):
+            payload["stats"] = self.stats.snapshot()
+        return Response.json(payload)
+
+    def handle_get_pprof(self, req: Request, rest: str | None = None) -> Response:
+        """Thread-stack dump — the Python analog of /debug/pprof/goroutine
+        (full CPU profiling is via py-spy on the host)."""
+        frames = sys._current_frames()
+        out = io.StringIO()
+        for t in threading.enumerate():
+            out.write(f"thread {t.name} (daemon={t.daemon})\n")
+            fr = frames.get(t.ident)
+            if fr is not None:
+                out.write("".join(traceback.format_stack(fr)))
+            out.write("\n")
+        return Response(body=out.getvalue().encode(), content_type="text/plain")
+
+    # ------------------------------------------------------------------
+    # helpers
+    # ------------------------------------------------------------------
+
+    def _attr_diff(self, req: Request, store) -> Response:
+        """Shared column/row attr-diff logic (reference:
+        handler.go:514-570, 782-838)."""
+        try:
+            payload = json.loads(req.body)
+        except json.JSONDecodeError as e:
+            return Response.error(str(e), 400)
+        remote_blocks = [
+            (b["id"], base64.b64decode(b["checksum"]))
+            for b in payload.get("blocks", [])
+        ]
+        local_blocks = store.blocks()
+        diff_ids = attr_mod.diff_blocks(local_blocks, remote_blocks)
+        attrs: dict[str, dict] = {}
+        for bid in diff_ids:
+            for id_, a in store.block_data(bid).items():
+                attrs[str(id_)] = a
+        return Response.json({"attrs": attrs})
+
+    def _broadcast(self, msg) -> None:
+        if self.broadcaster is not None:
+            try:
+                self.broadcaster.send_sync(msg)
+            except Exception as e:  # noqa: BLE001 — broadcast is best-effort
+                self.logger(f"broadcast error: {e}")
+
+
+def _frame_meta_proto(f) -> wire.FrameMeta:
+    return wire.FrameMeta(
+        RowLabel=f.row_label,
+        InverseEnabled=f.inverse_enabled,
+        CacheType=f.cache_type,
+        CacheSize=f.cache_size,
+        TimeQuantum=f.time_quantum,
+    )
+
+
+def _dt_from_unix(ts: int):
+    """ImportRequest timestamps are Unix *nanoseconds* (reference:
+    ctl/import.go:157 stores t.UnixNano())."""
+    from datetime import datetime, timezone
+
+    return datetime.fromtimestamp(ts / 1e9, tz=timezone.utc).replace(tzinfo=None)
+
+
+# ---------------------------------------------------------------------------
+# stdlib HTTP adapter
+# ---------------------------------------------------------------------------
+
+
+def make_http_server(handler: Handler, host: str = "127.0.0.1", port: int = 0):
+    """Mount a Handler on a ThreadingHTTPServer; returns the server
+    (call .serve_forever() in a thread; .server_address has the bound
+    port when port=0)."""
+
+    class _Adapter(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def _run(self):
+            parsed = urllib.parse.urlsplit(self.path)
+            query = dict(urllib.parse.parse_qsl(parsed.query))
+            length = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(length) if length else b""
+            req = Request(
+                method=self.command,
+                path=parsed.path,
+                query=query,
+                headers={k.lower(): v for k, v in self.headers.items()},
+                body=body,
+            )
+            resp = handler.dispatch(req)
+            self.send_response(resp.status)
+            self.send_header("Content-Type", resp.content_type)
+            self.send_header("Content-Length", str(len(resp.body)))
+            self.end_headers()
+            self.wfile.write(resp.body)
+
+        do_GET = do_POST = do_DELETE = do_PATCH = _run
+
+        def log_message(self, fmt, *args):  # quiet
+            pass
+
+    return ThreadingHTTPServer((host, port), _Adapter)

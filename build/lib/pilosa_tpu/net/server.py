@@ -235,10 +235,10 @@ class Server:
 
             for i, dev in enumerate(jax.local_devices()):
                 ms = getattr(dev, "memory_stats", None)
-                mem = ms() if callable(ms) else None
-                if mem and "bytes_in_use" in mem:
+                stats = ms() if callable(ms) else None
+                if stats and "bytes_in_use" in stats:
                     self.stats.gauge(
-                        f"device.{i}.hbm_bytes_in_use", mem["bytes_in_use"]
+                        f"device.{i}.hbm_bytes_in_use", stats["bytes_in_use"]
                     )
         except Exception:  # noqa: BLE001 — device stats are best-effort
             pass

@@ -1,0 +1,100 @@
+"""Embedded web console.
+
+A single-page query console served from `/` — the counterpart of the
+reference's statik-embedded WebUI (reference: webui/index.html,
+webui/assets/main.js, handler.go:169-182).  Re-written from scratch:
+query box POSTs PQL to /index/<index>/query, cluster state from
+/status, schema browser from /schema.
+"""
+
+INDEX_HTML = """<!DOCTYPE html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>pilosa-tpu console</title>
+<link rel="stylesheet" href="/assets/main.css">
+</head>
+<body>
+<header><h1>pilosa-tpu</h1><span id="version"></span></header>
+<main>
+  <section id="query-section">
+    <h2>Query</h2>
+    <div class="row">
+      <input id="index-name" placeholder="index" value="">
+      <button id="run">Run &#9654;</button>
+    </div>
+    <textarea id="query" rows="4"
+      placeholder="Count(Bitmap(frame='f', rowID=1))"></textarea>
+    <pre id="output"></pre>
+  </section>
+  <section id="schema-section">
+    <h2>Schema</h2>
+    <pre id="schema"></pre>
+  </section>
+  <section id="cluster-section">
+    <h2>Cluster</h2>
+    <pre id="cluster"></pre>
+  </section>
+</main>
+<script src="/assets/main.js"></script>
+</body>
+</html>
+"""
+
+MAIN_JS = """'use strict';
+function get(url, cb) {
+  fetch(url).then(function (r) { return r.json(); }).then(cb)
+    .catch(function (e) { console.error(url, e); });
+}
+function refresh() {
+  get('/version', function (v) {
+    document.getElementById('version').textContent = 'v' + v.version;
+  });
+  get('/schema', function (s) {
+    document.getElementById('schema').textContent =
+      JSON.stringify(s.indexes, null, 2);
+    var first = s.indexes && s.indexes[0];
+    var input = document.getElementById('index-name');
+    if (first && !input.value) input.value = first.name;
+  });
+  get('/status', function (s) {
+    document.getElementById('cluster').textContent =
+      JSON.stringify(s.status, null, 2);
+  });
+}
+document.getElementById('run').addEventListener('click', function () {
+  var index = document.getElementById('index-name').value;
+  var query = document.getElementById('query').value;
+  fetch('/index/' + encodeURIComponent(index) + '/query', {
+    method: 'POST', body: query,
+  }).then(function (r) { return r.json(); }).then(function (out) {
+    document.getElementById('output').textContent =
+      JSON.stringify(out, null, 2);
+    refresh();
+  }).catch(function (e) {
+    document.getElementById('output').textContent = String(e);
+  });
+});
+refresh();
+"""
+
+MAIN_CSS = """body { font-family: monospace; margin: 0; background: #111;
+  color: #dcdcdc; }
+header { padding: 0.6rem 1rem; background: #222; display: flex;
+  align-items: baseline; gap: 1rem; }
+h1 { font-size: 1.1rem; margin: 0; color: #7fd4ff; }
+h2 { font-size: 0.95rem; color: #9fe89f; }
+main { padding: 1rem; max-width: 60rem; }
+.row { display: flex; gap: 0.5rem; margin-bottom: 0.5rem; }
+input, textarea { width: 100%; background: #1b1b1b; color: #dcdcdc;
+  border: 1px solid #333; padding: 0.4rem; font-family: inherit; }
+button { background: #245; color: #cfe; border: 1px solid #368;
+  padding: 0.4rem 1rem; cursor: pointer; }
+pre { background: #1b1b1b; border: 1px solid #333; padding: 0.6rem;
+  overflow: auto; min-height: 1rem; }
+"""
+
+ASSETS = {
+    "main.js": (MAIN_JS, "application/javascript"),
+    "main.css": (MAIN_CSS, "text/css"),
+}

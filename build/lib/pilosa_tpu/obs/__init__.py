@@ -1,0 +1,21 @@
+"""Observability: stats clients, hierarchical tags, latency histograms.
+
+reference: stats.go (StatsClient interface + nop/expvar/multi impls),
+statsd/statsd.go (DataDog dogstatsd client).
+"""
+
+from pilosa_tpu.obs.stats import (
+    ExpvarStatsClient,
+    MultiStatsClient,
+    NopStatsClient,
+    StatsDClient,
+    new_stats_client,
+)
+
+__all__ = [
+    "ExpvarStatsClient",
+    "MultiStatsClient",
+    "NopStatsClient",
+    "StatsDClient",
+    "new_stats_client",
+]

@@ -1,0 +1,242 @@
+"""StatsClient — metrics with hierarchical tags.
+
+Interface parity with the reference (reference: stats.go:34-61):
+``tags / with_tags / count / count_with_custom_tags / gauge / histogram /
+set / timing``; tag propagation is hierarchical — holder tags
+``index:<n>``, then ``frame:<n>``, ``view:<n>``, ``slice:<n>`` via
+``with_tags`` (reference: holder.go:259, index.go:443, frame.go:438,
+view.go:257).
+
+Implementations: Nop (default), Expvar (in-memory snapshot served by
+/debug/vars, reference: stats.go:78-150), StatsD (dogstatsd datagram
+format over UDP, reference: statsd/statsd.go), Multi fan-out
+(reference: stats.go:152-219).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from collections import defaultdict
+
+
+def union_string_slice(a: list[str], b: list[str]) -> list[str]:
+    """Sorted union (reference: stats.go:222-247)."""
+    return sorted(set(a) | set(b))
+
+
+class NopStatsClient:
+    """reference: stats.go:66-76"""
+
+    def tags(self) -> list[str]:
+        return []
+
+    def with_tags(self, *tags: str) -> "NopStatsClient":
+        return self
+
+    def count(self, name: str, value: int = 1) -> None:
+        pass
+
+    def count_with_custom_tags(self, name: str, value: int, tags: list[str]) -> None:
+        pass
+
+    def gauge(self, name: str, value: float) -> None:
+        pass
+
+    def histogram(self, name: str, value: float) -> None:
+        pass
+
+    def set(self, name: str, value: str) -> None:
+        pass
+
+    def timing(self, name: str, value: float) -> None:
+        pass
+
+
+class ExpvarStatsClient:
+    """In-memory counters/gauges keyed by tag-qualified names, readable
+    as one JSON snapshot from /debug/vars (reference: stats.go:78-150)."""
+
+    def __init__(self, _store=None, _tags: list[str] | None = None):
+        if _store is None:
+            _store = {
+                "lock": threading.Lock(),
+                "counts": defaultdict(int),
+                "gauges": {},
+                "sets": {},
+                "histograms": defaultdict(list),
+            }
+        self._store = _store
+        self._tags = _tags or []
+
+    def _key(self, name: str, tags: list[str] | None = None) -> str:
+        all_tags = union_string_slice(self._tags, tags or [])
+        if all_tags:
+            return f"{name}[{','.join(all_tags)}]"
+        return name
+
+    def tags(self) -> list[str]:
+        return list(self._tags)
+
+    def with_tags(self, *tags: str) -> "ExpvarStatsClient":
+        return ExpvarStatsClient(
+            self._store, union_string_slice(self._tags, list(tags))
+        )
+
+    def count(self, name: str, value: int = 1) -> None:
+        with self._store["lock"]:
+            self._store["counts"][self._key(name)] += value
+
+    def count_with_custom_tags(self, name: str, value: int, tags: list[str]) -> None:
+        with self._store["lock"]:
+            self._store["counts"][self._key(name, tags)] += value
+
+    def gauge(self, name: str, value: float) -> None:
+        with self._store["lock"]:
+            self._store["gauges"][self._key(name)] = value
+
+    def histogram(self, name: str, value: float) -> None:
+        with self._store["lock"]:
+            h = self._store["histograms"][self._key(name)]
+            h.append(value)
+            if len(h) > 4096:  # bound memory
+                del h[: len(h) - 4096]
+
+    def set(self, name: str, value: str) -> None:
+        with self._store["lock"]:
+            self._store["sets"][self._key(name)] = value
+
+    def timing(self, name: str, value: float) -> None:
+        self.histogram(name, value)
+
+    def snapshot(self) -> dict:
+        """For /debug/vars."""
+        with self._store["lock"]:
+            out: dict = {
+                "counts": dict(self._store["counts"]),
+                "gauges": dict(self._store["gauges"]),
+                "sets": dict(self._store["sets"]),
+            }
+            hists = {}
+            for k, values in self._store["histograms"].items():
+                if not values:
+                    continue
+                s = sorted(values)
+                hists[k] = {
+                    "n": len(s),
+                    "min": s[0],
+                    "max": s[-1],
+                    "mean": sum(s) / len(s),
+                    "p50": s[len(s) // 2],
+                    "p99": s[min(len(s) - 1, int(len(s) * 0.99))],
+                }
+            out["histograms"] = hists
+            return out
+
+
+class StatsDClient:
+    """dogstatsd datagram client (reference: statsd/statsd.go:30-127):
+    ``pilosa.<name>:<value>|<type>|#tag1,tag2`` over UDP; prefix
+    ``pilosa.``, fire-and-forget."""
+
+    PREFIX = "pilosa."
+
+    def __init__(self, host: str = "127.0.0.1:8125", _tags: list[str] | None = None):
+        self.host = host
+        self._tags = _tags or []
+        addr, _, port = host.partition(":")
+        self._addr = (addr or "127.0.0.1", int(port or 8125))
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+
+    def _send(self, name: str, payload: str, tags: list[str] | None = None) -> None:
+        all_tags = union_string_slice(self._tags, tags or [])
+        msg = f"{self.PREFIX}{name}:{payload}"
+        if all_tags:
+            msg += f"|#{','.join(all_tags)}"
+        try:
+            self._sock.sendto(msg.encode(), self._addr)
+        except OSError:
+            pass  # fire-and-forget
+
+    def tags(self) -> list[str]:
+        return list(self._tags)
+
+    def with_tags(self, *tags: str) -> "StatsDClient":
+        c = StatsDClient.__new__(StatsDClient)
+        c.host = self.host
+        c._tags = union_string_slice(self._tags, list(tags))
+        c._addr = self._addr
+        c._sock = self._sock
+        return c
+
+    def count(self, name: str, value: int = 1) -> None:
+        self._send(name, f"{value}|c")
+
+    def count_with_custom_tags(self, name: str, value: int, tags: list[str]) -> None:
+        self._send(name, f"{value}|c", tags)
+
+    def gauge(self, name: str, value: float) -> None:
+        self._send(name, f"{value}|g")
+
+    def histogram(self, name: str, value: float) -> None:
+        self._send(name, f"{value}|h")
+
+    def set(self, name: str, value: str) -> None:
+        self._send(name, f"{value}|s")
+
+    def timing(self, name: str, value: float) -> None:
+        self._send(name, f"{value}|ms")
+
+
+class MultiStatsClient:
+    """Fan-out to several clients (reference: stats.go:152-219)."""
+
+    def __init__(self, clients: list):
+        self.clients = list(clients)
+
+    def tags(self) -> list[str]:
+        return self.clients[0].tags() if self.clients else []
+
+    def with_tags(self, *tags: str) -> "MultiStatsClient":
+        return MultiStatsClient([c.with_tags(*tags) for c in self.clients])
+
+    def count(self, name: str, value: int = 1) -> None:
+        for c in self.clients:
+            c.count(name, value)
+
+    def count_with_custom_tags(self, name: str, value: int, tags: list[str]) -> None:
+        for c in self.clients:
+            c.count_with_custom_tags(name, value, tags)
+
+    def gauge(self, name: str, value: float) -> None:
+        for c in self.clients:
+            c.gauge(name, value)
+
+    def histogram(self, name: str, value: float) -> None:
+        for c in self.clients:
+            c.histogram(name, value)
+
+    def set(self, name: str, value: str) -> None:
+        for c in self.clients:
+            c.set(name, value)
+
+    def timing(self, name: str, value: float) -> None:
+        for c in self.clients:
+            c.timing(name, value)
+
+    def snapshot(self) -> dict:
+        for c in self.clients:
+            if hasattr(c, "snapshot"):
+                return c.snapshot()
+        return {}
+
+
+def new_stats_client(service: str, host: str = ""):
+    """reference: server/server.go:236-245"""
+    if service in ("", "nop", "none"):
+        return NopStatsClient()
+    if service == "expvar":
+        return ExpvarStatsClient()
+    if service == "statsd":
+        return StatsDClient(host or "127.0.0.1:8125")
+    raise ValueError(f"unknown metrics service: {service!r}")

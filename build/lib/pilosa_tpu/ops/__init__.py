@@ -1,0 +1,12 @@
+"""Bitmap kernel layer: dense bit-planes + Pallas/XLA popcount kernels.
+
+This package replaces the reference's roaring container ops and amd64
+popcount assembly (reference: roaring/roaring.go:345-474,1259-1716 and
+roaring/assembly_amd64.s) with TPU-native equivalents operating on dense
+uint32 bit-planes.
+"""
+
+from pilosa_tpu.ops import bitplane
+from pilosa_tpu.ops import roaring
+
+__all__ = ["bitplane", "roaring"]

@@ -170,8 +170,7 @@ def _popcount_sum(words: jnp.ndarray) -> jnp.ndarray:
 @functools.lru_cache(maxsize=8)
 def _parse_mesh_shape(shape: str) -> int | None:
     """Device cap from a mesh-shape string ("4", "4x2", ...); None when
-    unset, malformed, or non-positive (a bad value must never silently
-    disable sharding)."""
+    unset or malformed (malformed never silently disables sharding)."""
     factors = shape.lower().replace("x", " ").split()
     if not factors:
         return None
@@ -179,24 +178,9 @@ def _parse_mesh_shape(shape: str) -> int | None:
         want = 1
         for f in factors:
             want *= int(f)
+        return max(1, want)
     except ValueError:
         return None
-    return want if want >= 1 else None
-
-
-@functools.lru_cache(maxsize=8)
-def _participating_devices(shape: str, n_local: int) -> tuple:
-    """The device tuple for slice placement under a mesh-shape cap —
-    cached so the per-slice hot paths don't re-derive it."""
-    want = _parse_mesh_shape(shape)
-    n = n_local if want is None else min(n_local, want)
-    return tuple(jax.local_devices()[:n])
-
-
-def participating_devices() -> tuple:
-    return _participating_devices(
-        os.environ.get("PILOSA_TPU_MESH_SHAPE", ""), len(jax.local_devices())
-    )
 
 
 def mesh_device_count() -> int:
@@ -204,7 +188,11 @@ def mesh_device_count() -> int:
     mesh.  The ``tpu.mesh-shape`` config (env ``PILOSA_TPU_MESH_SHAPE``,
     e.g. "4" or "4x2" — the product of the factors) caps it; default
     all local devices."""
-    return len(participating_devices())
+    n = len(jax.local_devices())
+    want = _parse_mesh_shape(os.environ.get("PILOSA_TPU_MESH_SHAPE", ""))
+    if want is not None:
+        n = min(n, want)
+    return n
 
 
 def home_device(slice_i: int):
@@ -214,7 +202,7 @@ def home_device(slice_i: int):
     parallel/) so the storage layer can pin planes without pulling in
     the mesh/planner machinery; parallel/mesh.py builds its sharded
     batches around the same mapping."""
-    devs = participating_devices()
+    devs = jax.local_devices()[: mesh_device_count()]
     return devs[slice_i % len(devs)]
 
 

@@ -1,0 +1,150 @@
+"""Pallas TPU kernels for the fused bitwise-op + popcount reductions.
+
+These are the TPU-native replacements for the reference's nine amd64
+assembly procedures (reference: roaring/assembly_amd64.s: popcntSliceAsm,
+popcntAndSliceAsm, popcntOrSliceAsm, popcntXorSliceAsm, popcntMaskSliceAsm
+— "mask" is AND-NOT), which the Go code dispatches to via CPUID
+(reference: roaring/assembly_asm.go:19-87).
+
+A slice-row is 32,768 uint32 words; we view every operand as (M, 128)
+lanes with M a multiple of _ROW_SUBLANES = 256 (one slice-row = one
+(256, 128) tile = 128 KiB of VMEM per operand).  The grid walks slice-row
+tiles sequentially, accumulating the popcount into a single SMEM scalar —
+the data streams HBM -> VMEM once and the bitwise op fuses with the
+popcount, so the kernels run at HBM bandwidth.
+
+Everything here is optional: :mod:`pilosa_tpu.ops.bitplane` falls back to
+pure-XLA (jnp) formulations off-TPU or when PILOSA_TPU_DISABLE_PALLAS is
+set, and the two paths are asserted bit-identical in
+tests/test_kernels.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANES = 128
+_ROW_SUBLANES = 256  # one slice-row: 256 * 128 = 32768 words
+
+
+def _interpret() -> bool:
+    """Run kernels in interpreter mode off-TPU so the Pallas path is
+    testable on the CPU fixture mesh."""
+    return jax.default_backend() != "tpu"
+
+
+def _combine(op: str, x, y):
+    if op == "and":
+        return x & y
+    if op == "or":
+        return x | y
+    if op == "xor":
+        return x ^ y
+    if op == "andnot":
+        return x & ~y
+    raise ValueError(f"unknown op {op!r}")
+
+
+def _fused_count_kernel(op, a_ref, b_ref, out_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[0, 0] = 0
+
+    w = _combine(op, a_ref[:], b_ref[:])
+    out_ref[0, 0] += jnp.sum(jax.lax.population_count(w).astype(jnp.int32))
+
+
+def _count_kernel(a_ref, out_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[0, 0] = 0
+
+    out_ref[0, 0] += jnp.sum(jax.lax.population_count(a_ref[:]).astype(jnp.int32))
+
+
+def _as_tiles(x: jnp.ndarray) -> jnp.ndarray:
+    """Reshape any word array whose size is a multiple of one slice-row
+    into (M, 128)."""
+    total = x.size
+    assert total % (_ROW_SUBLANES * _LANES) == 0, (
+        f"operand size {total} is not a whole number of slice-rows"
+    )
+    return x.reshape(total // _LANES, _LANES)
+
+
+@functools.partial(jax.jit, static_argnames=("op",))
+def fused_count(a: jnp.ndarray, b: jnp.ndarray, op: str) -> jnp.ndarray:
+    """int32 popcount of (a OP b) over whole slice-row-multiple operands."""
+    at, bt = _as_tiles(a), _as_tiles(b)
+    m = at.shape[0]
+    grid = m // _ROW_SUBLANES
+    out = pl.pallas_call(
+        functools.partial(_fused_count_kernel, op),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((_ROW_SUBLANES, _LANES), lambda i: (i, 0)),
+            pl.BlockSpec((_ROW_SUBLANES, _LANES), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        interpret=_interpret(),
+    )(at, bt)
+    return out[0, 0]
+
+
+@jax.jit
+def count(a: jnp.ndarray) -> jnp.ndarray:
+    """int32 popcount of a (reference: popcntSliceAsm)."""
+    at = _as_tiles(a)
+    grid = at.shape[0] // _ROW_SUBLANES
+    out = pl.pallas_call(
+        _count_kernel,
+        grid=(grid,),
+        in_specs=[pl.BlockSpec((_ROW_SUBLANES, _LANES), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+        out_shape=jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        interpret=_interpret(),
+    )(at)
+    return out[0, 0]
+
+
+def _top_counts_kernel(plane_ref, src_ref, out_ref):
+    w = plane_ref[:] & src_ref[:]
+    out_ref[pl.program_id(0)] = jnp.sum(
+        jax.lax.population_count(w).astype(jnp.int32)
+    )
+
+
+@jax.jit
+def top_counts(plane: jnp.ndarray, src_row: jnp.ndarray) -> jnp.ndarray:
+    """Per-row |row AND src| over a (rows, 32768) plane -> int32[rows].
+
+    The batched TopN(Src=...) scorer: one grid step per row, src tile
+    revisited from VMEM each step.
+    """
+    rows = plane.shape[0]
+    pt = plane.reshape(rows, _ROW_SUBLANES, _LANES)
+    st = src_row.reshape(_ROW_SUBLANES, _LANES)
+    out = pl.pallas_call(
+        _top_counts_kernel,
+        grid=(rows,),
+        in_specs=[
+            pl.BlockSpec((1, _ROW_SUBLANES, _LANES), lambda i: (i, 0, 0)),
+            pl.BlockSpec((_ROW_SUBLANES, _LANES), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((rows,), lambda i: (0,), memory_space=pltpu.SMEM),
+        out_shape=jax.ShapeDtypeStruct((rows,), jnp.int32),
+        interpret=_interpret(),
+    )(pt, st)
+    return out

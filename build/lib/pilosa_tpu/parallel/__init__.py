@@ -1,0 +1,26 @@
+"""Parallel layer: slice -> TPU-device sharding and mesh collectives.
+
+reference equivalent: the slice->node map/reduce in executor.go:1131-1283
+and the HTTP reduce fan-in — replaced intra-host by XLA collectives over
+ICI (SURVEY.md §2.10 table).
+"""
+
+from pilosa_tpu.parallel.mesh import (
+    AXIS_ROWS,
+    AXIS_SLICES,
+    distributed_count,
+    distributed_topn,
+    query_step,
+    shard_planes,
+    slice_mesh,
+)
+
+__all__ = [
+    "AXIS_SLICES",
+    "AXIS_ROWS",
+    "slice_mesh",
+    "shard_planes",
+    "distributed_count",
+    "distributed_topn",
+    "query_step",
+]

@@ -1,0 +1,211 @@
+"""Device-mesh execution: slices sharded over TPU chips, ICI reduces.
+
+The reference distributes slices over *nodes* and reduces over HTTP
+(reference: executor.go:1149-1243 mapReduce; SURVEY.md §2.10).  Within a
+TPU host the same map lives on a `jax.sharding.Mesh`:
+
+* **slices axis** — the unbounded column axis, 2^20 columns per slice
+  (the reference's inter-node data parallelism).  Slices are disjoint
+  column ranges, so a cross-slice "Union" of result rows is a *merge*,
+  never an OR; the only cross-slice collectives are ``psum`` for counts
+  and gather/merge for TopN pairs.
+* **rows axis** — shards a fragment's row dimension for TopN scoring
+  (the analog of tensor parallelism: one row-block per device, scored
+  against a replicated src row).
+
+Planes are laid out ``uint32[n_slices, rows, words]`` and sharded
+``P(AXIS_SLICES, AXIS_ROWS, None)``; the word axis stays contiguous so
+the fused bitwise+popcount kernels see full 128 KiB slice-rows.
+
+Multi-host: the same mesh spans hosts via jax distributed initialization,
+with XLA routing the psum over ICI within a pod slice and DCN across
+pods — no NCCL/MPI translation, per SURVEY.md §5 "distributed
+communication backend".
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from pilosa_tpu.exec import plan
+
+AXIS_SLICES = "slices"
+AXIS_ROWS = "rows"
+
+_slices_mesh: Mesh | None = None
+
+
+def default_slices_mesh() -> Mesh | None:
+    """A 1-D slices mesh over the participating local devices; None on
+    single-device hosts (the executor then uses the plain vmapped
+    path)."""
+    global _slices_mesh
+    n = mesh_device_count()
+    if n < 2:
+        return None
+    devs = jax.local_devices()[:n]
+    if _slices_mesh is None or _slices_mesh.devices.size != n:
+        _slices_mesh = Mesh(np.array(devs), (AXIS_SLICES,))
+    return _slices_mesh
+
+
+from pilosa_tpu.ops.bitplane import (  # noqa: E402 — re-export; placement
+    home_device,  # policy lives with the kernels so core/ never imports
+    mesh_device_count,  # this module.
+)
+
+
+def assemble_sharded_batch(blocks: list[jax.Array], mesh: Mesh) -> jax.Array:
+    """Glue per-device blocks (block d committed to mesh device d, all
+    the same shape) into one global array sharded P(slices) on axis 0
+    — no device-to-device traffic."""
+    chunk = blocks[0].shape[0]
+    shape = (len(blocks) * chunk,) + blocks[0].shape[1:]
+    spec = P(AXIS_SLICES, *([None] * (len(shape) - 1)))
+    return jax.make_array_from_single_device_arrays(
+        shape, NamedSharding(mesh, spec), blocks
+    )
+
+
+def slice_mesh(n_devices: int | None = None, row_shards: int = 1) -> Mesh:
+    """A (slices, rows) mesh over the first ``n_devices`` devices.
+
+    ``row_shards`` splits the row axis (TopN scoring parallelism); the
+    remaining devices shard the slice axis.
+    """
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    if n % row_shards != 0:
+        raise ValueError(f"n_devices {n} not divisible by row_shards {row_shards}")
+    grid = np.array(devs[:n]).reshape(n // row_shards, row_shards)
+    return Mesh(grid, (AXIS_SLICES, AXIS_ROWS))
+
+
+def plane_spec() -> P:
+    return P(AXIS_SLICES, AXIS_ROWS, None)
+
+
+def shard_planes(planes: np.ndarray, mesh: Mesh) -> jax.Array:
+    """Place ``uint32[n_slices, rows, words]`` onto the mesh, slice axis
+    over AXIS_SLICES and row axis over AXIS_ROWS.  Pads the slice axis up
+    to the mesh size (zero slices contribute nothing to any query)."""
+    n_sl = mesh.shape[AXIS_SLICES]
+    n_rw = mesh.shape[AXIS_ROWS]
+    s, r, w = planes.shape
+    pad_s = (-s) % n_sl
+    pad_r = (-r) % n_rw
+    if pad_s or pad_r:
+        planes = np.pad(planes, ((0, pad_s), (0, pad_r), (0, 0)))
+    return jax.device_put(planes, NamedSharding(mesh, plane_spec()))
+
+
+# ---------------------------------------------------------------------------
+# Distributed query kernels.  Each is jitted with the mesh baked in via
+# sharding annotations — XLA inserts the ICI collectives.
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("expr",))
+def _count_tree(expr: tuple, leaf_planes: jax.Array) -> jax.Array:
+    """Fused tree-count over ``uint32[n_slices, n_leaves, rows, words]``:
+    evaluates the bitmap expression and popcount-reduces the word axis to
+    int32[n_slices, rows] partials.  One slice-row holds at most 2^20
+    bits so a partial always fits int32; the unbounded cross-slice /
+    cross-row total is summed on host in int64 (JAX x64 is off)."""
+    out = plan._eval_expr(expr, leaf_planes.swapaxes(0, 1))
+    return jnp.sum(jax.lax.population_count(out).astype(jnp.int32), axis=-1)
+
+
+def distributed_count(expr: tuple, leaf_planes: jax.Array) -> int:
+    """Count(tree) where each leaf is a full sharded plane.
+
+    ``leaf_planes``: uint32[n_slices, n_leaves, rows, words] sharded
+    P(slices, None, rows, None).
+    """
+    return int(np.asarray(_count_tree(expr, leaf_planes), dtype=np.int64).sum())
+
+
+@jax.jit
+def _topn_partials(plane: jax.Array, src: jax.Array):
+    """Per-(slice, row) |row AND src| -> int32[n_slices, rows].
+
+    ``plane``: uint32[n_slices, rows, words] sharded (slices, rows, -).
+    ``src``:   uint32[n_slices, words] sharded (slices, -) — one src row
+    per slice (a RowBitmap's segments, stacked).
+
+    Only the word axis reduces on device (a partial <= 2^20 always fits
+    int32); the cross-slice per-row total — unbounded — is summed on
+    host in int64.
+    """
+    return jnp.sum(
+        jax.lax.population_count(plane & src[:, None, :]).astype(jnp.int32),
+        axis=-1,
+    )
+
+
+def distributed_topn(plane: jax.Array, src: jax.Array, k: int):
+    """TopN(Src=...) over a sharded fragment-stack: returns (counts,
+    row_ids) host arrays, count-descending, ties toward lower id —
+    matching the reference Pair sort (reference: cache.go:316-330)."""
+    per = np.asarray(_topn_partials(plane, src), dtype=np.int64).sum(axis=0)
+    k = min(k, per.shape[0])
+    ids = np.argsort(-per, kind="stable")[:k]
+    return per[ids], ids
+
+
+# ---------------------------------------------------------------------------
+# The full sharded step for dry-run / benchmarking: mutate + query + topn.
+# ---------------------------------------------------------------------------
+
+
+def query_step(mesh: Mesh):
+    """Build a jitted end-to-end step over ``mesh``: applies a batch of
+    bit mutations (scatter-OR), then runs Count(Intersect(r0, r1)) and a
+    TopN scoring pass — the write+read cycle of SURVEY.md §3.2/§3.3 as
+    one compiled program.
+
+    Returns ``step(planes, rows_upd, words_upd, masks) -> (planes',
+    count, top_counts, top_ids)`` where planes is
+    uint32[n_slices, rows, words] sharded (slices, rows, None) and the
+    update batch indexes [n_upd] within every slice's local block.
+
+    The (rows_upd, words_upd) pairs must be unique: the scatter computes
+    ``old | mask`` per entry, so duplicate targets would race.  The host
+    write path pre-combines duplicates (``np.bitwise_or.at`` in
+    ops/bitplane.np_set_bulk) before flushing a batch to the device.
+    """
+    pspec = NamedSharding(mesh, plane_spec())
+    rep = NamedSharding(mesh, P())
+
+    @functools.partial(
+        jax.jit,
+        out_shardings=(pspec, rep, rep, rep),
+    )
+    def step(planes, rows_upd, words_upd, masks):
+        # Write path: batched scatter-OR of the update batch into every
+        # slice (each slice applies its own mask batch).
+        def upd_one(pl, m):
+            return pl.at[rows_upd, words_upd].set(pl[rows_upd, words_upd] | m)
+
+        planes = jax.vmap(upd_one)(planes, masks)
+        # Read path: Count(Intersect(row0, row1)) across all slices;
+        # int32 partials per slice (one slice-row <= 2^20 bits).
+        a = planes[:, 0, :]
+        b = planes[:, 1, :]
+        count = jnp.sum(jax.lax.population_count(a & b).astype(jnp.int32), axis=-1)
+        # TopN: per-row intersection counts with row 0 as src, global
+        # top-4.  int32 is safe up to 2047 slices (2047 x 2^20 < 2^31);
+        # the production path (distributed_topn) host-sums in int64.
+        per_row = jnp.sum(
+            jax.lax.population_count(planes & a[:, None, :]).astype(jnp.int32),
+            axis=(0, 2),
+        )
+        top_counts, top_ids = jax.lax.top_k(per_row, 4)
+        return planes, count, top_counts, top_ids
+
+    return step

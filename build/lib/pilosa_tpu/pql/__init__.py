@@ -1,0 +1,19 @@
+"""PQL — the Pilosa Query Language.
+
+Grammar and semantics match the reference parser (reference: pql/parser.go,
+pql/scanner.go, pql/ast.go): a query is a sequence of calls; a call is
+``Name(child1(...), child2(...), key=value, ...)``; values are
+bool/null/ident/string/int64/float64/list.  The canonical ``str()`` form
+(sorted argument keys, Go-style quoting) is wire-compatible with the
+reference so remote call forwarding and test fixtures interoperate.
+"""
+
+from pilosa_tpu.pql.parser import (
+    Call,
+    ParseError,
+    Query,
+    TIME_FORMAT,
+    parse_string,
+)
+
+__all__ = ["Call", "ParseError", "Query", "TIME_FORMAT", "parse_string"]

@@ -178,10 +178,13 @@ def test_mesh_shape_config_caps_devices(monkeypatch):
     devs = jax.local_devices()[:4]
     for s in range(8):
         assert bp.home_device(s) == devs[s % 4]
+    # the slices mesh respects the cap
+    mesh = pmesh.default_slices_mesh()
+    assert mesh is not None and mesh.devices.size == 4
+    pmesh._slices_mesh = None  # reset the cached mesh for other tests
     monkeypatch.setenv("PILOSA_TPU_MESH_SHAPE", "1")
     assert bp.mesh_device_count() == 1
-    # malformed values never silently disable sharding
-    monkeypatch.setenv("PILOSA_TPU_MESH_SHAPE", "bogus")
-    assert bp.mesh_device_count() == 8
-    monkeypatch.setenv("PILOSA_TPU_MESH_SHAPE", "x")
-    assert bp.mesh_device_count() == 8
+    # malformed / non-positive values never silently disable sharding
+    for bad in ("bogus", "x", "0", "0x4", "-2"):
+        monkeypatch.setenv("PILOSA_TPU_MESH_SHAPE", bad)
+        assert bp.mesh_device_count() == 8, bad
