@@ -62,6 +62,34 @@ MAX_FRAGMENT_ROWS = 1 << 16
 MAX_ROW_ID = 1 << 44
 
 
+def _apply_pending(dev, pending):
+    """Fold queued point writes into one device scatter.
+
+    Sequential semantics per bit compose to last-wins: each (slot, word)
+    accumulates a set-mask and clear-mask where a later opposite op on
+    the same bit cancels the earlier one, then a single gather/modify/
+    scatter applies ``(v & ~clear) | set`` — unique keys, so the scatter
+    never races."""
+    acc: dict[tuple[int, int], list[int]] = {}
+    for slot, word, mask, op in pending:
+        masks = acc.setdefault((slot, word), [0, 0])
+        if op:
+            masks[0] |= mask
+            masks[1] &= ~mask
+        else:
+            masks[1] |= mask
+            masks[0] &= ~mask
+    keys = list(acc)
+    slots = np.asarray([k[0] for k in keys], dtype=np.int32)
+    words = np.asarray([k[1] for k in keys], dtype=np.int32)
+    set_m = np.asarray([acc[k][0] for k in keys], dtype=np.uint32)
+    keep_m = np.asarray(
+        [(~acc[k][1]) & 0xFFFFFFFF for k in keys], dtype=np.uint32
+    )
+    cur = dev[slots, words]
+    return dev.at[slots, words].set((cur & keep_m) | set_m)
+
+
 class FragmentError(RuntimeError):
     pass
 
@@ -124,6 +152,11 @@ class Fragment:
         self._version = 0
         self._device = None
         self._device_version = -1
+        # Point writes queue here while a device mirror exists; the next
+        # read folds them into ONE batched scatter instead of re-uploading
+        # the whole plane (SURVEY.md §7 "mutation rate vs immutable device
+        # buffers").  (slot, word, mask, op) with op 1=OR / 0=ANDNOT.
+        self._device_pending: list[tuple[int, int, int, int]] = []
         self._file = None
         self._row_cache: dict[int, np.ndarray] = {}
         self.cache = cache_mod.new_cache(cache_type, cache_size)
@@ -171,8 +204,7 @@ class Fragment:
                 fcntl.flock(self._file.fileno(), fcntl.LOCK_UN)
                 self._file.close()
                 self._file = None
-            self._device = None
-            self._device_version = -1
+            self._invalidate_device()
             self._opened = False
 
     @property
@@ -248,6 +280,8 @@ class Fragment:
                 (grow - self._plane.shape[0], bp.WORDS_PER_SLICE), np.uint32
             )
             self._plane = np.vstack([self._plane, extra])
+            # the device mirror no longer matches the plane's shape
+            self._invalidate_device()
         self._max_row_id = max(self._max_row_id, row_id)
         return slot
 
@@ -260,6 +294,7 @@ class Fragment:
             plane[i] = row_map[r]
         self._plane = plane
         self._max_row_id = rows[-1] if rows else 0
+        self._invalidate_device()
 
     def _row_map(self) -> dict[int, np.ndarray]:
         return {r: self._plane[s] for r, s in self._slot_of.items()}
@@ -297,18 +332,40 @@ class Fragment:
             counts = np.asarray(bp.row_counts(self.device_plane()))
             return {r: int(counts[s]) for r, s in self._slot_of.items()}
 
+    # Above this many queued point writes, a full re-upload is cheaper
+    # than the scatter program.
+    _MAX_DEVICE_PENDING = 8192
+
+    def _invalidate_device(self) -> None:
+        """Bulk plane changes (import, restore, load) force a full
+        re-upload; queued point updates would be stale."""
+        self._device = None
+        self._device_version = -1
+        self._device_pending.clear()
+
     def device_plane(self):
-        """The HBM mirror of the plane, re-uploaded when stale.  Pinned
-        to the slice's home device (slice mod n_devices) so multi-device
-        query batches assemble shard-local with no inter-chip copies
-        (parallel/mesh.home_device)."""
+        """The HBM mirror of the plane, pinned to the slice's home device
+        (slice mod n_devices) so multi-device query batches assemble
+        shard-local (parallel/mesh.home_device).  Point writes since the
+        last read apply as one batched on-device scatter; bulk changes
+        re-upload."""
         import jax
 
         with self._mu:
+            if self._device is not None and self._device_version != self._version:
+                if self._device_pending:
+                    self._device = _apply_pending(
+                        self._device, self._device_pending
+                    )
+                    self._device_pending.clear()
+                    self._device_version = self._version
+                else:
+                    self._device = None
             if self._device is None or self._device_version != self._version:
                 self._device = jax.device_put(
                     self._plane, bp.home_device(self.slice)
                 )
+                self._device_pending.clear()
                 self._device_version = self._version
             return self._device
 
@@ -331,6 +388,7 @@ class Fragment:
             slot = self._ensure_slot(row_id)
             changed = bp.np_set_bit(self._plane, slot * SLICE_WIDTH + pos % SLICE_WIDTH)
             if changed:
+                self._queue_device_update(slot, pos % SLICE_WIDTH, 1)
                 self._append_op(roaring.OP_ADD, pos)
                 self._after_write(row_id, slot)
             return changed
@@ -343,9 +401,21 @@ class Fragment:
                 return False
             changed = bp.np_clear_bit(self._plane, slot * SLICE_WIDTH + pos % SLICE_WIDTH)
             if changed:
+                self._queue_device_update(slot, pos % SLICE_WIDTH, 0)
                 self._append_op(roaring.OP_REMOVE, pos)
                 self._after_write(row_id, slot)
             return changed
+
+    def _queue_device_update(self, slot: int, offset: int, op: int) -> None:
+        """Record a point write for the device mirror; overflow degrades
+        to a full re-upload on next read."""
+        if self._device is None:
+            return
+        if len(self._device_pending) >= self._MAX_DEVICE_PENDING:
+            self._invalidate_device()
+            return
+        word, shift = divmod(offset, bp.WORD_BITS)
+        self._device_pending.append((slot, word, 1 << shift, op))
 
     def _after_write(self, row_id: int, slot: int) -> None:
         self._version += 1
@@ -381,6 +451,7 @@ class Fragment:
             slots = np.asarray([slot_of[int(r)] for r in rows], dtype=np.int64)
             bp.np_set_bulk(self._plane, slots, offs)
             self._version += 1
+            self._invalidate_device()
             self._row_cache.clear()
             counts = bp.np_row_counts(self._plane)
             for r, s in slot_of.items():

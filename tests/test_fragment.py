@@ -330,3 +330,73 @@ def test_huge_row_id_rejected_before_mutation(frag):
     # clearing a never-set row is a no-op, regardless of id
     assert frag.clear_bit((1 << 64) - 1, 1) is False
     assert frag.count() == 0
+
+
+class TestIncrementalDeviceMirror:
+    """Point writes after a device read apply as a batched scatter, not
+    a full plane re-upload; bulk changes force re-upload."""
+
+    def test_point_writes_visible_after_device_read(self, frag, monkeypatch):
+        import jax
+        import numpy as np
+
+        from pilosa_tpu.ops import bitplane as bp
+
+        frag.set_bit(1, 10)
+        frag.device_plane()  # initial upload
+        # From here on, point writes must apply as a device scatter —
+        # any further full upload is a regression.
+        uploads = []
+        real_put = jax.device_put
+        monkeypatch.setattr(
+            jax, "device_put", lambda *a, **k: uploads.append(1) or real_put(*a, **k)
+        )
+        frag.set_bit(1, 20)
+        frag.set_bit(2, 30)
+        frag.clear_bit(1, 10)
+        row1 = np.asarray(frag.device_row(1))
+        assert bp.np_row_to_columns(row1).tolist() == [20]
+        row2 = np.asarray(frag.device_row(2))
+        assert bp.np_row_to_columns(row2).tolist() == [30]
+        assert uploads == [], "point writes triggered a full plane re-upload"
+        assert frag._device_pending == []
+
+    def test_set_then_clear_same_bit_last_wins(self, frag):
+        frag.set_bit(0, 5)
+        frag.device_plane()
+        frag.clear_bit(0, 5)
+        frag.set_bit(0, 5)
+        frag.clear_bit(0, 5)
+        assert not frag.contains(0, 5)
+        import numpy as np
+
+        from pilosa_tpu.ops import bitplane as bp
+
+        assert bp.np_row_to_columns(np.asarray(frag.device_row(0))).tolist() == []
+
+    def test_bulk_import_invalidates_mirror(self, frag):
+        frag.set_bit(0, 1)
+        frag.device_plane()
+        frag.import_bulk([0, 0], [2, 3])
+        assert frag._device is None  # full re-upload scheduled
+        import numpy as np
+
+        from pilosa_tpu.ops import bitplane as bp
+
+        assert bp.np_row_to_columns(np.asarray(frag.device_row(0))).tolist() == [1, 2, 3]
+
+    def test_overflow_degrades_to_reupload(self, frag):
+        frag.set_bit(0, 0)
+        frag.device_plane()
+        cap = frag._MAX_DEVICE_PENDING
+        cols = list(range(1, cap + 2))
+        for c in cols:
+            frag.set_bit(0, c)
+        # the overflow branch must have invalidated the mirror
+        assert frag._device is None
+        import numpy as np
+
+        from pilosa_tpu.ops import bitplane as bp
+
+        got = bp.np_row_to_columns(np.asarray(frag.device_row(0)))
+        assert got.tolist() == [0] + cols
