@@ -56,6 +56,12 @@ def build_server(cfg: config_mod.Config):
     if cfg.tpu.mesh_shape:
         os.environ["PILOSA_TPU_MESH_SHAPE"] = cfg.tpu.mesh_shape
 
+    # Join a multi-host JAX process group when the launcher configured
+    # one (JAX_COORDINATOR_ADDRESS etc.); no-op otherwise.
+    from pilosa_tpu.parallel import multihost
+
+    multihost.initialize()
+
     # Logging: log-path file or stderr (reference: server/server.go:125-133).
     if cfg.log_path:
         log_file = open(os.path.expanduser(cfg.log_path), "a", buffering=1)

@@ -1,0 +1,76 @@
+"""Multi-host device meshes — jax.distributed wiring.
+
+Two distribution regimes compose in this framework (SURVEY.md §5
+"distributed communication backend"):
+
+1. **HTTP+protobuf across clusters of independent hosts** — the
+   reference-compatible path (net/, cluster/): each node owns slices,
+   queries fan out, reduces merge on the coordinator.  Works anywhere,
+   no shared ICI required.
+2. **One JAX process group across hosts that share an ICI/DCN domain**
+   (a TPU pod slice): all hosts join a single runtime via
+   ``jax.distributed.initialize``; ``jax.devices()`` then spans every
+   host, the slices mesh covers the pod, and cross-host reduces ride
+   ICI/DCN as XLA collectives instead of HTTP fan-in.
+
+This module wires regime 2.  Call :func:`initialize` once per process
+before any JAX computation; afterwards ``parallel.mesh`` and the
+executor's sharded path transparently use the global device set
+(``jax.local_devices()`` stays host-local, which keeps fragment
+placement host-local — each host still owns the slices whose planes it
+pins; global collectives happen inside the jitted query programs).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Join this process to a multi-host JAX runtime.
+
+    Only gates on ``JAX_COORDINATOR_ADDRESS`` (or the explicit
+    argument); everything else passes through as ``None`` so
+    ``jax.distributed.initialize`` keeps its own env/cluster
+    auto-detection (Cloud-TPU / Slurm plugins fill per-host process ids
+    only for params left unset — supplying defaults here would break
+    pod launches).  No-ops when unconfigured (single-host deployments)
+    or when the process group already exists.
+    """
+    import jax
+
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    if coordinator_address is None:
+        return
+    # Re-init guard: jax.distributed.initialize raises if called twice.
+    if jax.distributed.is_initialized():
+        return
+    env_np = os.environ.get("JAX_NUM_PROCESSES")
+    env_pid = os.environ.get("JAX_PROCESS_ID")
+    if num_processes is None and env_np is not None:
+        num_processes = int(env_np)
+    if process_id is None and env_pid is not None:
+        process_id = int(env_pid)
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def global_device_count() -> int:
+    import jax
+
+    return len(jax.devices())
+
+
+def is_multihost() -> bool:
+    import jax
+
+    return jax.process_count() > 1

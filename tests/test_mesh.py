@@ -188,3 +188,15 @@ def test_mesh_shape_config_caps_devices(monkeypatch):
     for bad in ("bogus", "x", "0", "0x4", "-2"):
         monkeypatch.setenv("PILOSA_TPU_MESH_SHAPE", bad)
         assert bp.mesh_device_count() == 8, bad
+
+
+def test_multihost_initialize_single_process(monkeypatch):
+    """initialize() joins a 1-process group (the degenerate multi-host
+    case) and is a no-op without configuration."""
+    from pilosa_tpu.parallel import multihost
+
+    # unconfigured -> no-op
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    multihost.initialize()
+    assert multihost.global_device_count() == 8
+    assert not multihost.is_multihost()
