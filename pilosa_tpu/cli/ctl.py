@@ -56,11 +56,6 @@ def build_server(cfg: config_mod.Config):
     if cfg.tpu.mesh_shape:
         os.environ["PILOSA_TPU_MESH_SHAPE"] = cfg.tpu.mesh_shape
 
-    # Join a multi-host JAX process group when the launcher configured
-    # one (JAX_COORDINATOR_ADDRESS etc.); no-op otherwise.
-    from pilosa_tpu.parallel import multihost
-
-    multihost.initialize()
 
     # Logging: log-path file or stderr (reference: server/server.go:125-133).
     if cfg.log_path:
@@ -124,6 +119,12 @@ def run_server(args) -> int:
     if args.dry_run:
         print("dry-run: config ok", file=sys.stderr)
         return 0
+    # Join a multi-host JAX process group when the launcher configured
+    # one (JAX_COORDINATOR_ADDRESS etc.); after the dry-run exit — the
+    # coordinator barrier blocks until all peers connect.
+    from pilosa_tpu.parallel import multihost
+
+    multihost.initialize()
     server.open()
     print(f"listening on http://{server.host}", file=sys.stderr)
     try:

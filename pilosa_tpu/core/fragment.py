@@ -150,6 +150,9 @@ class Fragment:
         self._max_row_id = 0
         self._op_n = 0
         self._version = 0
+        # Incremental per-row popcounts (reference keeps cached counts,
+        # bitmap.go:184-217); avoids an O(row) recount on every SetBit.
+        self._count_of: dict[int, int] = {}
         self._device = None
         self._device_version = -1
         # Point writes queue here while a device mirror exists; the next
@@ -226,8 +229,7 @@ class Fragment:
             return
         for row_id in ids:
             if isinstance(row_id, int) and row_id in self._slot_of:
-                n = bp.np_count(self._plane[self._slot_of[row_id]])
-                self.cache.bulk_add(row_id, n)
+                self.cache.bulk_add(row_id, self._count_of.get(row_id, 0))
         self.cache.invalidate()
 
     def flush_cache(self) -> None:
@@ -273,6 +275,7 @@ class Fragment:
             )
         slot = len(self._slot_of)
         self._slot_of[row_id] = slot
+        self._count_of[row_id] = 0
         needed = bp.pad_rows(slot + 1)
         if needed > self._plane.shape[0]:
             grow = max(needed, min(2 * self._plane.shape[0], MAX_FRAGMENT_ROWS))
@@ -294,6 +297,8 @@ class Fragment:
             plane[i] = row_map[r]
         self._plane = plane
         self._max_row_id = rows[-1] if rows else 0
+        counts = bp.np_row_counts(plane[: len(rows)]) if rows else []
+        self._count_of = {r: int(counts[i]) for i, r in enumerate(rows)}
         self._invalidate_device()
 
     def _row_map(self) -> dict[int, np.ndarray]:
@@ -390,7 +395,7 @@ class Fragment:
             if changed:
                 self._queue_device_update(slot, pos % SLICE_WIDTH, 1)
                 self._append_op(roaring.OP_ADD, pos)
-                self._after_write(row_id, slot)
+                self._after_write(row_id, +1)
             return changed
 
     def clear_bit(self, row_id: int, column_id: int) -> bool:
@@ -403,7 +408,7 @@ class Fragment:
             if changed:
                 self._queue_device_update(slot, pos % SLICE_WIDTH, 0)
                 self._append_op(roaring.OP_REMOVE, pos)
-                self._after_write(row_id, slot)
+                self._after_write(row_id, -1)
             return changed
 
     def _queue_device_update(self, slot: int, offset: int, op: int) -> None:
@@ -417,10 +422,10 @@ class Fragment:
         word, shift = divmod(offset, bp.WORD_BITS)
         self._device_pending.append((slot, word, 1 << shift, op))
 
-    def _after_write(self, row_id: int, slot: int) -> None:
+    def _after_write(self, row_id: int, delta: int) -> None:
         self._version += 1
         self._row_cache.pop(row_id, None)
-        n = bp.np_count(self._plane[slot])
+        n = self._count_of[row_id] = self._count_of.get(row_id, 0) + delta
         self.cache.add(row_id, n)
         self._op_n += 1
         if self._op_n >= self.max_op_n:
@@ -455,6 +460,7 @@ class Fragment:
             self._row_cache.clear()
             counts = bp.np_row_counts(self._plane)
             for r, s in slot_of.items():
+                self._count_of[r] = int(counts[s])
                 self.cache.bulk_add(r, int(counts[s]))
             self.cache.invalidate()
             self.cache.recalculate()
@@ -765,8 +771,9 @@ class Fragment:
                     self.cache = cache_mod.new_cache(self.cache_type, self.cache_size)
                     for row_id in ids:
                         if isinstance(row_id, int) and row_id in self._slot_of:
-                            n = bp.np_count(self._plane[self._slot_of[row_id]])
-                            self.cache.bulk_add(row_id, n)
+                            self.cache.bulk_add(
+                                row_id, self._count_of.get(row_id, 0)
+                            )
                     self.cache.invalidate()
             tr.close()
 

@@ -400,3 +400,15 @@ class TestIncrementalDeviceMirror:
 
         got = bp.np_row_to_columns(np.asarray(frag.device_row(0)))
         assert got.tolist() == [0] + cols
+
+
+def test_import_then_point_write_keeps_counts(frag):
+    """Regression: import_bulk must refresh the incremental count map so
+    later point writes don't poison the TopN cache with tiny counts."""
+    frag.import_bulk([7] * 100, list(range(100)))
+    frag.set_bit(7, 200)
+    assert frag.cache.get(7) == 101
+    frag.clear_bit(7, 200)
+    assert frag.cache.get(7) == 100
+    top = frag.top(TopOptions(n=1))
+    assert [(p.id, p.count) for p in top] == [(7, 100)]

@@ -190,13 +190,48 @@ def test_mesh_shape_config_caps_devices(monkeypatch):
         assert bp.mesh_device_count() == 8, bad
 
 
-def test_multihost_initialize_single_process(monkeypatch):
-    """initialize() joins a 1-process group (the degenerate multi-host
-    case) and is a no-op without configuration."""
+def test_multihost_initialize_unconfigured_noop(monkeypatch):
+    """Without JAX_COORDINATOR_ADDRESS, initialize() is a no-op (the
+    configured 1-process-group path runs in a subprocess below)."""
     from pilosa_tpu.parallel import multihost
 
-    # unconfigured -> no-op
     monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
     multihost.initialize()
     assert multihost.global_device_count() == 8
     assert not multihost.is_multihost()
+
+
+def test_multihost_initialize_single_process_group():
+    """The configured path joins a real 1-process group (subprocess:
+    jax.distributed can only initialize once per process) and the second
+    initialize() call is an idempotent no-op."""
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+        JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+        JAX_NUM_PROCESSES="1",
+        JAX_PROCESS_ID="0",
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", (
+            "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+            "from pilosa_tpu.parallel import multihost\n"
+            "multihost.initialize()\n"
+            "multihost.initialize()\n"
+            "print('pc', jax.process_count())\n"
+        )],
+        env=env, capture_output=True, text=True, timeout=120,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert out.returncode == 0, out.stderr[-800:]
+    assert "pc 1" in out.stdout
