@@ -391,7 +391,8 @@ class Executor:
                 pos_of[s] = d * chunk + i
 
         batch = pmesh.assemble_sharded_batch(blocks, mesh)
-        res = plan.compiled_batched(expr, reduce)(batch)
+        # plain-XLA formulation: partitions cleanly under SPMD
+        res = plan.compiled_batched(expr, reduce, fused=False)(batch)
         res = jax.device_get(res)
         return {s: res[p] for s, p in pos_of.items()}
 

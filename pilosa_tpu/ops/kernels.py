@@ -119,11 +119,37 @@ def count(a: jnp.ndarray) -> jnp.ndarray:
     return out[0, 0]
 
 
-def _top_counts_kernel(plane_ref, src_ref, out_ref):
-    w = plane_ref[:] & src_ref[:]
+def _fused_count_rows_kernel(op, a_ref, b_ref, out_ref):
+    w = _combine(op, a_ref[:], b_ref[:])
     out_ref[pl.program_id(0)] = jnp.sum(
         jax.lax.population_count(w).astype(jnp.int32)
     )
+
+
+@functools.partial(jax.jit, static_argnames=("op",))
+def fused_count_rows(a: jnp.ndarray, b: jnp.ndarray, op: str) -> jnp.ndarray:
+    """Per-row popcount of (a OP b) over (rows, 32768) operands ->
+    int32[rows]: the batched Count(op(x, y)) fast path — one partial per
+    slice-row tile (<= 2^20 bits, always int32-safe; cross-slice totals
+    sum on host in int64)."""
+    rows = a.shape[0]
+    at = a.reshape(rows, _ROW_SUBLANES, _LANES)
+    bt = b.reshape(rows, _ROW_SUBLANES, _LANES)
+    return pl.pallas_call(
+        functools.partial(_fused_count_rows_kernel, op),
+        grid=(rows,),
+        in_specs=[
+            pl.BlockSpec((1, _ROW_SUBLANES, _LANES), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, _ROW_SUBLANES, _LANES), lambda i: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((rows,), lambda i: (0,), memory_space=pltpu.SMEM),
+        out_shape=jax.ShapeDtypeStruct((rows,), jnp.int32),
+        interpret=_interpret(),
+    )(at, bt)
+
+
+# TopN scoring is the AND case of the fused per-row count kernel.
+_top_counts_kernel = functools.partial(_fused_count_rows_kernel, "and")
 
 
 @jax.jit
