@@ -370,3 +370,96 @@ def _free_udp_port() -> int:
     port = s.getsockname()[1]
     s.close()
     return port
+
+
+class TestGossipCluster:
+    """Four real servers joined by UDP gossip: schema replicates through
+    gossip broadcast + state piggyback, queries fan out over the
+    cluster (the in-process analog of the reference's multi-node server
+    tests, server/server_test.go:376-497)."""
+
+    def test_four_node_gossip_cluster(self, tmp_path):
+        import time as _time
+
+        from pilosa_tpu.cluster.gossip import GossipNodeSet
+        from pilosa_tpu.cluster.topology import Cluster
+        from pilosa_tpu.ops.bitplane import SLICE_WIDTH
+
+        n = 4
+        gossip_ports = [_free_udp_port() for _ in range(n)]
+        # Gossip identity is the HTTP host, so allocate concrete HTTP
+        # ports up front (production configs always have them).
+        http_hosts = [f"127.0.0.1:{_free_tcp_port()}" for _ in range(n)]
+        servers, nodesets, clusters = [], [], []
+        for i in range(n):
+            ns = GossipNodeSet(
+                host=http_hosts[i],
+                seed="" if i == 0 else f"127.0.0.1:{gossip_ports[0]}",
+                gossip_interval=0.05,
+                suspect_after=5.0,
+            )
+            ns.bind = ("127.0.0.1", gossip_ports[i])
+            cluster = Cluster(replica_n=1)
+            cluster.node_set = ns
+            # placement: every cluster gets the full node list, same order
+            for h in sorted(http_hosts):
+                cluster.add_node(h)
+            s = Server(
+                data_dir=str(tmp_path / f"g{i}"),
+                host=http_hosts[i],
+                cluster=cluster,
+                broadcaster=ns,
+                broadcast_receiver=ns,
+                anti_entropy_interval=3600,
+                polling_interval=3600,
+                cache_flush_interval=3600,
+            )
+            servers.append(s)
+            nodesets.append(ns)
+            clusters.append(cluster)
+        try:
+            for s in servers:
+                s.open()
+
+            # membership converges
+            deadline = _time.time() + 10.0
+            while _time.time() < deadline:
+                if all(len(ns.nodes()) == n for ns in nodesets):
+                    break
+                _time.sleep(0.05)
+            assert all(len(ns.nodes()) == n for ns in nodesets), [
+                ns.nodes() for ns in nodesets
+            ]
+
+            # schema created on node 0 replicates via gossip broadcast
+            c0 = InternalClient(servers[0].host, timeout=10.0)
+            c0.create_index("i")
+            c0.create_frame("i", "f")
+            deadline = _time.time() + 10.0
+            while _time.time() < deadline:
+                if all(s.holder.frame("i", "f") is not None for s in servers):
+                    break
+                _time.sleep(0.05)
+            assert all(s.holder.frame("i", "f") is not None for s in servers)
+
+            # writes route across the cluster; any node answers the count
+            for sl in range(8):
+                c0.execute_query(
+                    "i",
+                    f'SetBit(frame="f", rowID=1, columnID={sl * SLICE_WIDTH})',
+                )
+            deadline = _time.time() + 10.0
+            want = None
+            while _time.time() < deadline:
+                c3 = InternalClient(servers[3].host, timeout=10.0)
+                want = c3.execute_pql("i", 'Count(Bitmap(frame="f", rowID=1))')
+                if want == 8:
+                    break
+                _time.sleep(0.1)
+            assert want == 8
+        finally:
+            for s in servers:
+                try:
+                    s.close()
+                except Exception:
+                    pass
