@@ -294,22 +294,17 @@ class Executor:
             acc = row if acc is None else (acc | row)
         return acc
 
-    def _eval_tree_slices(
-        self, index: str, c: Call, slices: list[int], reduce: str
-    ) -> dict[int, object]:
-        """Evaluate a bitmap call tree over local slices as one batched
-        device program: leaves for all slices stack into a
-        uint32[n_slices, n_leaves, 32768] array and the jitted tree fn is
-        vmapped over the slice axis — the TPU-shaped replacement for the
-        reference's goroutine-per-slice mapperLocal."""
-        expr, leaves = plan.decompose(c)
-        out: dict[int, object] = {}
-        if not slices:
-            return out
+    def _gather_leaf_stacks(self, index: str, c: Call, slices: list[int]):
+        """Fetch every slice's leaf rows onto its home device.
 
-        stacks = []
-        kept_slices = []
-        empties = []
+        Returns ``(expr, stacks, kept_slices, empties)``: ``stacks[i]``
+        is uint32[n_leaves, 32768] for ``kept_slices[i]`` (device-local);
+        ``empties`` are slices where no leaf has any bits (their result
+        is identically zero for every tree shape)."""
+        expr, leaves = plan.decompose(c)
+        stacks: list[object] = []
+        kept_slices: list[int] = []
+        empties: list[int] = []
         for s in slices:
             rows = []
             any_set = False
@@ -320,16 +315,29 @@ class Executor:
                 else:
                     any_set = True
                 rows.append(r)
-            if not leaves:
-                empties.append(s)
-                continue
-            if not any_set:
+            if not leaves or not any_set:
                 empties.append(s)
                 continue
             # All of a slice's leaves live on its home device, so this
             # stack stays device-local.
             stacks.append(jnp.stack(rows))
             kept_slices.append(s)
+        return expr, stacks, kept_slices, empties
+
+    def _eval_tree_slices(
+        self, index: str, c: Call, slices: list[int], reduce: str
+    ) -> dict[int, object]:
+        """Evaluate a bitmap call tree over local slices as one batched
+        device program: leaves for all slices stack into a
+        uint32[n_slices, n_leaves, 32768] array and the jitted tree fn is
+        vmapped over the slice axis — the TPU-shaped replacement for the
+        reference's goroutine-per-slice mapperLocal."""
+        out: dict[int, object] = {}
+        if not slices:
+            return out
+        expr, stacks, kept_slices, empties = self._gather_leaf_stacks(
+            index, c, slices
+        )
 
         for s in empties:
             out[s] = 0 if reduce == "count" else None
@@ -342,9 +350,15 @@ class Executor:
             out.update(self._eval_sharded(expr, reduce, kept_slices, stacks, mesh))
             return out
 
-        # Single device: pad the slice axis to a power of two — one
-        # compiled program per (tree shape, bucket) instead of per slice
-        # count (SURVEY.md §7 "dynamic shapes" — shape bucketing).
+        out.update(self._eval_single_device(expr, reduce, kept_slices, stacks))
+        return out
+
+    def _eval_single_device(
+        self, expr, reduce, kept_slices, stacks
+    ) -> dict[int, object]:
+        """Single device: pad the slice axis to a power of two — one
+        compiled program per (tree shape, bucket) instead of per slice
+        count (SURVEY.md §7 "dynamic shapes" — shape bucketing)."""
         n = len(stacks)
         bucket = 1 << (n - 1).bit_length()
         if bucket != n:
@@ -352,23 +366,50 @@ class Executor:
             stacks = stacks + [pad] * (bucket - n)
         batched = plan.compiled_batched(expr, reduce)
         res = batched(jnp.stack(stacks))
-        for i, s in enumerate(kept_slices):
-            out[s] = res[i]
-        return out
+        return {s: res[i] for i, s in enumerate(kept_slices)}
 
-    def _eval_sharded(
-        self, expr, reduce, kept_slices, stacks, mesh
-    ) -> dict[int, object]:
-        """Evaluate the batched tree over a multi-device slices mesh.
+    def _count_slices_total(self, index: str, c: Call, slices: list[int]) -> int:
+        """Count(tree) over local slices with the cross-slice reduce ON
+        DEVICE.
 
-        Slices are grouped by home device (slice mod n_devices, matching
-        fragment plane placement), per-device blocks are padded to one
-        power-of-two chunk, and the global batch is assembled shard-local
-        (parallel/mesh.assemble_sharded_batch) — the jitted tree program
-        then runs SPMD over the mesh, the in-host analog of the
-        reference's slice->node map/reduce (reference:
-        executor.go:1149-1243), with the reduce riding ICI instead of
-        HTTP fan-in."""
+        On a multi-device mesh the per-slice popcount partials sum
+        across the sharded slice axis inside the jitted program — XLA
+        inserts the all-reduce (psum over ICI) and only ONE scalar comes
+        back to the host, the collective replacement for the reference's
+        HTTP fan-in reduce (reference: executor.go:1176-1207).  Falls
+        back to the per-slice host sum (int64) beyond the int32-safe
+        partial budget or on single-device hosts."""
+        if not slices:
+            return 0
+        expr, stacks, kept_slices, _empties = self._gather_leaf_stacks(
+            index, c, slices
+        )
+        if not kept_slices:
+            return 0
+
+        mesh = pmesh.default_slices_mesh()
+        if mesh is not None and len(kept_slices) > 1:
+            batch, pos_of = self._assemble_mesh_batch(stacks, kept_slices, mesh)
+            # Zero pad slices contribute nothing, so the budget is on the
+            # real slice count, not the padded batch size.
+            if len(kept_slices) <= plan.MAX_INT32_COUNT_PARTIALS:
+                total = plan.compiled_total_count(expr, mesh)(batch)
+                return int(jax.device_get(total))
+            res = jax.device_get(
+                plan.compiled_batched(expr, "count", fused=False)(batch)
+            )
+            return int(sum(int(res[p]) for p in pos_of.values()))
+
+        res = self._eval_single_device(expr, "count", kept_slices, stacks)
+        return sum(int(v) for v in res.values())
+
+    def _assemble_mesh_batch(self, stacks, kept_slices, mesh):
+        """Group slices by home device (slice mod n_devices, matching
+        fragment plane placement), pad per-device blocks to one
+        power-of-two chunk, and assemble the global batch shard-local
+        (parallel/mesh.assemble_sharded_batch) — no device-to-device
+        traffic.  Returns ``(batch, pos_of)`` with ``pos_of[slice]`` the
+        slice's row in the global batch."""
         n_dev = int(mesh.devices.size)
         groups: dict[int, list[tuple[int, object]]] = {}
         for s, st in zip(kept_slices, stacks):
@@ -390,7 +431,16 @@ class Executor:
             for i, (s, _) in enumerate(g):
                 pos_of[s] = d * chunk + i
 
-        batch = pmesh.assemble_sharded_batch(blocks, mesh)
+        return pmesh.assemble_sharded_batch(blocks, mesh), pos_of
+
+    def _eval_sharded(
+        self, expr, reduce, kept_slices, stacks, mesh
+    ) -> dict[int, object]:
+        """Evaluate the batched tree over a multi-device slices mesh —
+        the jitted tree program runs SPMD over the mesh, the in-host
+        analog of the reference's slice->node map/reduce (reference:
+        executor.go:1149-1243)."""
+        batch, pos_of = self._assemble_mesh_batch(stacks, kept_slices, mesh)
         # plain-XLA formulation: partitions cleanly under SPMD
         res = plan.compiled_batched(expr, reduce, fused=False)(batch)
         res = jax.device_get(res)
@@ -463,8 +513,7 @@ class Executor:
         child = c.children[0]
 
         def map_fn(local_slices: list[int]):
-            counts = self._eval_tree_slices(index, child, local_slices, "count")
-            return sum(int(v) for v in counts.values())
+            return self._count_slices_total(index, child, local_slices)
 
         def reduce_fn(prev, v):
             return (prev or 0) + v

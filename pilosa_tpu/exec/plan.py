@@ -137,6 +137,39 @@ def compiled_batched(expr: tuple, reduce: str, fused: bool | None = None):
     return _compiled_batched(expr, reduce, fused and _fusable(expr, reduce))
 
 
+# int32 accumulation budget for on-device cross-slice count reduces: each
+# per-slice-row partial is <= 2^20 (one slice-row of bits), so up to 2047
+# partials sum below 2^31.  Callers fall back to the per-slice host sum
+# (int64) beyond this.
+MAX_INT32_COUNT_PARTIALS = 2047
+
+
+def compiled_total_count(expr: tuple, mesh):
+    """Count(tree) reduced to ONE replicated scalar on-device.
+
+    Input: uint32[n_slices, n_leaves, words] sharded P(slices, None,
+    None) over ``mesh``.  The per-slice popcount partials sum across the
+    sharded slice axis *inside* the jitted program, so the SPMD
+    partitioner inserts the cross-device all-reduce (psum riding
+    ICI) — the collective replacement for the reference's streaming HTTP
+    fan-in reduce (reference: executor.go:1176-1207).  Only the final
+    scalar ever reaches the host.
+
+    int32 accumulation: callers must guard
+    ``n_slices <= MAX_INT32_COUNT_PARTIALS``.
+    """
+    return _compiled_total_count(expr, mesh)
+
+
+@functools.lru_cache(maxsize=512)
+def _compiled_total_count(expr: tuple, mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rep = NamedSharding(mesh, P())
+    inner = _make_fn(expr, "count")
+    return jax.jit(lambda batch: inner(batch.swapaxes(0, 1)), out_shardings=rep)
+
+
 @functools.lru_cache(maxsize=512)
 def _compiled_batched(expr: tuple, reduce: str, use_fused: bool):
     if use_fused:

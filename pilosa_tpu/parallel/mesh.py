@@ -121,12 +121,25 @@ def _count_tree(expr: tuple, leaf_planes: jax.Array) -> jax.Array:
     return jnp.sum(jax.lax.population_count(out).astype(jnp.int32), axis=-1)
 
 
-def distributed_count(expr: tuple, leaf_planes: jax.Array) -> int:
+def distributed_count(
+    expr: tuple, leaf_planes: jax.Array, n_partials: int | None = None
+) -> int:
     """Count(tree) where each leaf is a full sharded plane.
 
     ``leaf_planes``: uint32[n_slices, n_leaves, rows, words] sharded
-    P(slices, None, rows, None).
+    P(slices, None, rows, None).  The cross-slice/cross-row reduce runs
+    on-device (plan.compiled_total_count — all-reduce over the mesh)
+    whenever the partial count fits the int32 budget; beyond that the
+    per-partial host sum (int64) takes over.  Callers whose planes carry
+    zero padding (shard_planes) may pass the REAL slice-row count as
+    ``n_partials`` — zero pads cannot overflow the budget.
     """
+    if n_partials is None:
+        n_partials = leaf_planes.shape[0] * leaf_planes.shape[2]
+    sh = leaf_planes.sharding
+    if isinstance(sh, NamedSharding) and n_partials <= plan.MAX_INT32_COUNT_PARTIALS:
+        total = plan.compiled_total_count(expr, sh.mesh)(leaf_planes)
+        return int(jax.device_get(total))
     return int(np.asarray(_count_tree(expr, leaf_planes), dtype=np.int64).sum())
 
 
@@ -148,11 +161,39 @@ def _topn_partials(plane: jax.Array, src: jax.Array):
     )
 
 
+@functools.lru_cache(maxsize=8)
+def _topn_total_fn(mesh: Mesh):
+    """Per-row |row AND src| totals with the cross-slice reduce
+    on-device: the slice-axis sum inside the jitted program becomes an
+    all-reduce over the slices mesh axis (and an all-gather over the
+    rows axis for the replicated [rows] output) — only the per-row
+    totals ever reach the host, not the [n_slices, rows] partials."""
+    rep = NamedSharding(mesh, P())
+
+    def fn(plane, src):
+        return jnp.sum(
+            jax.lax.population_count(plane & src[:, None, :]).astype(jnp.int32),
+            axis=(0, 2),
+        )
+
+    return jax.jit(fn, out_shardings=rep)
+
+
 def distributed_topn(plane: jax.Array, src: jax.Array, k: int):
     """TopN(Src=...) over a sharded fragment-stack: returns (counts,
     row_ids) host arrays, count-descending, ties toward lower id —
-    matching the reference Pair sort (reference: cache.go:316-330)."""
-    per = np.asarray(_topn_partials(plane, src), dtype=np.int64).sum(axis=0)
+    matching the reference Pair sort (reference: cache.go:316-330).
+
+    The cross-slice per-row reduce runs on-device (all-reduce) within
+    the int32 partial budget; the final rank (a [rows] vector) keeps the
+    host stable-argsort for the exact reference tie-break."""
+    sh = plane.sharding
+    if isinstance(sh, NamedSharding) and plane.shape[0] <= plan.MAX_INT32_COUNT_PARTIALS:
+        per = np.asarray(
+            jax.device_get(_topn_total_fn(sh.mesh)(plane, src)), dtype=np.int64
+        )
+    else:
+        per = np.asarray(_topn_partials(plane, src), dtype=np.int64).sum(axis=0)
     k = min(k, per.shape[0])
     ids = np.argsort(-per, kind="stable")[:k]
     return per[ids], ids

@@ -372,6 +372,15 @@ def _free_udp_port() -> int:
     return port
 
 
+def _free_tcp_port() -> int:
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
 class TestGossipCluster:
     """Four real servers joined by UDP gossip: schema replicates through
     gossip broadcast + state piggyback, queries fan out over the

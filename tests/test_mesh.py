@@ -104,6 +104,43 @@ def test_query_step_end_to_end(rng):
     np.testing.assert_array_equal(np.asarray(top_counts), per_row[order])
 
 
+def test_on_device_count_reduce_emits_collective(rng):
+    """The sharded Count program carries its cross-slice reduce as a
+    compiled collective (all-reduce) — only a scalar reaches the host
+    (VERDICT r1 item 3; reference analog: the HTTP fan-in reduce in
+    executor.go:1176-1207)."""
+    m = slice_mesh(8)
+    q = parse_string("Count(Intersect(Bitmap(rowID=1), Bitmap(rowID=2)))")
+    expr, _ = plan.decompose(q.calls[0].children[0])
+    planes = np.random.default_rng(3).integers(
+        0, 2**32, size=(8, 2, W), dtype=np.uint32
+    )
+    batch = jax.device_put(planes, NamedSharding(m, P(AXIS_SLICES, None, None)))
+    fn = plan.compiled_total_count(expr, m)
+    hlo = fn.lower(batch).compile().as_text()
+    assert "all-reduce" in hlo, hlo[:2000]
+    got = int(jax.device_get(fn(batch)))
+    assert got == int(np.bitwise_count(planes[:, 0] & planes[:, 1]).sum())
+
+
+def test_distributed_topn_reduce_on_device(rng):
+    """distributed_topn's cross-slice sum compiles to a collective and
+    transfers only the [rows] totals."""
+    from pilosa_tpu.parallel import mesh as pmesh
+
+    m = slice_mesh(8)
+    planes = rng.integers(0, 2**32, size=(8, 16, W), dtype=np.uint32)
+    src = rng.integers(0, 2**32, size=(8, W), dtype=np.uint32)
+    pl = jax.device_put(planes, NamedSharding(m, P(AXIS_SLICES, AXIS_ROWS, None)))
+    sr = jax.device_put(src, NamedSharding(m, P(AXIS_SLICES, None)))
+    fn = pmesh._topn_total_fn(m)
+    hlo = fn.lower(pl, sr).compile().as_text()
+    assert "all-reduce" in hlo, hlo[:2000]
+    per = np.asarray(jax.device_get(fn(pl, sr)))
+    want = np.bitwise_count(planes & src[:, None, :]).sum(axis=(0, 2))
+    np.testing.assert_array_equal(per, want)
+
+
 class TestShardedExecutor:
     """The executor's multi-device path: fragments pin planes to
     slice%n_devices and query batches assemble shard-local."""
@@ -164,6 +201,16 @@ class TestShardedExecutor:
         h, ex, parse = self._exec(tmp_path, n_slices=11)
         q = parse('Count(Bitmap(frame="f", rowID=1))')
         assert ex.execute("i", q) == [11]
+
+    def test_count_uses_on_device_total(self, tmp_path):
+        """Executor Count routes through the collective total-count
+        program (one scalar back to host), not per-slice device_get."""
+        h, ex, parse = self._exec(tmp_path)
+        before = plan._compiled_total_count.cache_info()
+        q = parse('Count(Bitmap(frame="f", rowID=1))')
+        assert ex.execute("i", q) == [8]
+        after = plan._compiled_total_count.cache_info()
+        assert after.hits + after.misses == before.hits + before.misses + 1
 
 
 def test_mesh_shape_config_caps_devices(monkeypatch):
