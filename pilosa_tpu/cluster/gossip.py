@@ -11,10 +11,14 @@ small UDP protocol instead of an external library:
   USER      → application messages (the 5 schema broadcast messages,
               type-byte envelope from cluster/broadcast.py)
 
-send_sync delivers a USER datagram to every live member (reference:
-SendSync via errgroup TCP, gossip.go:124-149); send_async sends to
-``gossip_fanout`` random members and relies on periodic exchange for
-convergence (reference: TransmitLimitedQueue, gossip.go:152-164).
+send_sync delivers a USER datagram to every live member and blocks
+until each peer ACKs it, retrying with backoff and raising on peers
+that never confirm — the UDP equivalent of the reference's reliable
+errgroup-TCP SendSync with error propagation (reference:
+gossip.go:124-149).  Receivers dedup message ids so retries stay
+exactly-once.  send_async sends to ``gossip_fanout`` random members
+and relies on periodic exchange for convergence (reference:
+TransmitLimitedQueue, gossip.go:152-164).
 Liveness: members not heard from within ``suspect_after`` are marked
 DOWN (reference surface: memberlist NotifyLeave → node state DOWN,
 cluster.go:161-173).
@@ -29,11 +33,14 @@ server.go:382-412).
 from __future__ import annotations
 
 import base64
+import itertools
 import json
 import random
 import socket
 import threading
 import time
+import uuid
+from collections import OrderedDict
 
 
 def gossip_port_for(host: str, offset: int = 1000) -> int:
@@ -87,6 +94,17 @@ class GossipNodeSet:
         # member -> {addr: (ip, port), last_seen: float, state: UP|DOWN}
         self._members: dict[str, dict] = {}
         self.on_membership_change = None  # callback(list[(host, state)])
+        # Reliable send_sync machinery: per-message ack events on the
+        # sender, an id-dedup LRU on the receiver (retries stay
+        # exactly-once).  Ids carry a per-process random prefix so a
+        # restarted node's fresh counter can never collide with ids a
+        # peer remembers from the previous incarnation.
+        self._msg_ids = itertools.count()
+        self._msg_prefix = uuid.uuid4().hex[:12]
+        self._ack_events: dict[str, threading.Event] = {}
+        self._seen_user: OrderedDict[str, float] = OrderedDict()
+        self.sync_retries = 5
+        self.ack_timeout = 0.25  # doubles per retry
 
     # ------------------------------------------------------------------
     # NodeSet
@@ -128,21 +146,61 @@ class GossipNodeSet:
     # ------------------------------------------------------------------
 
     def send_sync(self, msg) -> None:
+        """Deliver ``msg`` to every live member, blocking until each one
+        ACKs (retry with backoff); raises listing the peers that never
+        confirmed — reliable like the reference's TCP SendSync
+        (reference: gossip.go:124-149)."""
         from pilosa_tpu.cluster.broadcast import marshal_message
 
         payload = base64.b64encode(marshal_message(msg)).decode()
-        errors = []
+        errors: list[str] = []
+        errors_mu = threading.Lock()
+
+        def deliver(host: str, member: dict) -> None:
+            mid = f"{self._msg_prefix}/{next(self._msg_ids)}"
+            ev = threading.Event()
+            with self._mu:
+                self._ack_events[mid] = ev
+            try:
+                timeout = self.ack_timeout
+                for _ in range(self.sync_retries):
+                    try:
+                        self._send(
+                            member["addr"],
+                            {
+                                "t": "user",
+                                "from": self.host,
+                                "p": payload,
+                                "id": mid,
+                            },
+                        )
+                    except OSError as e:
+                        with errors_mu:
+                            errors.append(f"{host}: {e}")
+                        return
+                    if ev.wait(timeout):
+                        return
+                    timeout *= 2
+                with errors_mu:
+                    errors.append(f"{host}: no ack after {self.sync_retries} tries")
+            finally:
+                with self._mu:
+                    self._ack_events.pop(mid, None)
+
+        # Concurrent fan-out, like the reference's errgroup SendSync
+        # (reference: gossip.go:124-149) — total wall time is one peer's
+        # retry budget, not the sum over unresponsive peers.
+        threads = []
         for host, member in self._snapshot().items():
             if host == self.host or member["state"] != "UP":
                 continue
-            try:
-                self._send(
-                    member["addr"], {"t": "user", "from": self.host, "p": payload}
-                )
-            except OSError as e:
-                errors.append(f"{host}: {e}")
+            t = threading.Thread(target=deliver, args=(host, member), daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
         if errors:
-            raise RuntimeError("; ".join(errors))
+            raise RuntimeError("; ".join(sorted(errors)))
 
     def send_async(self, msg) -> None:
         from pilosa_tpu.cluster.broadcast import marshal_message
@@ -275,11 +333,47 @@ class GossipNodeSet:
             self._merge_members(obj.get("members", []))
             self._merge_state(obj)
         elif typ == "user":
-            if self._handler is not None:
+            mid = obj.get("id")
+            if self._handler is None:
+                # No handler wired yet — don't ack, so the sender keeps
+                # retrying until this node can actually apply messages.
+                return
+            if mid is None or not self._is_seen(mid):
                 from pilosa_tpu.cluster.broadcast import unmarshal_message
 
                 msg = unmarshal_message(base64.b64decode(obj["p"]))
+                # A handler exception propagates before the id is marked
+                # seen or acked — the sender's retry re-applies instead
+                # of being deduped into a silent drop.
                 self._handler.receive_message(msg)
+                if mid is not None:
+                    self._mark_seen(mid)
+            # Ack AFTER processing so a send_sync return means the
+            # message was handled, not merely received.
+            if mid is not None:
+                self._send(addr, {"t": "user-ack", "from": self.host, "id": mid})
+        elif typ == "user-ack":
+            with self._mu:
+                ev = self._ack_events.get(obj.get("id"))
+            if ev is not None:
+                ev.set()
+
+    def _is_seen(self, mid: str) -> bool:
+        """True when a user message id was already fully processed —
+        retries of it are acked but not re-applied."""
+        with self._mu:
+            if mid in self._seen_user:
+                self._seen_user.move_to_end(mid)
+                return True
+            return False
+
+    def _mark_seen(self, mid: str) -> None:
+        """Record a processed id (bounded LRU); called only after the
+        handler applied the message successfully."""
+        with self._mu:
+            self._seen_user[mid] = time.monotonic()
+            while len(self._seen_user) > 4096:
+                self._seen_user.popitem(last=False)
 
     def _state_field(self) -> dict:
         if self.state_provider is None:

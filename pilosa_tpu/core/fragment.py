@@ -44,6 +44,7 @@ import numpy as np
 from pilosa_tpu.core import cache as cache_mod
 from pilosa_tpu.core.bitmap import RowBitmap
 from pilosa_tpu.core.cache import Pair
+from pilosa_tpu.obs.stats import NopStatsClient
 from pilosa_tpu.ops import bitplane as bp
 from pilosa_tpu.ops import roaring
 
@@ -140,7 +141,7 @@ class Fragment:
         self.max_op_n = max_op_n
 
         self.row_attr_store = None  # wired by Frame
-        self.stats = None  # StatsClient, wired by View
+        self.stats = NopStatsClient()  # re-tagged by View._new_fragment
 
         self._mu = threading.RLock()
         # Compact row storage: plane row *slots* hold touched rows only;
@@ -163,6 +164,12 @@ class Fragment:
         self._file = None
         self._row_cache: dict[int, np.ndarray] = {}
         self.cache = cache_mod.new_cache(cache_type, cache_size)
+        # Block checksum cache: blocks() re-hashes only blocks written
+        # since the last call (the reference likewise caches block
+        # checksums and invalidates per-write, fragment.go:717-796).
+        # A None digest records "materialized but empty" (skipped).
+        self._block_sums: dict[int, bytes | None] = {}
+        self._dirty_blocks: set[int] = set()
         self._opened = False
 
     # ------------------------------------------------------------------
@@ -299,6 +306,8 @@ class Fragment:
         self._max_row_id = rows[-1] if rows else 0
         counts = bp.np_row_counts(plane[: len(rows)]) if rows else []
         self._count_of = {r: int(counts[i]) for i, r in enumerate(rows)}
+        self._block_sums.clear()
+        self._dirty_blocks.clear()
         self._invalidate_device()
 
     def _row_map(self) -> dict[int, np.ndarray]:
@@ -390,12 +399,17 @@ class Fragment:
     def set_bit(self, row_id: int, column_id: int) -> bool:
         with self._mu:
             pos = self.pos(row_id, column_id)
+            grew = row_id > self._max_row_id
             slot = self._ensure_slot(row_id)
             changed = bp.np_set_bit(self._plane, slot * SLICE_WIDTH + pos % SLICE_WIDTH)
             if changed:
                 self._queue_device_update(slot, pos % SLICE_WIDTH, 1)
                 self._append_op(roaring.OP_ADD, pos)
                 self._after_write(row_id, +1)
+                self.stats.count("setBit")  # reference: fragment.go:418
+                if grew:
+                    # reference: fragment.go:421-423
+                    self.stats.gauge("rows", float(self._max_row_id))
             return changed
 
     def clear_bit(self, row_id: int, column_id: int) -> bool:
@@ -409,6 +423,7 @@ class Fragment:
                 self._queue_device_update(slot, pos % SLICE_WIDTH, 0)
                 self._append_op(roaring.OP_REMOVE, pos)
                 self._after_write(row_id, -1)
+                self.stats.count("clearBit")  # reference: fragment.go:470
             return changed
 
     def _queue_device_update(self, slot: int, offset: int, op: int) -> None:
@@ -425,6 +440,7 @@ class Fragment:
     def _after_write(self, row_id: int, delta: int) -> None:
         self._version += 1
         self._row_cache.pop(row_id, None)
+        self._dirty_blocks.add(row_id // HASH_BLOCK_SIZE)
         n = self._count_of[row_id] = self._count_of.get(row_id, 0) + delta
         self.cache.add(row_id, n)
         self._op_n += 1
@@ -458,18 +474,21 @@ class Fragment:
             self._version += 1
             self._invalidate_device()
             self._row_cache.clear()
+            self._dirty_blocks.update(int(r) // HASH_BLOCK_SIZE for r in uniq)
             counts = bp.np_row_counts(self._plane)
             for r, s in slot_of.items():
                 self._count_of[r] = int(counts[s])
                 self.cache.bulk_add(r, int(counts[s]))
             self.cache.invalidate()
             self.cache.recalculate()
+            self.stats.count("ImportBit", len(row_ids))  # ref: fragment.go:969
             self.snapshot()
 
     def snapshot(self) -> None:
         """Full roaring serialization atomically renamed over the data
         file; resets the op count (reference: fragment.go:1032-1074)."""
         with self._mu:
+            t0 = time.perf_counter()
             data = roaring.encode(
                 roaring.row_map_to_containers(self._row_map(), SLICE_WIDTH)
             )
@@ -483,6 +502,8 @@ class Fragment:
             self._file = open(self.path, "a+b")
             fcntl.flock(self._file.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
             self._op_n = 0
+            # reference: fragment.go:1026-1030
+            self.stats.histogram("snapshot", time.perf_counter() - t0)
 
     # ------------------------------------------------------------------
     # TopN engine (reference: fragment.go:505-673)
@@ -614,10 +635,22 @@ class Fragment:
                 by_block.setdefault(r // HASH_BLOCK_SIZE, []).append(r)
             out = []
             for block_id in sorted(by_block):
-                block = self._block_rows(block_id, by_block[block_id])
-                if not block.any():
-                    continue
-                out.append((block_id, hashlib.sha1(block.tobytes()).digest()))
+                if (
+                    block_id in self._block_sums
+                    and block_id not in self._dirty_blocks
+                ):
+                    chk = self._block_sums[block_id]
+                else:
+                    block = self._block_rows(block_id, by_block[block_id])
+                    chk = (
+                        hashlib.sha1(block.tobytes()).digest()
+                        if block.any()
+                        else None
+                    )
+                    self._block_sums[block_id] = chk
+                    self._dirty_blocks.discard(block_id)
+                if chk is not None:
+                    out.append((block_id, chk))
             return out
 
     def _block_rows(self, block_id: int, rows: list[int]) -> np.ndarray:

@@ -29,6 +29,7 @@ from pilosa_tpu.core.view import (
     is_inverse_view,
     is_valid_view,
 )
+from pilosa_tpu.obs.stats import NopStatsClient
 from pilosa_tpu.ops.bitplane import SLICE_WIDTH
 
 # reference: frame.go:40-46
@@ -56,6 +57,7 @@ class Frame:
         self.time_quantum = ""
         self.row_attr_store = AttrStore(os.path.join(path, ".data"))
         self.on_create_slice = None  # wired by Index/Holder
+        self.stats = NopStatsClient()  # re-tagged by Index._new_frame
 
     # --- lifecycle (reference: frame.go:218-334) ---
 
@@ -144,7 +146,7 @@ class Frame:
     # --- views (reference: frame.go:336-395) ---
 
     def _new_view(self, name: str) -> View:
-        return View(
+        view = View(
             os.path.join(self.path, "views", name),
             self.index,
             self.name,
@@ -154,6 +156,8 @@ class Frame:
             row_attr_store=self.row_attr_store,
             on_create_slice=self.on_create_slice,
         )
+        view.stats = self.stats.with_tags(f"view:{name}")
+        return view
 
     def view(self, name: str) -> View | None:
         with self._mu:

@@ -17,6 +17,7 @@ from pilosa_tpu.core.frame import Frame
 from pilosa_tpu.core.index import Index
 from pilosa_tpu.core.names import ValidationError
 from pilosa_tpu.core.view import View
+from pilosa_tpu.obs.stats import NopStatsClient
 
 # reference: holder.go:30-31
 DEFAULT_CACHE_FLUSH_INTERVAL_S = 60.0
@@ -28,7 +29,12 @@ class Holder:
         self._mu = threading.RLock()
         self._indexes: dict[str, Index] = {}
         self.on_create_slice = None  # wired by Server before open()
-        self.stats = None
+        # Tag-qualified stats chain down the storage hierarchy:
+        # holder -> index:<n> -> frame:<n> -> view:<n> -> slice:<i>
+        # (reference: holder.go:259, index.go:443, frame.go:438,
+        # view.go:257).  Server replaces this with its configured client
+        # before open().
+        self.stats = NopStatsClient()
 
     # --- lifecycle ---
 
@@ -59,6 +65,7 @@ class Holder:
     def _new_index(self, name: str) -> Index:
         index = Index(os.path.join(self.path, name), name)
         index.on_create_slice = self.on_create_slice
+        index.stats = self.stats.with_tags(f"index:{name}")
         return index
 
     def index(self, name: str) -> Index | None:

@@ -18,6 +18,7 @@ import threading
 from pilosa_tpu.core.attr import AttrStore
 from pilosa_tpu.core.frame import Frame
 from pilosa_tpu.core.names import ValidationError, validate_label, validate_name
+from pilosa_tpu.obs.stats import NopStatsClient
 from pilosa_tpu.core import timequantum as tq
 
 # reference: index.go:33-35
@@ -43,6 +44,7 @@ class Index:
         self.remote_max_slice = 0
         self.remote_max_inverse_slice = 0
         self.on_create_slice = None  # wired by Holder/Server
+        self.stats = NopStatsClient()  # re-tagged by Holder._new_index
 
     # --- lifecycle (reference: index.go:134-228) ---
 
@@ -113,6 +115,7 @@ class Index:
     def _new_frame(self, name: str) -> Frame:
         frame = Frame(os.path.join(self.path, name), self.name, name)
         frame.on_create_slice = self.on_create_slice
+        frame.stats = self.stats.with_tags(f"frame:{name}")
         return frame
 
     def frame(self, name: str) -> Frame | None:
@@ -171,7 +174,9 @@ class Index:
             local = max(
                 (f.max_slice() for f in self._frames.values()), default=0
             )
-            return max(local, self.remote_max_slice)
+            m = max(local, self.remote_max_slice)
+            self.stats.gauge("maxSlice", float(m))  # reference: index.go:264
+            return m
 
     def max_inverse_slice(self) -> int:
         with self._mu:

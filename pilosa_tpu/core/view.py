@@ -17,6 +17,7 @@ from typing import Callable
 
 from pilosa_tpu.core import cache as cache_mod
 from pilosa_tpu.core.fragment import Fragment
+from pilosa_tpu.obs.stats import NopStatsClient
 from pilosa_tpu.ops.bitplane import SLICE_WIDTH
 
 VIEW_STANDARD = "standard"
@@ -54,6 +55,7 @@ class View:
         self.cache_size = cache_size
         self.row_attr_store = row_attr_store
         self.on_create_slice = on_create_slice
+        self.stats = NopStatsClient()  # re-tagged by Frame._new_view
         self._mu = threading.RLock()
         self._fragments: dict[int, Fragment] = {}
 
@@ -90,6 +92,7 @@ class View:
             cache_size=self.cache_size,
         )
         frag.row_attr_store = self.row_attr_store
+        frag.stats = self.stats.with_tags(f"slice:{slice_i}")
         return frag
 
     # --- accessors ---

@@ -331,6 +331,39 @@ class InternalClient:
         resp.ParseFromString(self._check(status, data))
         return list(resp.RowIDs), list(resp.ColumnIDs)
 
+    def import_view_bits(
+        self,
+        index: str,
+        frame: str,
+        view: str,
+        slice_i: int,
+        sets: tuple[list[int], list[int]],
+        clears: tuple[list[int], list[int]],
+    ) -> None:
+        """View-scoped raw sets/clears on THIS node — the anti-entropy
+        repair push for derived (inverse/time) views.  ``sets`` and
+        ``clears`` are (row_ids, absolute_column_ids) pairs."""
+        pb = wire.ImportViewRequest(
+            Index=index,
+            Frame=frame,
+            View=view,
+            Slice=slice_i,
+            RowIDs=[int(r) for r in sets[0]],
+            ColumnIDs=[int(c) for c in sets[1]],
+            ClearRowIDs=[int(r) for r in clears[0]],
+            ClearColumnIDs=[int(c) for c in clears[1]],
+        )
+        status, data = self._request(
+            "POST",
+            "/fragment/import-view",
+            body=pb.SerializeToString(),
+            headers={"Content-Type": PROTOBUF, "Accept": PROTOBUF},
+        )
+        resp = wire.ImportResponse()
+        resp.ParseFromString(self._check(status, data))
+        if resp.Err:
+            raise ClientError(500, resp.Err)
+
     def column_attr_diff(
         self, index: str, blocks: list[tuple[int, bytes]]
     ) -> dict[int, dict]:

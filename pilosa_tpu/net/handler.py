@@ -147,6 +147,7 @@ class Handler:
             ("GET", r"/fragment/data", self.handle_get_fragment_data),
             ("POST", r"/fragment/data", self.handle_post_fragment_data),
             ("GET", r"/fragment/blocks", self.handle_get_fragment_blocks),
+            ("POST", r"/fragment/import-view", self.handle_post_import_view),
             ("GET", r"/fragment/block/data", self.handle_get_fragment_block_data),
             ("GET", r"/debug/vars", self.handle_get_vars),
             ("GET", r"/debug/pprof(?P<rest>/.*)?", self.handle_get_pprof),
@@ -560,6 +561,46 @@ class Handler:
         ] if pb.Timestamps else None
         try:
             f.import_bulk(list(pb.RowIDs), list(pb.ColumnIDs), timestamps)
+        except Exception as e:  # noqa: BLE001
+            return Response.proto(wire.ImportResponse(Err=str(e)), status=500)
+        return Response.proto(wire.ImportResponse())
+
+    def handle_post_import_view(self, req: Request) -> Response:
+        """View-scoped raw sets/clears — the anti-entropy repair path
+        for derived (inverse/time) views, which the PQL write fan-out
+        cannot target individually (pilosa_tpu extension; the reference
+        only repairs the standard view, fragment.go:1443)."""
+        pb = wire.ImportViewRequest()
+        try:
+            pb.ParseFromString(req.body)
+        except Exception as e:  # noqa: BLE001
+            return Response.error(str(e), 400)
+        if self.cluster is not None and self.executor is not None:
+            owners = {
+                n.host for n in self.cluster.fragment_nodes(pb.Index, pb.Slice)
+            }
+            if self.executor.host not in owners:
+                return Response.error(
+                    f"host does not own slice {self.executor.host}"
+                    f" slice={pb.Slice}",
+                    412,
+                )
+        f = self.holder.frame(pb.Index, pb.Frame)
+        if f is None:
+            return Response.error("frame not found", 404)
+        if len(pb.RowIDs) != len(pb.ColumnIDs) or len(pb.ClearRowIDs) != len(
+            pb.ClearColumnIDs
+        ):
+            # zip would silently truncate a malformed pair list — reject
+            # like Fragment.merge_block does on the read side.
+            return Response.error("row/column id length mismatch", 400)
+        try:
+            view = f.create_view_if_not_exists(pb.View)
+            frag = view.create_fragment_if_not_exists(pb.Slice)
+            for r, c in zip(pb.RowIDs, pb.ColumnIDs):
+                frag.set_bit(int(r), int(c))
+            for r, c in zip(pb.ClearRowIDs, pb.ClearColumnIDs):
+                frag.clear_bit(int(r), int(c))
         except Exception as e:  # noqa: BLE001
             return Response.proto(wire.ImportResponse(Err=str(e)), status=500)
         return Response.proto(wire.ImportResponse())

@@ -77,6 +77,11 @@ class Server:
         # Max-slice growth must reach peers before queries route there
         # (reference: view.go:236-241 broadcasts CreateSliceMessage).
         self.holder.on_create_slice = self._on_create_slice
+        if self.stats is not None:
+            # Root of the tag chain: indexes opened from disk (and all
+            # their frames/views/fragments) pick up tagged children
+            # (reference: server.go wiring of holder.Stats).
+            self.holder.stats = self.stats
         self.holder.open()
 
         # Start HTTP listener first so ":0" resolves to the real port

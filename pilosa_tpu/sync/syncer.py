@@ -24,7 +24,7 @@ from __future__ import annotations
 import threading
 
 from pilosa_tpu.core.fragment import PairSet
-from pilosa_tpu.core.view import VIEW_STANDARD
+from pilosa_tpu.core.view import VIEW_STANDARD, is_inverse_view
 from pilosa_tpu.net.client import ClientError, InternalClient
 from pilosa_tpu.ops.bitplane import SLICE_WIDTH
 
@@ -56,16 +56,20 @@ class HolderSyncer:
                     return
                 self.sync_frame(index_name, frame_name)
                 for view_name, view in sorted(frame.views().items()):
-                    # Block sync exchanges standard-view bit dumps only
-                    # (the reference hardcodes ViewStandard in syncBlock,
-                    # reference: fragment.go:1443); merging standard data
-                    # into inverse/time fragments would transpose bits,
-                    # so non-standard views are skipped here — they
-                    # converge through the pushed SetBit/ClearBit PQL,
-                    # which fans out to all of a frame's views.
-                    if view_name != VIEW_STANDARD:
-                        continue
-                    max_slice = idx.max_slice()
+                    # Every view's fragments sync, like the reference's
+                    # holder walk (reference: holder.go:403-425).  The
+                    # standard view repairs remotes via PQL push (which
+                    # fans out to derived views); inverse/time views
+                    # exchange and repair their OWN block data through
+                    # the view-scoped import path, so divergence
+                    # introduced directly in a derived view converges
+                    # too (the reference only ever merges standard
+                    # data, fragment.go:1443).
+                    max_slice = (
+                        idx.max_inverse_slice()
+                        if is_inverse_view(view_name)
+                        else idx.max_slice()
+                    )
                     for slice_i in range(max_slice + 1):
                         if self.is_closing():
                             return
@@ -73,9 +77,10 @@ class HolderSyncer:
                             self.host, index_name, slice_i
                         ):
                             continue
-                        frag = view.fragment(slice_i)
-                        if frag is None:
-                            continue
+                        # Create locally-absent fragments so data that
+                        # exists only on peers is pulled (reference:
+                        # holder.go:533-546 CreateFragmentIfNotExists).
+                        view.create_fragment_if_not_exists(slice_i)
                         self.sync_fragment(index_name, frame_name, view_name, slice_i)
 
     def sync_index(self, index: str) -> None:
@@ -176,6 +181,7 @@ class FragmentSyncer:
             if self.is_closing():
                 return
             self.sync_block(block_id)
+            f.stats.count("BlockRepair")  # reference: fragment.go:1412
 
     def sync_block(self, block_id: int) -> None:
         """reference: fragment.go:1420-1498"""
@@ -188,10 +194,17 @@ class FragmentSyncer:
             if self.is_closing():
                 return
             client = self.client_factory(node.host)
-            # Only the standard view participates in block sync.
-            row_ids, column_ids = client.block_data(
-                f.index, f.frame, VIEW_STANDARD, f.slice, block_id
-            )
+            # Each view exchanges its OWN block data (a 404 means the
+            # peer hasn't materialized this derived view yet — treat as
+            # empty so the consensus can still pull/push).
+            try:
+                row_ids, column_ids = client.block_data(
+                    f.index, f.frame, f.view, f.slice, block_id
+                )
+            except ClientError as e:
+                if e.status != 404:
+                    raise
+                row_ids, column_ids = [], []
             pair_sets.append(PairSet(row_ids=row_ids, column_ids=column_ids))
             hosts.append(node.host)
 
@@ -199,22 +212,37 @@ class FragmentSyncer:
             return
         sets, clears = f.merge_block(block_id, pair_sets)
 
-        # Push each remote's diff back as generated PQL.
         base = f.slice * SLICE_WIDTH
         for host, set_ps, clear_ps in zip(hosts, sets, clears):
             if not set_ps.column_ids and not clear_ps.column_ids:
                 continue
-            lines = []
-            for r, c in zip(set_ps.row_ids, set_ps.column_ids):
-                lines.append(
-                    f'SetBit(frame="{f.frame}", rowID={r}, columnID={base + c})'
-                )
-            for r, c in zip(clear_ps.row_ids, clear_ps.column_ids):
-                lines.append(
-                    f'ClearBit(frame="{f.frame}", rowID={r}, columnID={base + c})'
-                )
             if self.is_closing():
                 return
-            self.client_factory(host).execute_query(
-                f.index, "\n".join(lines), remote=False
-            )
+            if f.view == VIEW_STANDARD:
+                # Standard diffs push back as generated PQL, which fans
+                # out through the remote's whole write path (all views,
+                # caches, op-log) — reference: fragment.go:1465-1492.
+                lines = []
+                for r, c in zip(set_ps.row_ids, set_ps.column_ids):
+                    lines.append(
+                        f'SetBit(frame="{f.frame}", rowID={r}, columnID={base + c})'
+                    )
+                for r, c in zip(clear_ps.row_ids, clear_ps.column_ids):
+                    lines.append(
+                        f'ClearBit(frame="{f.frame}", rowID={r}, columnID={base + c})'
+                    )
+                self.client_factory(host).execute_query(
+                    f.index, "\n".join(lines), remote=False
+                )
+            else:
+                # Derived views repair via the view-scoped raw write
+                # path: PQL cannot target an individual inverse/time
+                # view.
+                self.client_factory(host).import_view_bits(
+                    f.index,
+                    f.frame,
+                    f.view,
+                    f.slice,
+                    (set_ps.row_ids, [base + c for c in set_ps.column_ids]),
+                    (clear_ps.row_ids, [base + c for c in clear_ps.column_ids]),
+                )

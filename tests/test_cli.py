@@ -288,6 +288,46 @@ class TestStats:
         finally:
             s.close()
 
+    def test_storage_stats_tag_chain(self, tmp_path):
+        """Writes surface as tag-qualified counters/gauges through the
+        holder->index->frame->view->slice chain (reference: holder.go:259,
+        index.go:443, frame.go:438, view.go:257, fragment.go:412-473)."""
+        s = Server(
+            data_dir=str(tmp_path / "sv"),
+            stats=stats_mod.ExpvarStatsClient(),
+            anti_entropy_interval=3600, polling_interval=3600,
+            cache_flush_interval=3600,
+        )
+        s.open()
+        try:
+            c = InternalClient(s.host, timeout=10.0)
+            c.create_index("i")
+            c.create_frame("i", "f")
+            c.execute_query("i", 'SetBit(frame="f", rowID=4, columnID=2)')
+            c.execute_query("i", 'SetBit(frame="f", rowID=4, columnID=3)')
+            c.execute_query("i", 'ClearBit(frame="f", rowID=4, columnID=3)')
+            # Reads gauge maxSlice (reference gauges inside MaxSlice()).
+            c.execute_pql("i", 'Count(Bitmap(frame="f", rowID=4))')
+            status, data = c._request("GET", "/debug/vars")
+            snap = json.loads(data)["stats"]
+            key = "setBit[frame:f,index:i,slice:0,view:standard]"
+            assert snap["counts"].get(key) == 2, snap["counts"]
+            assert (
+                snap["counts"].get(
+                    "clearBit[frame:f,index:i,slice:0,view:standard]"
+                )
+                == 1
+            )
+            assert (
+                snap["gauges"].get(
+                    "rows[frame:f,index:i,slice:0,view:standard]"
+                )
+                == 4.0
+            )
+            assert snap["gauges"].get("maxSlice[index:i]") == 0.0
+        finally:
+            s.close()
+
 
 # ---------------------------------------------------------------------------
 # gossip
@@ -332,6 +372,59 @@ class TestGossip:
             while time.time() < deadline and not received:
                 time.sleep(0.02)
             assert received and received[0].Index == "y"
+        finally:
+            a.close()
+            b.close()
+
+    def test_send_sync_survives_dropped_datagram(self):
+        """send_sync is reliable over lossy UDP: drop the first USER
+        datagram on the wire — the ack+retry loop still delivers it,
+        synchronously, exactly once (reference analog: reliable TCP
+        SendSync, gossip.go:124-149)."""
+        from pilosa_tpu.cluster.gossip import GossipNodeSet
+        from pilosa_tpu.net import wire_pb2 as wire
+
+        received = []
+
+        class H:
+            def receive_message(self, msg):
+                received.append(msg)
+
+        a = GossipNodeSet(host="127.0.0.1:1", bind="127.0.0.1:0",
+                          gossip_interval=0.05, suspect_after=5.0)
+        a.bind = ("127.0.0.1", _free_udp_port())
+        a.start(H())
+        a.open()
+        b = GossipNodeSet(
+            host="127.0.0.1:2", bind="127.0.0.1:0",
+            seed=f"{a.bind[0]}:{a.bind[1]}",
+            gossip_interval=0.05, suspect_after=5.0,
+        )
+        b.bind = ("127.0.0.1", _free_udp_port())
+        b.start(H())
+        b.open()
+
+        dropped = []
+        orig_send = a._send
+
+        def lossy_send(addr, obj):
+            if obj.get("t") == "user" and not dropped:
+                dropped.append(obj)  # swallow the first USER datagram
+                return
+            orig_send(addr, obj)
+
+        a._send = lossy_send
+        try:
+            deadline = time.time() + 5.0
+            while time.time() < deadline:
+                if "127.0.0.1:2" in a.nodes() and "127.0.0.1:1" in b.nodes():
+                    break
+                time.sleep(0.02)
+            a.send_sync(wire.DeleteIndexMessage(Index="y"))
+            # Reliable send_sync is synchronous: the message was already
+            # handled when the call returned, despite the dropped packet.
+            assert dropped, "drop injection never triggered"
+            assert len(received) == 1 and received[0].Index == "y"
         finally:
             a.close()
             b.close()

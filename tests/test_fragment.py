@@ -176,6 +176,57 @@ def test_blocks_checksums_change(frag):
     assert frag.checksum() != b""
 
 
+def test_blocks_cached_until_write(frag, monkeypatch):
+    """blocks() on an unmodified fragment re-hashes nothing; a write
+    re-hashes only the touched block (VERDICT r1: the reference caches
+    block checksums and invalidates per-write, fragment.go:717-796)."""
+    frag.set_bit(0, 1)
+    frag.set_bit(150, 2)  # block 1
+    b1 = frag.blocks()
+
+    computed = []
+    orig = Fragment._block_rows
+
+    def spy(self, block_id, rows):
+        computed.append(block_id)
+        return orig(self, block_id, rows)
+
+    monkeypatch.setattr(Fragment, "_block_rows", spy)
+    assert frag.blocks() == b1
+    assert computed == []  # fully served from cache
+
+    frag.set_bit(160, 3)  # dirty block 1 only
+    b2 = frag.blocks()
+    assert computed == [1]
+    assert b2[0] == b1[0]
+    assert b2[1] != b1[1]
+
+    # clear_bit dirties too; unchanged no-op writes don't
+    frag.clear_bit(160, 3)
+    assert frag.blocks() == b1
+    assert computed == [1, 1]
+    frag.clear_bit(160, 3)  # already clear: no change, no re-hash
+    assert frag.blocks() == b1
+    assert computed == [1, 1]
+
+    # import_bulk dirties every touched block
+    frag.import_bulk([0, 205], [7, 8])
+    frag.blocks()
+    assert sorted(computed[2:]) == [0, 2]
+
+
+def test_blocks_cache_reset_on_reopen(tmp_path):
+    f = Fragment(str(tmp_path / "0"), "i", "f", "standard", 0)
+    f.open()
+    f.set_bit(3, 4)
+    want = f.blocks()
+    f2 = reopen(f)
+    try:
+        assert f2.blocks() == want
+    finally:
+        f2.close()
+
+
 def test_block_data(frag):
     frag.set_bit(0, 5)
     frag.set_bit(102, 9)

@@ -540,6 +540,68 @@ class TestAntiEntropy:
         for col in (base + 1, base + 2, base + 3):
             c0.execute_query("i", f'SetBit(frame="f", rowID=0, columnID={col})')
 
+    def test_inverse_view_divergence_converges(self, tmp_path):
+        """Divergence introduced DIRECTLY in a derived (inverse) view —
+        e.g. a partial import on one replica — is detected and repaired
+        through the view-scoped sync path, something standard-only block
+        sync can never see (the reference walks every view,
+        holder.go:524-556, but only merges standard data,
+        fragment.go:1443)."""
+        from pilosa_tpu.sync.syncer import HolderSyncer
+
+        clusters = [Cluster(replica_n=2) for _ in range(2)]
+        servers = [
+            Server(
+                data_dir=str(tmp_path / f"r{i}"),
+                cluster=clusters[i],
+                anti_entropy_interval=3600,
+                polling_interval=3600,
+                cache_flush_interval=3600,
+            )
+            for i in range(2)
+        ]
+        for s in servers:
+            s.open()
+        try:
+            hosts = sorted(s.host for s in servers)
+            for c in clusters:
+                for h in hosts:
+                    if c.node_by_host(h) is None:
+                        c.add_node(h)
+                c.nodes.sort(key=lambda n: n.host)
+            s0, s1 = servers
+            for s in servers:
+                s.holder.create_index_if_not_exists("i")
+                s.holder.index("i").create_frame_if_not_exists(
+                    "f", inverse_enabled=True
+                )
+            # Identical data on both replicas through the write fan-out.
+            c0 = InternalClient(s0.host, timeout=10.0)
+            c0.execute_query("i", 'SetBit(frame="f", rowID=3, columnID=9)')
+            # Diverge ONLY s1's inverse view: direct fragment write that
+            # no broadcast or standard-view checksum can observe.
+            frag1 = s1.holder.fragment("i", "f", "inverse", 0)
+            assert frag1 is not None
+            frag1.set_bit(42, 7)
+            # ...and s0's, in the other direction — this one must be
+            # PUSHED to s1 over the view-scoped import endpoint.
+            frag0 = s0.holder.fragment("i", "f", "inverse", 0)
+            frag0.set_bit(43, 8)
+            # Standard views still agree everywhere.
+            std0 = dict(s0.holder.fragment("i", "f", "standard", 0).blocks())
+            std1 = dict(s1.holder.fragment("i", "f", "standard", 0).blocks())
+            assert std0 == std1
+            # Anti-entropy from s0 pulls the diverged inverse bit.
+            HolderSyncer(
+                holder=s0.holder, host=s0.host, cluster=clusters[0]
+            ).sync_holder()
+            assert frag0.contains(42, 7)  # pulled from s1
+            assert frag1.contains(43, 8)  # pushed to s1
+            assert dict(frag0.blocks()) == dict(frag1.blocks())
+        finally:
+            for s in servers:
+                s.close()
+
     def test_attr_sync(self, two_servers):
         from pilosa_tpu.sync.syncer import HolderSyncer
 
