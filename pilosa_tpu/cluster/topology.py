@@ -116,6 +116,11 @@ class Cluster:
             # protocol); tolerate Node objects too.
             for n in self.node_set.nodes():
                 up.add(n if isinstance(n, str) else n.host)
+        else:
+            # Static clusters have no failure detector; every configured
+            # node counts as UP (the reference's StaticNodeSet returns
+            # the full list, cluster.go:62-86).
+            up = {n.host for n in self.nodes}
         out = {}
         for n in self.nodes:
             n.state = NODE_STATE_UP if n.host in up else NODE_STATE_DOWN

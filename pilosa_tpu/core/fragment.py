@@ -813,22 +813,25 @@ class Fragment:
     # ------------------------------------------------------------------
 
     def for_each_bit(self) -> Iterable[tuple[int, int]]:
-        """Yield (rowID, absolute columnID) for every set bit (reference:
-        fragment.go:487-502)."""
+        """Yield (rowID, absolute columnID) for every set bit, streaming
+        one row-block at a time (reference: fragment.go:487-502 over the
+        container iterators, roaring/roaring.go:742-840).
+
+        Peak extra memory is ONE unpacked row (~1 MiB), not the fully
+        unpacked plane — exports and sync walks of big fragments stay
+        under 2x plane memory."""
         with self._mu:
             rows = sorted(self._slot_of)
-            plane = (
-                self._plane[np.asarray([self._slot_of[r] for r in rows])]
-                if rows
-                else np.zeros((0, bp.WORDS_PER_SLICE), np.uint32)
-            )
         base = self.slice * SLICE_WIDTH
-        bits = np.unpackbits(
-            np.ascontiguousarray(plane).view(np.uint8), bitorder="little"
-        ).reshape(plane.shape[0], SLICE_WIDTH)
-        rws, cls = np.nonzero(bits)
-        for r, c in zip(rws, cls):
-            yield rows[int(r)], base + int(c)
+        for r in rows:
+            with self._mu:
+                slot = self._slot_of.get(r)
+                if slot is None:
+                    continue
+                words = self._plane[slot].copy()
+            bits = np.unpackbits(words.view(np.uint8), bitorder="little")
+            for c in np.nonzero(bits)[0]:
+                yield r, base + int(c)
 
     def __repr__(self) -> str:
         return (

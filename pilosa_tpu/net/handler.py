@@ -241,6 +241,10 @@ class Handler:
         return self.handle_get_schema(req)
 
     def handle_get_status(self, req: Request) -> Response:
+        if self.cluster is not None:
+            # Refresh Node.state from the membership backend (or the
+            # static all-UP default) before reporting.
+            self.cluster.node_states()
         status = {
             "Nodes": [
                 {

@@ -319,6 +319,28 @@ def test_flock_excludes_second_opener(tmp_path, frag):
         f2.open()
 
 
+def test_for_each_bit_streams_rows(frag):
+    """Iteration peak memory is one unpacked row, not the whole plane
+    (VERDICT r1 item 10; reference streams via container iterators,
+    roaring/roaring.go:742-840)."""
+    import tracemalloc
+
+    for r in range(16):
+        frag.set_bit(r, r * 3)
+        frag.set_bit(r, SW - 1 - r)
+    want = sorted(
+        [(r, r * 3) for r in range(16)] + [(r, SW - 1 - r) for r in range(16)]
+    )
+    tracemalloc.start()
+    got = sorted(frag.for_each_bit())
+    _, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    assert got == want
+    # One unpacked row is SLICE_WIDTH bytes (~1 MiB); the old
+    # implementation unpacked all 16 rows at once (~17 MiB).
+    assert peak < 3 * SW, f"peak {peak} suggests whole-plane unpack"
+
+
 def test_for_each_bit(frag):
     frag.set_bit(2, 5)
     frag.set_bit(0, 1)

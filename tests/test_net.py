@@ -300,7 +300,18 @@ class TestServerHTTP:
     def test_webui(self, server, client):
         status, data = client._request("GET", "/")
         assert status == 200 and b"pilosa-tpu" in data
+        # The console shell carries the three interface areas the
+        # reference console has: REPL, index dropdown, cluster pane.
+        for marker in (b'id="query"', b'id="index-dropdown"', b'id="pane-cluster"'):
+            assert marker in data, marker
         status, data = client._request("GET", "/assets/main.js")
+        assert status == 200
+        # Feature markers: REPL history, tab completion, meta commands,
+        # cluster rendering (reference: webui/assets/main.js).
+        for marker in (b"class Repl", b"completeAtCursor", b"parseMeta",
+                       b"refreshCluster"):
+            assert marker in data, marker
+        status, data = client._request("GET", "/assets/main.css")
         assert status == 200
         status, _ = client._request("GET", "/assets/nope.js")
         assert status == 404
