@@ -25,7 +25,38 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def wait_for_backend(attempts: int = 8, delay_s: float = 60.0) -> None:
+    """Probe accelerator init in SUBPROCESSES until one succeeds.
+
+    The axon TPU tunnel can be wedged for many minutes after an earlier
+    killed process (leaked session grant); a failed in-process backend
+    init is cached by JAX, so probing must happen out-of-process.  Turns
+    a transiently-wedged tunnel into a delayed bench instead of a
+    crashed one (round-1 BENCH artifact failure mode)."""
+    import subprocess
+    import time as _time
+
+    for i in range(attempts):
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices(); print('OK')"],
+            capture_output=True,
+            text=True,
+            timeout=900,
+        )
+        if probe.returncode == 0 and "OK" in probe.stdout:
+            return
+        tail = (probe.stderr or probe.stdout).strip().splitlines()
+        log(
+            f"backend probe {i + 1}/{attempts} failed"
+            f" ({tail[-1] if tail else 'no output'}); retrying in {delay_s:.0f}s"
+        )
+        _time.sleep(delay_s)
+    log("backend never came up; proceeding (the real error will surface)")
+
+
 def main() -> None:
+    wait_for_backend()
+
     import jax
     import jax.numpy as jnp
 
@@ -53,22 +84,39 @@ def main() -> None:
     # --- device: fused Intersect+Count, batched over all slices ---
     q = parse_string("Count(Intersect(Bitmap(rowID=1), Bitmap(rowID=2)))")
     expr, _ = plan.decompose(q.calls[0].children[0])
-    fn = plan.compiled_batched(expr, "count")
 
     dev = jnp.asarray(leaves)
     jax.block_until_ready(dev)
-    # warmup/compile
-    out = jax.block_until_ready(fn(dev))
-    dev_count = int(np.asarray(out, dtype=np.int64).sum())
-    assert dev_count == host_count, f"bit-exactness: {dev_count} != {host_count}"
 
-    iters = 20
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(dev)
-    jax.block_until_ready(out)
-    dev_s = (time.perf_counter() - t0) / iters
-    log(f"device fused Intersect+Count: {dev_s*1e3:.2f} ms/query (x{iters})")
+    def time_variant(name: str, fn) -> float:
+        out = jax.block_until_ready(fn(dev))  # warmup/compile
+        got = int(np.asarray(out, dtype=np.int64).sum())
+        assert got == host_count, f"bit-exactness ({name}): {got} != {host_count}"
+        iters = 20
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(dev)
+        jax.block_until_ready(out)
+        s = (time.perf_counter() - t0) / iters
+        log(f"device {name} Intersect+Count: {s*1e3:.2f} ms/query (x{iters})")
+        return s
+
+    # Keep-or-kill evidence for the fused Pallas kernel path: time it
+    # against the plain-XLA formulation on the same data (VERDICT r1
+    # item 4) and take the better one as the headline.
+    plain_s = time_variant("plain-XLA", plan.compiled_batched(expr, "count", fused=False))
+    variants = {"plain-XLA": plain_s}
+    from pilosa_tpu.ops.bitplane import _use_pallas
+
+    if _use_pallas():
+        variants["fused-pallas"] = time_variant(
+            "fused-pallas", plan.compiled_batched(expr, "count", fused=True)
+        )
+        ratio = plain_s / variants["fused-pallas"]
+        log(f"fused-pallas vs plain-XLA speedup: {ratio:.3f}x")
+    best = min(variants, key=variants.get)
+    dev_s = variants[best]
+    log(f"headline variant: {best}")
 
     # --- secondary: TopN(n=100) scoring latency (BASELINE configs[2]) ---
     # 2048 candidate rows scored against a src row in one batched kernel;

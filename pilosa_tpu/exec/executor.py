@@ -407,24 +407,39 @@ class Executor:
         """Group slices by home device (slice mod n_devices, matching
         fragment plane placement), pad per-device blocks to one
         power-of-two chunk, and assemble the global batch shard-local
-        (parallel/mesh.assemble_sharded_batch) — no device-to-device
-        traffic.  Returns ``(batch, pos_of)`` with ``pos_of[slice]`` the
-        slice's row in the global batch."""
+        (parallel/mesh.assemble_sharded_batch).  Returns ``(batch,
+        pos_of)`` with ``pos_of[slice]`` the slice's row in the global
+        batch.
+
+        The chunk is sized for a BALANCED distribution (pow2 >=
+        ceil(n/n_devices)); when the queried slice set is clustered mod
+        n_devices, the overflow spills to devices with free rows (one
+        plane transfer per spilled slice) instead of inflating every
+        device's padding to the largest group — at pod scale, mostly-
+        zero compute costs more than the occasional spill copy."""
         n_dev = int(mesh.devices.size)
-        groups: dict[int, list[tuple[int, object]]] = {}
+        groups: dict[int, list[tuple[int, object]]] = {d: [] for d in range(n_dev)}
         for s, st in zip(kept_slices, stacks):
-            groups.setdefault(s % n_dev, []).append((s, st))
-        longest = max(len(g) for g in groups.values())
-        chunk = 1 << (longest - 1).bit_length()
+            groups[s % n_dev].append((s, st))
+        chunk = 1 << (((len(kept_slices) + n_dev - 1) // n_dev) - 1).bit_length()
+
+        spill: list[tuple[int, object]] = []
+        for d in range(n_dev):
+            while len(groups[d]) > chunk:
+                spill.append(groups[d].pop())
 
         blocks = []
         pos_of: dict[int, int] = {}
         for d in range(n_dev):
-            g = groups.get(d, [])
+            g = groups[d]
+            dev = mesh.devices.flat[d]
+            while spill and len(g) < chunk:
+                s, st = spill.pop()
+                g.append((s, jax.device_put(st, dev)))
             entries = [st for _, st in g]
             if len(entries) < chunk:
                 zero_stack = jnp.stack(
-                    [self._zero_row_on(mesh.devices.flat[d])] * stacks[0].shape[0]
+                    [self._zero_row_on(dev)] * stacks[0].shape[0]
                 )
                 entries = entries + [zero_stack] * (chunk - len(entries))
             blocks.append(jnp.stack(entries))

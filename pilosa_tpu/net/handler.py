@@ -722,17 +722,57 @@ class Handler:
         return Response.json(payload)
 
     def handle_get_pprof(self, req: Request, rest: str | None = None) -> Response:
-        """Thread-stack dump — the Python analog of /debug/pprof/goroutine
-        (full CPU profiling is via py-spy on the host)."""
-        frames = sys._current_frames()
-        out = io.StringIO()
-        for t in threading.enumerate():
-            out.write(f"thread {t.name} (daemon={t.daemon})\n")
-            fr = frames.get(t.ident)
-            if fr is not None:
-                out.write("".join(traceback.format_stack(fr)))
-            out.write("\n")
-        return Response(body=out.getvalue().encode(), content_type="text/plain")
+        """Profiling endpoints — the Python analog of the reference's
+        net/http/pprof mount (reference: handler.go:111-112):
+
+        * ``/debug/pprof`` or ``/goroutine`` — live thread-stack dump;
+        * ``/debug/pprof/profile?seconds=N`` — statistical CPU profile:
+          samples every thread's stack at ~100 Hz for N seconds (default
+          5, max 60) and returns folded stacks ("f1;f2;f3 count"), the
+          flamegraph-ready equivalent of the pprof CPU profile;
+        * ``/debug/pprof/heap`` — tracemalloc top allocations
+          (``?start=1`` begins tracing, ``?stop=1`` ends it).
+        """
+        kind = (rest or "/").strip("/") or "goroutine"
+        if kind == "goroutine":
+            frames = sys._current_frames()
+            out = io.StringIO()
+            for t in threading.enumerate():
+                out.write(f"thread {t.name} (daemon={t.daemon})\n")
+                fr = frames.get(t.ident)
+                if fr is not None:
+                    out.write("".join(traceback.format_stack(fr)))
+                out.write("\n")
+            return Response(body=out.getvalue().encode(), content_type="text/plain")
+        if kind == "profile":
+            try:
+                seconds = min(float(req.query.get("seconds", "5")), 60.0)
+            except ValueError:
+                return Response.error("invalid seconds", 400)
+            folded = _sample_cpu_profile(seconds)
+            return Response(body=folded.encode(), content_type="text/plain")
+        if kind == "heap":
+            import tracemalloc
+
+            if req.query.get("start"):
+                tracemalloc.start(16)
+                return Response(body=b"tracemalloc started\n",
+                                content_type="text/plain")
+            if req.query.get("stop"):
+                tracemalloc.stop()
+                return Response(body=b"tracemalloc stopped\n",
+                                content_type="text/plain")
+            if not tracemalloc.is_tracing():
+                return Response(
+                    body=b"tracemalloc not tracing; GET ?start=1 first\n",
+                    content_type="text/plain",
+                )
+            snap = tracemalloc.take_snapshot()
+            out = io.StringIO()
+            for stat in snap.statistics("lineno")[:50]:
+                out.write(f"{stat}\n")
+            return Response(body=out.getvalue().encode(), content_type="text/plain")
+        return Response.error(f"unknown profile: {kind}", 404)
 
     # ------------------------------------------------------------------
     # helpers
@@ -763,6 +803,36 @@ class Handler:
                 self.broadcaster.send_sync(msg)
             except Exception as e:  # noqa: BLE001 — broadcast is best-effort
                 self.logger(f"broadcast error: {e}")
+
+
+def _sample_cpu_profile(seconds: float, hz: float = 100.0) -> str:
+    """Statistical whole-process CPU profile: sample every thread's
+    stack at ``hz`` for ``seconds`` and fold identical stacks into
+    "frame1;frame2;... count" lines (most-sampled first) — the
+    flamegraph-collapsed equivalent of the reference's pprof CPU
+    profile endpoint."""
+    counts: dict[str, int] = {}
+    me = threading.get_ident()
+    deadline = time.monotonic() + seconds
+    interval = 1.0 / hz
+    while time.monotonic() < deadline:
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue  # don't profile the profiler
+            parts = []
+            f = frame
+            while f is not None:
+                code = f.f_code
+                parts.append(f"{code.co_name} ({code.co_filename}:{f.f_lineno})")
+                f = f.f_back
+            stack = ";".join(reversed(parts)) or "<idle>"
+            counts[stack] = counts.get(stack, 0) + 1
+        time.sleep(interval)
+    lines = [
+        f"{stack} {n}"
+        for stack, n in sorted(counts.items(), key=lambda kv: -kv[1])
+    ]
+    return "\n".join(lines) + ("\n" if lines else "")
 
 
 def _frame_meta_proto(f) -> wire.FrameMeta:

@@ -322,6 +322,35 @@ class TestServerHTTP:
         status, data = client._request("GET", "/debug/pprof/")
         assert status == 200 and b"thread" in data
 
+    def test_pprof_profile_and_heap(self, server, client):
+        """CPU-profile + heap endpoints (the reference mounts full
+        net/http/pprof, handler.go:111-112)."""
+        # CPU: a short sample window still catches the server's own
+        # threads (rx loops sleeping in poll etc.) as folded stacks.
+        status, data = client._request(
+            "GET", "/debug/pprof/profile", query={"seconds": "0.3"}
+        )
+        assert status == 200
+        text = data.decode()
+        assert text.strip(), "no samples collected"
+        line = text.strip().splitlines()[0]
+        stack, _, count = line.rpartition(" ")
+        assert stack and count.isdigit()
+        # heap: start -> snapshot -> stop
+        status, data = client._request(
+            "GET", "/debug/pprof/heap", query={"start": "1"}
+        )
+        assert status == 200 and b"started" in data
+        client._request("GET", "/schema")  # allocate something traced
+        status, data = client._request("GET", "/debug/pprof/heap")
+        assert status == 200 and b".py" in data
+        status, data = client._request(
+            "GET", "/debug/pprof/heap", query={"stop": "1"}
+        )
+        assert status == 200 and b"stopped" in data
+        status, _ = client._request("GET", "/debug/pprof/bogus")
+        assert status == 404
+
     def test_not_found_route(self, server, client):
         status, _ = client._request("GET", "/nope")
         assert status == 404
