@@ -1,0 +1,145 @@
+"""Generate golden roaring-format fixtures byte-by-byte from the format
+spec (reference: roaring/roaring.go:507-660) — deliberately WITHOUT using
+pilosa_tpu.ops.roaring, so the fixtures are an independent oracle for the
+codec: a header/offset/op-log deviation in our encoder or decoder cannot
+self-validate.
+
+Layout (little-endian):
+
+    u32 cookie = 12346
+    u32 containerCount
+    containerCount * { u64 key, u32 n-1 }        # key table
+    containerCount * { u32 absolute offset }     # payload offsets
+    payloads: n <= 4096 -> n sorted u32 low-bits (array form)
+              n >  4096 -> 1024 u64 words (bitmap form)
+    op-log records until EOF:
+        u8 type (0=add 1=remove), u64 value, u32 FNV-1a of first 9 bytes
+
+Run from the repo root:  python tests/golden/make_fixtures.py
+Writes *.roaring files plus expected.json (fixture -> sorted set-bit list
+after op-log replay) next to this script.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+COOKIE = 12346
+ARRAY_MAX = 4096
+
+
+def fnv1a32(data: bytes) -> int:
+    h = 0x811C9DC5
+    for b in data:
+        h = ((h ^ b) * 0x01000193) & 0xFFFFFFFF
+    return h
+
+
+def build(containers: list[tuple[int, list[int]]], ops: list[tuple[int, int]] = ()) -> bytes:
+    """containers: [(key, sorted low-bit values < 2^16)], keys ascending."""
+    header = struct.pack("<II", COOKIE, len(containers))
+    keytab = b"".join(
+        struct.pack("<QI", key, len(vals) - 1) for key, vals in containers
+    )
+    payloads = []
+    for _, vals in containers:
+        assert vals == sorted(set(vals)) and all(0 <= v < 1 << 16 for v in vals)
+        if len(vals) <= ARRAY_MAX:
+            payloads.append(b"".join(struct.pack("<I", v) for v in vals))
+        else:
+            words = [0] * 1024
+            for v in vals:
+                words[v // 64] |= 1 << (v % 64)
+            payloads.append(b"".join(struct.pack("<Q", w) for w in words))
+    offset = len(header) + len(keytab) + 4 * len(containers)
+    offtab = b""
+    for p in payloads:
+        offtab += struct.pack("<I", offset)
+        offset += len(p)
+    data = header + keytab + offtab + b"".join(payloads)
+    for typ, value in ops:
+        rec = struct.pack("<BQ", typ, value)
+        data += rec + struct.pack("<I", fnv1a32(rec))
+    return data
+
+
+def replay(containers: list[tuple[int, list[int]]], ops=()) -> list[int]:
+    """Expected absolute set bits after op-log replay (spec semantics)."""
+    bits = set()
+    for key, vals in containers:
+        bits.update(key * (1 << 16) + v for v in vals)
+    for typ, value in ops:
+        if typ == 0:
+            bits.add(value)
+        else:
+            bits.discard(value)
+    return sorted(bits)
+
+
+def main() -> None:
+    fixtures: dict[str, tuple[list, list]] = {}
+
+    # array <-> bitmap boundary: exactly 4096 values stays array form;
+    # 4097 crosses to the 8 KiB bitmap form (ArrayMaxSize = 4096,
+    # reference: roaring/roaring.go:893).
+    fixtures["array_boundary_4096"] = ([(0, list(range(0, 8192, 2)))], [])
+    fixtures["bitmap_boundary_4097"] = ([(0, list(range(0, 8194, 2)))], [])
+
+    # multi-container: non-contiguous keys spanning multiple slice-rows
+    # (16 containers per 2^20-bit row) and mixed array/bitmap forms.
+    fixtures["multi_container"] = (
+        [
+            (0, [0, 1, 65535]),
+            (5, [7, 1000]),
+            (15, list(range(4097))),        # last container of row 0, bitmap form
+            (16, [42]),                     # first container of row 1
+            (33, [0]),                      # row 2
+            (1 << 30, [123, 456]),          # very high key (row 2^26)
+        ],
+        [],
+    )
+
+    # op-log after snapshot: add to an existing container, add creating a
+    # brand-new container, remove an existing bit, remove an absent bit
+    # (no-op), re-add a removed bit.
+    fixtures["oplog_after_snapshot"] = (
+        [(0, [1, 2, 3]), (2, [100])],
+        [
+            (0, 7),                 # add into key 0
+            (0, (5 << 16) + 9),     # add creating key 5
+            (1, 2),                 # remove existing
+            (1, 999),               # remove absent -> no-op
+            (1, (2 << 16) + 100),   # empty out key 2
+            (0, 2),                 # re-add previously removed
+        ],
+    )
+
+    # empty-container dropping: the op-log empties the only container;
+    # a correct re-encode of the decoded state writes ZERO containers
+    # (the reference skips c.n == 0, roaring/roaring.go:510-531).
+    fixtures["oplog_empties_container"] = (
+        [(3, [17])],
+        [(1, (3 << 16) + 17)],
+    )
+
+    # empty file: header only, no containers, no ops.
+    fixtures["empty"] = ([], [])
+
+    expected = {}
+    for name, (containers, ops) in fixtures.items():
+        data = build(containers, ops)
+        with open(os.path.join(HERE, name + ".roaring"), "wb") as fh:
+            fh.write(data)
+        expected[name] = {"bits": replay(containers, ops), "ops": len(ops)}
+        print(f"{name}.roaring: {len(data)} bytes, "
+              f"{len(expected[name]['bits'])} bits, {len(ops)} ops")
+
+    with open(os.path.join(HERE, "expected.json"), "w") as fh:
+        json.dump(expected, fh, indent=1, sort_keys=True)
+
+
+if __name__ == "__main__":
+    main()
