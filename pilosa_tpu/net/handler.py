@@ -495,7 +495,17 @@ class Handler:
         return Response.json(codec.response_to_json(results, column_attr_sets))
 
     def _read_query_request(self, req: Request) -> dict:
-        """reference: handler.go:863-944"""
+        """reference: handler.go:863-944.
+
+        ``time_granularity`` / ``QueryRequest.Quantum`` is VALIDATED
+        (invalid values are a 400) and carried on the wire, but — by
+        exact reference parity — never consumed by execution: the
+        reference parses it (handler.go:913-926), decodes it from
+        protobuf (handler.go:1396-1408), and then no code path reads
+        ``QueryRequest.Quantum`` again; remote exec re-marshals without
+        it (executor.go:1048-1052) and Range() always uses the frame's
+        own quantum (executor.go:572-573).  We reproduce that contract
+        verbatim rather than invent semantics the reference lacks."""
         if req.header("Content-Type") == PROTOBUF:
             pb = wire.QueryRequest()
             pb.ParseFromString(req.body)

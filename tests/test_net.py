@@ -186,6 +186,41 @@ class TestServerHTTP:
         )
         assert status == 400
 
+    def test_query_time_granularity_validated(self, server, client):
+        """``time_granularity`` is validated like the reference
+        (handler.go:913-919: invalid -> 400 "invalid time granularity")
+        and — also like the reference — has no effect on execution:
+        Range() always uses the frame's own quantum (reference:
+        executor.go:572-573; QueryRequest.Quantum is never consumed)."""
+        client.create_index("i")
+        client.create_frame("i", "f", {"timeQuantum": "YMD"})
+        status, data = client._request(
+            "POST",
+            "/index/i/query",
+            query={"time_granularity": "XQ"},
+            body=b'Count(Bitmap(frame="f", rowID=1))',
+        )
+        assert status == 400
+        assert "granularity" in json.loads(data)["error"]
+        client._request(
+            "POST",
+            "/index/i/query",
+            body=b'SetBit(frame="f", rowID=1, columnID=2,'
+            b' timestamp="2017-03-20T10:30")',
+        )
+        q = (
+            b'Range(frame="f", rowID=1, start="2017-03-19T00:00",'
+            b' end="2017-03-22T00:00")'
+        )
+        expected = [{"attrs": {}, "bits": [2]}]
+        # a VALID granularity is accepted -- and changes nothing
+        for extra in ({}, {"time_granularity": "Y"}):
+            status, data = client._request(
+                "POST", "/index/i/query", query=extra, body=q
+            )
+            assert status == 200
+            assert json.loads(data)["results"] == expected
+
     def test_column_attrs_on_query(self, server, client):
         client.create_index("i")
         client.create_frame("i", "f")
