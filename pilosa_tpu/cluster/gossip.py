@@ -142,6 +142,13 @@ class GossipNodeSet:
 
     def open(self) -> None:
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        # Chunked state transfers burst several ~44 KB datagrams; the
+        # default rcvbuf (~208 KB on Linux) would shed most of a large
+        # blob.  Best-effort — the kernel clamps to net.core.rmem_max.
+        try:
+            self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4 << 20)
+        except OSError:
+            pass
         self._sock.bind(self.bind)
         self._sock.settimeout(0.2)
         self.advertise = (self.advertise[0], self.bind[1])
@@ -438,10 +445,17 @@ class GossipNodeSet:
         digest = obj.get("state_digest")
         if not digest or self.state_merger is None:
             return
+        now = time.monotonic()
         with self._mu:
             if digest in self._merged_digests:
                 self._merged_digests.move_to_end(digest)
                 return
+            # A fresh in-flight assembly for this digest suppresses
+            # duplicate STATE-REQs — every ping/ack carrying the digest
+            # would otherwise trigger a full-blob retransmission.
+            for (_, d), asm in self._assemblies.items():
+                if d == digest and now - asm["t0"] <= _ASSEMBLY_TTL:
+                    return
         sender = self._snapshot().get(obj.get("from", ""))
         if sender is not None:
             self._send_logged(
@@ -506,23 +520,30 @@ class GossipNodeSet:
                 # count; start over.
                 asm = self._assemblies[key] = {"t0": now, "n": n, "parts": {}}
             asm["parts"][seq] = base64.b64decode(obj.get("p", ""))
+            # Progress refreshes the TTL: a slow lossy transfer keeps its
+            # partial assembly as long as chunks keep arriving.
+            asm["t0"] = now
             if len(asm["parts"]) < n:
                 return
             blob = b"".join(asm["parts"][i] for i in range(n))
             del self._assemblies[key]
-            if hashlib.sha1(blob).hexdigest() != digest:
-                self.logger(
-                    f"state transfer from {sender} failed digest check; dropped"
-                )
-                return
-            self._merged_digests[digest] = now
-            while len(self._merged_digests) > 64:
-                self._merged_digests.popitem(last=False)
+        if hashlib.sha1(blob).hexdigest() != digest:
+            self.logger(
+                f"state transfer from {sender} failed digest check; dropped"
+            )
+            return
         if self.state_merger is not None:
             try:
                 self.state_merger(blob)
             except Exception as e:  # noqa: BLE001
+                # NOT recorded as merged: the next ping retries the
+                # transfer instead of skipping this state forever.
                 self.logger(f"state merge error: {e}")
+                return
+        with self._mu:
+            self._merged_digests[digest] = now
+            while len(self._merged_digests) > 64:
+                self._merged_digests.popitem(last=False)
 
     def _tick_loop(self) -> None:
         while not self._closing.wait(self.gossip_interval):

@@ -392,9 +392,9 @@ class Executor:
             batch, pos_of = self._assemble_mesh_batch(stacks, kept_slices, mesh)
             # Zero pad slices contribute nothing, so the budget is on the
             # real slice count, not the padded batch size.
-            if len(kept_slices) <= plan.MAX_INT32_COUNT_PARTIALS:
-                total = plan.compiled_total_count(expr, mesh)(batch)
-                return int(jax.device_get(total))
+            if len(kept_slices) <= plan.MAX_ONDEVICE_COUNT_PARTIALS:
+                limbs = plan.compiled_total_count(expr, mesh)(batch)
+                return plan.recombine_count_limbs(jax.device_get(limbs))
             res = jax.device_get(
                 plan.compiled_batched(expr, "count", fused=False)(batch)
             )

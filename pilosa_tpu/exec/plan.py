@@ -137,28 +137,48 @@ def compiled_batched(expr: tuple, reduce: str, fused: bool | None = None):
     return _compiled_batched(expr, reduce, fused and _fusable(expr, reduce))
 
 
-# int32 accumulation budget for on-device cross-slice count reduces: each
-# per-slice-row partial is <= 2^20 (one slice-row of bits), so up to 2047
-# partials sum below 2^31.  Callers fall back to the per-slice host sum
-# (int64) beyond this.
-MAX_INT32_COUNT_PARTIALS = 2047
+# On-device count reduce budget, in PARTIALS (one partial = one
+# slice-row's popcount, <= 2^20 bits).  TPUs have no native int64, so
+# the reduce runs TWO-STAGE in 16-bit limbs of the per-slice-row int32
+# partials: sum(partial & 0xFFFF) stays below 2^31 for up to 2^15
+# partials and sum(partial >> 16) far longer; the host recombines
+# hi*2^16 + lo in Python ints.  2^15 single-row slices = ~34B columns
+# per node — past BASELINE configs[4]'s 10B-column cluster shape.
+# Callers fall back to the per-slice host sum (int64) beyond this.
+MAX_ONDEVICE_COUNT_PARTIALS = 1 << 15
 
 
 def compiled_total_count(expr: tuple, mesh):
-    """Count(tree) reduced to ONE replicated scalar on-device.
+    """Count(tree) reduced to one replicated int32[2] = (hi, lo) limb
+    pair on-device; total = (hi << 16) + lo, recombined by the caller
+    (recombine_count_limbs).
 
-    Input: uint32[n_slices, n_leaves, words] sharded P(slices, None,
-    None) over ``mesh``.  The per-slice popcount partials sum across the
-    sharded slice axis *inside* the jitted program, so the SPMD
-    partitioner inserts the cross-device all-reduce (psum riding
-    ICI) — the collective replacement for the reference's streaming HTTP
-    fan-in reduce (reference: executor.go:1176-1207).  Only the final
-    scalar ever reaches the host.
-
-    int32 accumulation: callers must guard
-    ``n_slices <= MAX_INT32_COUNT_PARTIALS``.
+    Input: uint32[n_slices, n_leaves, *rest, words] sharded P(slices,
+    None, ...) over ``mesh``.  The word axis reduces first — every
+    partial covers at most one slice-row's 2^20 bits, so int32 is exact
+    — then the partials limb-split and sum across ALL remaining axes
+    *inside* the jitted program, so the SPMD partitioner inserts the
+    cross-device all-reduce (psum riding ICI) — the collective
+    replacement for the reference's streaming HTTP fan-in reduce
+    (reference: executor.go:1176-1207).  Only the two scalars ever
+    reach the host, and the limb math is exact for up to
+    MAX_ONDEVICE_COUNT_PARTIALS slice-row partials.
     """
     return _compiled_total_count(expr, mesh)
+
+
+def recombine_count_limbs(limbs):
+    """(hi, lo) int32 limbs -> exact totals.
+
+    Scalar limb pair (shape [2]) -> Python int; vector limbs (shape
+    [2, n]) -> int64 ndarray.  The single recombination point for every
+    limb-split device reduce (Count and TopN)."""
+    import numpy as np
+
+    limbs = np.asarray(limbs, dtype=np.int64)
+    hi, lo = limbs[0], limbs[1]
+    total = (hi << 16) + lo
+    return int(total) if total.ndim == 0 else total
 
 
 @functools.lru_cache(maxsize=512)
@@ -166,8 +186,18 @@ def _compiled_total_count(expr: tuple, mesh):
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     rep = NamedSharding(mesh, P())
-    inner = _make_fn(expr, "count")
-    return jax.jit(lambda batch: inner(batch.swapaxes(0, 1)), out_shardings=rep)
+
+    def fn(batch):
+        out = _eval_expr(expr, batch.swapaxes(0, 1))
+        # Word axis first: each partial <= 2^20 bits, int32-exact.
+        partials = jnp.sum(
+            jax.lax.population_count(out).astype(jnp.int32), axis=-1
+        )
+        lo = jnp.sum(partials & 0xFFFF)
+        hi = jnp.sum(partials >> 16)
+        return jnp.stack([hi, lo])
+
+    return jax.jit(fn, out_shardings=rep)
 
 
 @functools.lru_cache(maxsize=512)

@@ -137,9 +137,9 @@ def distributed_count(
     if n_partials is None:
         n_partials = leaf_planes.shape[0] * leaf_planes.shape[2]
     sh = leaf_planes.sharding
-    if isinstance(sh, NamedSharding) and n_partials <= plan.MAX_INT32_COUNT_PARTIALS:
-        total = plan.compiled_total_count(expr, sh.mesh)(leaf_planes)
-        return int(jax.device_get(total))
+    if isinstance(sh, NamedSharding) and n_partials <= plan.MAX_ONDEVICE_COUNT_PARTIALS:
+        limbs = plan.compiled_total_count(expr, sh.mesh)(leaf_planes)
+        return plan.recombine_count_limbs(jax.device_get(limbs))
     return int(np.asarray(_count_tree(expr, leaf_planes), dtype=np.int64).sum())
 
 
@@ -166,15 +166,21 @@ def _topn_total_fn(mesh: Mesh):
     """Per-row |row AND src| totals with the cross-slice reduce
     on-device: the slice-axis sum inside the jitted program becomes an
     all-reduce over the slices mesh axis (and an all-gather over the
-    rows axis for the replicated [rows] output) — only the per-row
-    totals ever reach the host, not the [n_slices, rows] partials."""
+    rows axis for the replicated output) — only the per-row limb totals
+    ever reach the host, not the [n_slices, rows] partials.  Like
+    plan.compiled_total_count, the sums run in 16-bit limbs (TPUs have
+    no int64), int32-exact up to 2^15 slices; returns int32[2, rows] =
+    (hi, lo) with per-row total = (hi << 16) + lo."""
     rep = NamedSharding(mesh, P())
 
     def fn(plane, src):
-        return jnp.sum(
+        partials = jnp.sum(
             jax.lax.population_count(plane & src[:, None, :]).astype(jnp.int32),
-            axis=(0, 2),
-        )
+            axis=-1,
+        )  # int32[n_slices, rows], each <= 2^20
+        lo = jnp.sum(partials & 0xFFFF, axis=0)
+        hi = jnp.sum(partials >> 16, axis=0)
+        return jnp.stack([hi, lo])
 
     return jax.jit(fn, out_shardings=rep)
 
@@ -185,12 +191,12 @@ def distributed_topn(plane: jax.Array, src: jax.Array, k: int):
     matching the reference Pair sort (reference: cache.go:316-330).
 
     The cross-slice per-row reduce runs on-device (all-reduce) within
-    the int32 partial budget; the final rank (a [rows] vector) keeps the
+    the limb budget; the final rank (a [rows] vector) keeps the
     host stable-argsort for the exact reference tie-break."""
     sh = plane.sharding
-    if isinstance(sh, NamedSharding) and plane.shape[0] <= plan.MAX_INT32_COUNT_PARTIALS:
-        per = np.asarray(
-            jax.device_get(_topn_total_fn(sh.mesh)(plane, src)), dtype=np.int64
+    if isinstance(sh, NamedSharding) and plane.shape[0] <= plan.MAX_ONDEVICE_COUNT_PARTIALS:
+        per = plan.recombine_count_limbs(
+            jax.device_get(_topn_total_fn(sh.mesh)(plane, src))
         )
     else:
         per = np.asarray(_topn_partials(plane, src), dtype=np.int64).sum(axis=0)

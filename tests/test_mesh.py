@@ -106,8 +106,8 @@ def test_query_step_end_to_end(rng):
 
 def test_on_device_count_reduce_emits_collective(rng):
     """The sharded Count program carries its cross-slice reduce as a
-    compiled collective (all-reduce) — only a scalar reaches the host
-    (VERDICT r1 item 3; reference analog: the HTTP fan-in reduce in
+    compiled collective (all-reduce) — only the limb pair reaches the
+    host (VERDICT r1 item 3; reference analog: the HTTP fan-in reduce in
     executor.go:1176-1207)."""
     m = slice_mesh(8)
     q = parse_string("Count(Intersect(Bitmap(rowID=1), Bitmap(rowID=2)))")
@@ -119,8 +119,67 @@ def test_on_device_count_reduce_emits_collective(rng):
     fn = plan.compiled_total_count(expr, m)
     hlo = fn.lower(batch).compile().as_text()
     assert "all-reduce" in hlo, hlo[:2000]
-    got = int(jax.device_get(fn(batch)))
+    got = plan.recombine_count_limbs(jax.device_get(fn(batch)))
     assert got == int(np.bitwise_count(planes[:, 0] & planes[:, 1]).sum())
+
+
+def test_count_reduce_collective_at_4096_slices_past_int32(rng):
+    """The two-stage limb reduce keeps the collective on-device far past
+    the old 2047-slice int32 cliff (VERDICT r2 item 5): 4096 slices
+    still compile to one all-reduce with two scalars home.  Word count
+    is scaled down (the budget math is per-slice, not per-word);
+    all-ones rows make every partial exactly 2^16 — each lands entirely
+    in the hi limb, the shape the old single-int32 sum mis-handled
+    beyond 2047 slices at full width."""
+    m = slice_mesh(8)
+    q = parse_string("Count(Intersect(Bitmap(rowID=1), Bitmap(rowID=2)))")
+    expr, _ = plan.decompose(q.calls[0].children[0])
+    n, w = 4096, 2048  # 4096 slices x 65536 bits/slice, all ones
+    planes = np.full((n, 2, w), 0xFFFFFFFF, dtype=np.uint32)
+    planes[:7, 0, 0] = 0x1  # a little asymmetry across shards
+    batch = jax.device_put(planes, NamedSharding(m, P(AXIS_SLICES, None, None)))
+    fn = plan.compiled_total_count(expr, m)
+    hlo = fn.lower(batch).compile().as_text()
+    assert "all-reduce" in hlo, hlo[:2000]
+    got = plan.recombine_count_limbs(jax.device_get(fn(batch)))
+    want = int(np.bitwise_count(planes[:, 0] & planes[:, 1]).sum())
+    assert want > 2**27  # ~2^28 bits: far past any single-partial scale
+    assert got == want
+
+
+def test_count_reduce_4d_per_slice_total_past_int32():
+    """Multi-row (4-D) batches whose PER-SLICE totals pass int32 stay
+    exact: the limb split happens on per-(slice,row) partials BEFORE the
+    row-axis sum — a single per-slice int32 accumulator would wrap at
+    2^31 (code-review regression, r3)."""
+    m = slice_mesh(2)
+    q = parse_string("Count(Bitmap(rowID=1))")
+    expr, _ = plan.decompose(q.calls[0].children[0])
+    # 2 slices x 2048 full-width rows, all ones: per-slice total is
+    # exactly 2^31 — one int32 step past INT32_MAX.
+    rows, w = 2048, 32768
+    planes = np.full((2, 1, rows, w), 0xFFFFFFFF, dtype=np.uint32)
+    sharded = jax.device_put(
+        planes, NamedSharding(m, P(AXIS_SLICES, None, AXIS_ROWS, None))
+    )
+    got = distributed_count(expr, sharded)
+    assert got == 1 << 32
+
+
+def test_count_reduce_limbs_exact_past_2_31_bits():
+    """Totals beyond int32 range recombine exactly from the limbs:
+    2^15 slices x 2^17 bits = 2^32 bits, the budget edge (BASELINE
+    configs[4] 10B-column cluster shape fits well inside)."""
+    m = slice_mesh(8)
+    q = parse_string("Count(Bitmap(rowID=1))")
+    expr, _ = plan.decompose(q.calls[0].children[0])
+    n, w = 1 << 15, 4096  # 2^15 slices x 2^17 bits, all ones
+    planes = np.full((n, 1, w), 0xFFFFFFFF, dtype=np.uint32)
+    batch = jax.device_put(planes, NamedSharding(m, P(AXIS_SLICES, None, None)))
+    got = plan.recombine_count_limbs(
+        jax.device_get(plan.compiled_total_count(expr, m)(batch))
+    )
+    assert got == (1 << 32)  # > int32 max; limb math must be exact
 
 
 def test_distributed_topn_reduce_on_device(rng):
@@ -136,7 +195,7 @@ def test_distributed_topn_reduce_on_device(rng):
     fn = pmesh._topn_total_fn(m)
     hlo = fn.lower(pl, sr).compile().as_text()
     assert "all-reduce" in hlo, hlo[:2000]
-    per = np.asarray(jax.device_get(fn(pl, sr)))
+    per = plan.recombine_count_limbs(jax.device_get(fn(pl, sr)))
     want = np.bitwise_count(planes & src[:, None, :]).sum(axis=(0, 2))
     np.testing.assert_array_equal(per, want)
 
@@ -308,7 +367,8 @@ batch = jax.make_array_from_callback(planes.shape, sharding,
 
 q = parse_string('Count(Intersect(Bitmap(rowID=1), Bitmap(rowID=2)))')
 expr, _ = plan.decompose(q.calls[0].children[0])
-total = int(jax.device_get(plan.compiled_total_count(expr, mesh)(batch)))
+total = plan.recombine_count_limbs(
+    jax.device_get(plan.compiled_total_count(expr, mesh)(batch)))
 want = int(np.bitwise_count(planes[:, 0] & planes[:, 1]).sum())
 assert total == want, (total, want)
 print('MH OK', jax.process_index(), total, flush=True)
