@@ -36,6 +36,7 @@ import os
 import tarfile
 import threading
 import time
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Any, Iterable, Sequence
 
@@ -53,12 +54,20 @@ SLICE_WIDTH = bp.SLICE_WIDTH
 # reference: fragment.go:58-65
 HASH_BLOCK_SIZE = 100
 DEFAULT_FRAGMENT_MAX_OP_N = 2000
-# Cap on *touched* (non-empty-ever) rows per fragment: memory is
-# slots x 128 KiB (8 GiB at the cap).  Row *ids* are unbounded — storage
-# is compact (slot per touched row), the analog of roaring's
-# pay-per-container sparsity for tall-sparse fragments such as inverse
-# views, where the row axis is the column space.
-MAX_FRAGMENT_ROWS = 1 << 16
+# Dense-tier budget: up to this many rows live in the device-mirrored
+# dense plane (128 KiB/row — the batched-kernel fast path).  Rows beyond
+# the budget live in the SPARSE tier as sorted uint32 offset arrays,
+# paying only for set bits — the dense-plane analog of roaring's
+# pay-per-container storage (reference: roaring/roaring.go:43-52), so
+# tall-sparse fragments (inverse views, where the row axis is the
+# column space — up to 2^20 distinct rows per slice) are unbounded.
+DENSE_ROW_BUDGET = 1 << 16
+# Sparse rows whose bit count crosses this are promoted to the dense
+# tier when budget remains: past it, offset arrays (4 B/bit) cost more
+# than the 128 KiB plane row.
+PROMOTE_BITS = 32 * 1024
+# Paged-to-device sparse rows kept per fragment (LRU, 128 KiB each).
+SPARSE_DEVICE_CACHE = 64
 # Largest legal row id: op-log positions are u64 and pos = row*2^20+off.
 MAX_ROW_ID = 1 << 44
 
@@ -130,6 +139,7 @@ class Fragment:
         cache_type: str = cache_mod.TYPE_RANKED,
         cache_size: int = cache_mod.DEFAULT_CACHE_SIZE,
         max_op_n: int = DEFAULT_FRAGMENT_MAX_OP_N,
+        dense_row_budget: int = DENSE_ROW_BUDGET,
     ):
         self.path = path
         self.index = index
@@ -139,15 +149,22 @@ class Fragment:
         self.cache_type = cache_type
         self.cache_size = cache_size
         self.max_op_n = max_op_n
+        self.dense_row_budget = dense_row_budget
 
         self.row_attr_store = None  # wired by Frame
         self.stats = NopStatsClient()  # re-tagged by View._new_fragment
 
         self._mu = threading.RLock()
-        # Compact row storage: plane row *slots* hold touched rows only;
-        # _slot_of maps logical row id -> slot.
+        # Two-tier row storage.  DENSE: plane row *slots* hold up to
+        # dense_row_budget touched rows (device-mirrored fast path);
+        # _slot_of maps logical row id -> slot.  SPARSE: every further
+        # row is a sorted uint32 array of in-slice bit offsets — memory
+        # scales with set bits, so fragments are row-unbounded.
         self._plane = bp.empty_plane(bp.ROW_BLOCK)
         self._slot_of: dict[int, int] = {}
+        self._sparse: dict[int, np.ndarray] = {}
+        # Sparse rows paged to the home device for query leaves (LRU).
+        self._sparse_dev: "OrderedDict[int, object]" = OrderedDict()
         self._max_row_id = 0
         self._op_n = 0
         self._version = 0
@@ -197,10 +214,10 @@ class Fragment:
                 self._file.write(roaring.encode({}))
                 self._file.flush()
             else:
-                containers, op_n = roaring.decode_with_ops(data)
-                self._load_row_map(
-                    roaring.containers_to_row_map(containers, SLICE_WIDTH)
-                )
+                # Tiered decode: array containers stay as value arrays,
+                # so a tall-sparse file loads in O(set bits).
+                words, arrays, op_n = roaring.decode_tiered(data)
+                self._load_tiered(words, arrays)
                 # replayed-op count feeds snapshot bookkeeping
                 self._op_n = op_n
             self._open_cache()
@@ -235,7 +252,9 @@ class Fragment:
         if not isinstance(ids, list):
             return
         for row_id in ids:
-            if isinstance(row_id, int) and row_id in self._slot_of:
+            if isinstance(row_id, int) and (
+                row_id in self._slot_of or row_id in self._sparse
+            ):
                 self.cache.bulk_add(row_id, self._count_of.get(row_id, 0))
         self.cache.invalidate()
 
@@ -265,53 +284,158 @@ class Fragment:
     def max_row_id(self) -> int:
         return self._max_row_id
 
-    def _ensure_slot(self, row_id: int) -> int:
-        """Slot for a row, allocating compact plane capacity on first
-        touch (memory scales with touched rows, not max row id)."""
+    def _ensure_slot(self, row_id: int) -> int | None:
+        """Dense-tier slot for a row, or None when the row lives in (or
+        a first touch lands in) the SPARSE tier.  Dense capacity is
+        allocated compactly up to ``dense_row_budget``; beyond it new
+        rows start sparse — memory scales with set bits, never with
+        distinct-row count (the roaring pay-per-container analog)."""
         slot = self._slot_of.get(row_id)
         if slot is not None:
             return slot
+        if row_id in self._sparse:
+            return None
         # Bit positions are u64 in the op-log (pos = row*2^20 + offset),
         # so row ids must stay below 2^44; reject before mutating state
         # (PQL rowID=-1 wraps to 2^64-1 at the executor boundary).
         if row_id >= MAX_ROW_ID:
             raise FragmentError(f"row id out of range: {row_id}")
-        if len(self._slot_of) >= MAX_FRAGMENT_ROWS:
-            raise FragmentError(
-                f"fragment holds too many distinct rows ({MAX_FRAGMENT_ROWS})"
-            )
+        self._max_row_id = max(self._max_row_id, row_id)
+        if len(self._slot_of) >= self.dense_row_budget:
+            self._sparse[row_id] = np.empty(0, dtype=np.uint32)
+            self._count_of[row_id] = 0
+            return None
+        slot = self._alloc_dense_slot(row_id)
+        self._count_of[row_id] = 0
+        return slot
+
+    def _alloc_dense_slot(self, row_id: int) -> int:
         slot = len(self._slot_of)
         self._slot_of[row_id] = slot
-        self._count_of[row_id] = 0
         needed = bp.pad_rows(slot + 1)
         if needed > self._plane.shape[0]:
-            grow = max(needed, min(2 * self._plane.shape[0], MAX_FRAGMENT_ROWS))
+            grow = max(
+                needed, min(2 * self._plane.shape[0], self.dense_row_budget)
+            )
             extra = np.zeros(
                 (grow - self._plane.shape[0], bp.WORDS_PER_SLICE), np.uint32
             )
             self._plane = np.vstack([self._plane, extra])
             # the device mirror no longer matches the plane's shape
             self._invalidate_device()
-        self._max_row_id = max(self._max_row_id, row_id)
         return slot
 
-    def _load_row_map(self, row_map: dict[int, np.ndarray]) -> None:
-        """Replace storage with a {row_id: words} map (open/restore)."""
-        rows = sorted(row_map)
-        self._slot_of = {r: i for i, r in enumerate(rows)}
-        plane = bp.empty_plane(bp.pad_rows(len(rows)))
-        for i, r in enumerate(rows):
-            plane[i] = row_map[r]
+    def _maybe_promote(self, row_id: int) -> None:
+        """Sparse rows past PROMOTE_BITS move to the dense tier while
+        budget remains (beyond it, offset arrays cost more than the
+        plane row); correctness never depends on promotion."""
+        offs = self._sparse.get(row_id)
+        if (
+            offs is None
+            or len(offs) <= PROMOTE_BITS
+            or len(self._slot_of) >= self.dense_row_budget
+        ):
+            return
+        del self._sparse[row_id]
+        self._sparse_dev.pop(row_id, None)
+        slot = self._alloc_dense_slot(row_id)
+        self._plane[slot] = bp.np_columns_to_row(offs)
+        self._invalidate_device()
+
+    def _load_tiered(
+        self, words: dict[int, np.ndarray], arrays: dict[int, np.ndarray]
+    ) -> None:
+        """Replace storage from tiered containers (open/restore): the
+        densest rows fill the dense tier first; the long sparse tail
+        stays as offset arrays."""
+        per_row: dict[int, list[tuple[int, np.ndarray, bool]]] = {}
+        counts: dict[int, int] = {}
+        for key, w in words.items():
+            row, cidx = divmod(int(key), bp.CONTAINERS_PER_SLICE)
+            per_row.setdefault(row, []).append((cidx, w, False))
+            counts[row] = counts.get(row, 0) + bp.np_count(w)
+        for key, vals in arrays.items():
+            row, cidx = divmod(int(key), bp.CONTAINERS_PER_SLICE)
+            per_row.setdefault(row, []).append((cidx, vals, True))
+            counts[row] = counts.get(row, 0) + len(vals)
+
+        by_density = sorted(per_row, key=lambda r: (-counts[r], r))
+        dense_rows = sorted(by_density[: self.dense_row_budget])
+        sparse_rows = by_density[self.dense_row_budget :]
+
+        self._slot_of = {r: i for i, r in enumerate(dense_rows)}
+        plane = bp.empty_plane(bp.pad_rows(len(dense_rows)))
+        wpc = bp.WORDS_PER_CONTAINER
+        for i, r in enumerate(dense_rows):
+            for cidx, payload, is_vals in per_row[r]:
+                w = roaring.values_to_words(payload) if is_vals else payload
+                plane[i, cidx * wpc : (cidx + 1) * wpc] = (
+                    w.view("<u4").astype(np.uint32)
+                )
         self._plane = plane
-        self._max_row_id = rows[-1] if rows else 0
-        counts = bp.np_row_counts(plane[: len(rows)]) if rows else []
-        self._count_of = {r: int(counts[i]) for i, r in enumerate(rows)}
+
+        self._sparse = {}
+        for r in sparse_rows:
+            segs = []
+            for cidx, payload, is_vals in sorted(per_row[r]):
+                vals = payload if is_vals else roaring.words_to_values(payload)
+                segs.append(
+                    vals.astype(np.uint32) + np.uint32(cidx * roaring.CONTAINER_BITS)
+                )
+            self._sparse[r] = (
+                np.concatenate(segs) if segs else np.empty(0, np.uint32)
+            )
+        self._sparse_dev.clear()
+
+        self._max_row_id = max(per_row) if per_row else 0
+        self._count_of = counts
         self._block_sums.clear()
         self._dirty_blocks.clear()
         self._invalidate_device()
 
-    def _row_map(self) -> dict[int, np.ndarray]:
-        return {r: self._plane[s] for r, s in self._slot_of.items()}
+    def _containers_tiered(
+        self,
+    ) -> tuple[dict[int, np.ndarray], dict[int, np.ndarray]]:
+        """Current storage as tiered containers for serialization —
+        sparse rows convert offsets->values directly, never
+        materializing a plane row."""
+        words: dict[int, np.ndarray] = {}
+        arrays: dict[int, np.ndarray] = {}
+        wpc = bp.WORDS_PER_CONTAINER
+        cbits = roaring.CONTAINER_BITS
+        for r, slot in self._slot_of.items():
+            row = self._plane[slot]
+            for cidx in range(bp.CONTAINERS_PER_SLICE):
+                chunk = row[cidx * wpc : (cidx + 1) * wpc]
+                if chunk.any():
+                    words[r * bp.CONTAINERS_PER_SLICE + cidx] = (
+                        np.ascontiguousarray(chunk).view(np.uint64).copy()
+                    )
+        # Sparse tier, vectorized across ALL rows at once: rows visit in
+        # ascending order and offsets ascend within a row, so the global
+        # key stream is non-decreasing — one unique() groups it.
+        sp_rows = sorted(r for r in self._sparse if len(self._sparse[r]))
+        if sp_rows:
+            lens = np.asarray([len(self._sparse[r]) for r in sp_rows])
+            rows_rep = np.repeat(np.asarray(sp_rows, dtype=np.int64), lens)
+            offs_all = np.concatenate([self._sparse[r] for r in sp_rows])
+            keys_all = rows_rep * bp.CONTAINERS_PER_SLICE + offs_all // cbits
+            vals_all = (offs_all % cbits).astype(np.uint32)
+            uniq_keys, starts = np.unique(keys_all, return_index=True)
+            for j, k in enumerate(uniq_keys):
+                hi = starts[j + 1] if j + 1 < len(starts) else len(vals_all)
+                arrays[int(k)] = vals_all[starts[j] : hi]
+        return words, arrays
+
+    def _row_words_host(self, row_id: int) -> np.ndarray | None:
+        """One row's words on host (copy), whichever tier holds it."""
+        slot = self._slot_of.get(row_id)
+        if slot is not None:
+            return self._plane[slot].copy()
+        offs = self._sparse.get(row_id)
+        if offs is None:
+            return None
+        return bp.np_columns_to_row(offs)
 
     # ------------------------------------------------------------------
     # reads
@@ -319,32 +443,46 @@ class Fragment:
 
     def row(self, row_id: int) -> RowBitmap:
         """Extract one row as a RowBitmap segment (reference:
-        fragment.go:340-375 row via roaring.OffsetRange)."""
+        fragment.go:340-375 row via roaring.OffsetRange).
+
+        Only dense-tier rows are cached: caching a materialized sparse
+        row would cost 128 KiB per entry in an unbounded dict —
+        reintroducing the rows x 128 KiB footprint the sparse tier
+        removes."""
         with self._mu:
             seg = self._row_cache.get(row_id)
             if seg is None:
-                slot = self._slot_of.get(row_id)
-                seg = self._plane[slot].copy() if slot is not None else bp.empty_row()
-                self._row_cache[row_id] = seg
+                seg = self._row_words_host(row_id)
+                if seg is None:
+                    seg = bp.empty_row()
+                if row_id not in self._sparse:
+                    self._row_cache[row_id] = seg
             return RowBitmap.from_segment(self.slice, seg.copy())
 
     def contains(self, row_id: int, column_id: int) -> bool:
         with self._mu:
             offset = self.pos(row_id, column_id) % SLICE_WIDTH
             slot = self._slot_of.get(row_id)
-            if slot is None:
+            if slot is not None:
+                return bp.np_contains(self._plane, slot * SLICE_WIDTH + offset)
+            offs = self._sparse.get(row_id)
+            if offs is None:
                 return False
-            return bp.np_contains(self._plane, slot * SLICE_WIDTH + offset)
+            i = int(np.searchsorted(offs, offset))
+            return i < len(offs) and int(offs[i]) == offset
 
     def count(self) -> int:
+        """Total set bits — from the incrementally-maintained per-row
+        counts: no plane scan and no device round-trip (the counts are
+        exact under set/clear/import, like the reference's cached
+        bitmap.n bookkeeping, bitmap.go:184-217)."""
         with self._mu:
-            return int(np.asarray(bp.count(self.device_plane())))
+            return sum(self._count_of.values())
 
     def row_counts(self) -> dict[int, int]:
-        """{row_id: popcount} for every touched row."""
+        """{row_id: popcount} for every touched row (host-side, O(rows))."""
         with self._mu:
-            counts = np.asarray(bp.row_counts(self.device_plane()))
-            return {r: int(counts[s]) for r, s in self._slot_of.items()}
+            return dict(self._count_of)
 
     # Above this many queued point writes, a full re-upload is cheaper
     # than the scatter program.
@@ -384,13 +522,34 @@ class Fragment:
             return self._device
 
     def device_row(self, row_id: int):
-        """One row of the HBM mirror — a device gather, no host copy.
-        Query plans stack these as fused-program leaves (exec/plan.py)."""
+        """One row as a device leaf for query plans (exec/plan.py).
+
+        Dense rows gather from the HBM plane mirror (no host copy);
+        sparse rows PAGE on demand — materialized host-side and
+        device_put to the slice's home device, kept in a small LRU so
+        repeated queries over the same sparse rows (e.g. inverse-view
+        Bitmap calls) hit HBM (SURVEY.md §7 "row-block paging HBM<->host
+        for sparse-tall frames")."""
+        import jax
+
         with self._mu:
             slot = self._slot_of.get(row_id)
-            if slot is None:
+            if slot is not None:
+                return self.device_plane()[slot]
+            offs = self._sparse.get(row_id)
+            if offs is None:
                 return None
-            return self.device_plane()[slot]
+            dev = self._sparse_dev.get(row_id)
+            if dev is not None:
+                self._sparse_dev.move_to_end(row_id)
+                return dev
+            dev = jax.device_put(
+                bp.np_columns_to_row(offs), bp.home_device(self.slice)
+            )
+            self._sparse_dev[row_id] = dev
+            while len(self._sparse_dev) > SPARSE_DEVICE_CACHE:
+                self._sparse_dev.popitem(last=False)
+            return dev
 
     # ------------------------------------------------------------------
     # writes (reference: fragment.go:379-473)
@@ -399,32 +558,59 @@ class Fragment:
     def set_bit(self, row_id: int, column_id: int) -> bool:
         with self._mu:
             pos = self.pos(row_id, column_id)
+            offset = pos % SLICE_WIDTH
             grew = row_id > self._max_row_id
             slot = self._ensure_slot(row_id)
-            changed = bp.np_set_bit(self._plane, slot * SLICE_WIDTH + pos % SLICE_WIDTH)
+            if slot is not None:
+                changed = bp.np_set_bit(self._plane, slot * SLICE_WIDTH + offset)
+                if changed:
+                    self._queue_device_update(slot, offset, 1)
+            else:
+                changed = self._sparse_insert(row_id, offset)
             if changed:
-                self._queue_device_update(slot, pos % SLICE_WIDTH, 1)
                 self._append_op(roaring.OP_ADD, pos)
                 self._after_write(row_id, +1)
                 self.stats.count("setBit")  # reference: fragment.go:418
                 if grew:
                     # reference: fragment.go:421-423
                     self.stats.gauge("rows", float(self._max_row_id))
+                self._maybe_promote(row_id)
             return changed
 
     def clear_bit(self, row_id: int, column_id: int) -> bool:
         with self._mu:
             pos = self.pos(row_id, column_id)
+            offset = pos % SLICE_WIDTH
             slot = self._slot_of.get(row_id)
-            if slot is None:
+            if slot is not None:
+                changed = bp.np_clear_bit(self._plane, slot * SLICE_WIDTH + offset)
+                if changed:
+                    self._queue_device_update(slot, offset, 0)
+            elif row_id in self._sparse:
+                changed = self._sparse_remove(row_id, offset)
+            else:
                 return False
-            changed = bp.np_clear_bit(self._plane, slot * SLICE_WIDTH + pos % SLICE_WIDTH)
             if changed:
-                self._queue_device_update(slot, pos % SLICE_WIDTH, 0)
                 self._append_op(roaring.OP_REMOVE, pos)
                 self._after_write(row_id, -1)
                 self.stats.count("clearBit")  # reference: fragment.go:470
             return changed
+
+    def _sparse_insert(self, row_id: int, offset: int) -> bool:
+        offs = self._sparse[row_id]
+        i = int(np.searchsorted(offs, offset))
+        if i < len(offs) and int(offs[i]) == offset:
+            return False
+        self._sparse[row_id] = np.insert(offs, i, np.uint32(offset))
+        return True
+
+    def _sparse_remove(self, row_id: int, offset: int) -> bool:
+        offs = self._sparse[row_id]
+        i = int(np.searchsorted(offs, offset))
+        if i >= len(offs) or int(offs[i]) != offset:
+            return False
+        self._sparse[row_id] = np.delete(offs, i)
+        return True
 
     def _queue_device_update(self, slot: int, offset: int, op: int) -> None:
         """Record a point write for the device mirror; overflow degrades
@@ -440,6 +626,7 @@ class Fragment:
     def _after_write(self, row_id: int, delta: int) -> None:
         self._version += 1
         self._row_cache.pop(row_id, None)
+        self._sparse_dev.pop(row_id, None)
         self._dirty_blocks.add(row_id // HASH_BLOCK_SIZE)
         n = self._count_of[row_id] = self._count_of.get(row_id, 0) + delta
         self.cache.add(row_id, n)
@@ -469,16 +656,57 @@ class Fragment:
             offs = cols % SLICE_WIDTH
             uniq = np.unique(rows)
             slot_of = {int(r): self._ensure_slot(int(r)) for r in uniq}
-            slots = np.asarray([slot_of[int(r)] for r in rows], dtype=np.int64)
-            bp.np_set_bulk(self._plane, slots, offs)
+
+            dense_mask = np.asarray(
+                [slot_of[int(r)] is not None for r in rows], dtype=bool
+            )
+            if dense_mask.any():
+                d_rows = rows[dense_mask]
+                slots = np.asarray(
+                    [slot_of[int(r)] for r in d_rows], dtype=np.int64
+                )
+                bp.np_set_bulk(self._plane, slots, offs[dense_mask])
+            if not dense_mask.all():
+                s_rows = rows[~dense_mask]
+                s_offs = offs[~dense_mask].astype(np.uint32)
+                order = np.lexsort((s_offs, s_rows))
+                s_rows, s_offs = s_rows[order], s_offs[order]
+                uniq_s = np.unique(s_rows)
+                starts = np.searchsorted(s_rows, uniq_s)
+                for i, r in enumerate(uniq_s):
+                    hi = starts[i + 1] if i + 1 < len(starts) else len(s_rows)
+                    seg = s_offs[starts[i] : hi]
+                    cur = self._sparse[int(r)]
+                    if len(cur) == 0:
+                        # brand-new row (the tall-import common case):
+                        # the sorted segment IS the row, minus dups
+                        merged = seg[
+                            np.insert(np.diff(seg) != 0, 0, True)
+                        ] if len(seg) > 1 else seg
+                    else:
+                        merged = np.union1d(cur, seg).astype(np.uint32)
+                    self._sparse[int(r)] = merged
+
             self._version += 1
             self._invalidate_device()
+            self._sparse_dev.clear()
             self._row_cache.clear()
             self._dirty_blocks.update(int(r) // HASH_BLOCK_SIZE for r in uniq)
-            counts = bp.np_row_counts(self._plane)
+            d_items = [(r, s) for r, s in slot_of.items() if s is not None]
+            if d_items:
+                cnts = bp.np_row_counts(
+                    self._plane[np.asarray([s for _, s in d_items])]
+                )
+            for i, (r, _) in enumerate(d_items):
+                self._count_of[r] = int(cnts[i])
+                self.cache.bulk_add(r, int(cnts[i]))
             for r, s in slot_of.items():
-                self._count_of[r] = int(counts[s])
-                self.cache.bulk_add(r, int(counts[s]))
+                if s is None:
+                    n = len(self._sparse[r])
+                    self._count_of[r] = n
+                    self.cache.bulk_add(r, n)
+            for r in uniq:
+                self._maybe_promote(int(r))
             self.cache.invalidate()
             self.cache.recalculate()
             self.stats.count("ImportBit", len(row_ids))  # ref: fragment.go:969
@@ -489,9 +717,7 @@ class Fragment:
         file; resets the op count (reference: fragment.go:1032-1074)."""
         with self._mu:
             t0 = time.perf_counter()
-            data = roaring.encode(
-                roaring.row_map_to_containers(self._row_map(), SLICE_WIDTH)
-            )
+            data = roaring.encode_tiered(*self._containers_tiered())
             tmp = self.path + ".snapshotting"
             with open(tmp, "wb") as fh:
                 fh.write(data)
@@ -568,16 +794,31 @@ class Fragment:
         src_seg = opt.src.segments.get(self.slice)
         if src_seg is None:
             return []
+        src_words = np.asarray(src_seg, dtype=np.uint32)
         with self._mu:
-            present = [p.id for p in candidates if p.id in self._slot_of]
-            if not present:
+            dense_ids = [p.id for p in candidates if p.id in self._slot_of]
+            sparse_ids = [p.id for p in candidates if p.id in self._sparse]
+            if not dense_ids and not sparse_ids:
                 return []
-            slots = np.asarray([self._slot_of[i] for i in present], dtype=np.int32)
-            # Gather candidate rows from the HBM-resident plane — only the
-            # src row and the slot indices travel host->device.
-            sub = self.device_plane()[slots]
-        counts = np.asarray(bp.top_counts(sub, np.asarray(src_seg, dtype=np.uint32)))
-        by_id = dict(zip(present, (int(c) for c in counts)))
+            by_id: dict[int, int] = {}
+            if dense_ids:
+                slots = np.asarray(
+                    [self._slot_of[i] for i in dense_ids], dtype=np.int32
+                )
+                # Gather candidate rows from the HBM-resident plane —
+                # only the src row and slot indices travel host->device.
+                sub = self.device_plane()[slots]
+            # Sparse candidates (the low-count tail) score host-side in
+            # O(set bits): probe src's words at each offset.
+            for rid in sparse_ids:
+                offs = self._sparse[rid]
+                by_id[rid] = int(
+                    ((src_words[offs >> 5] >> (offs & np.uint32(31)))
+                     & np.uint32(1)).sum()
+                )
+        if dense_ids:
+            counts = np.asarray(bp.top_counts(sub, src_words))
+            by_id.update(zip(dense_ids, (int(c) for c in counts)))
 
         results: list[Pair] = []
         for p in candidates:
@@ -625,13 +866,16 @@ class Fragment:
 
     def blocks(self) -> list[tuple[int, bytes]]:
         """[(block_id, sha1)] per HASH_BLOCK_SIZE rows; empty blocks are
-        skipped (reference: fragment.go:717-796).  Each hashed block is
-        zero-padded to the full HASH_BLOCK_SIZE extent so the checksum
-        depends only on logical content, never on plane padding history —
-        two replicas with the same bits always agree."""
+        skipped (reference: fragment.go:717-796).  Checksums hash the
+        sorted (row, offset) BIT POSITIONS of the block — like the
+        reference, which hashes positions rather than raw storage — so
+        they depend only on logical content, identical across tiers and
+        replicas."""
         with self._mu:
             by_block: dict[int, list[int]] = {}
             for r in self._slot_of:
+                by_block.setdefault(r // HASH_BLOCK_SIZE, []).append(r)
+            for r in self._sparse:
                 by_block.setdefault(r // HASH_BLOCK_SIZE, []).append(r)
             out = []
             for block_id in sorted(by_block):
@@ -641,10 +885,15 @@ class Fragment:
                 ):
                     chk = self._block_sums[block_id]
                 else:
-                    block = self._block_rows(block_id, by_block[block_id])
+                    rws, cls = self._block_positions(
+                        block_id, by_block[block_id]
+                    )
                     chk = (
-                        hashlib.sha1(block.tobytes()).digest()
-                        if block.any()
+                        hashlib.sha1(
+                            rws.astype("<u8").tobytes()
+                            + cls.astype("<u8").tobytes()
+                        ).digest()
+                        if len(rws)
                         else None
                     )
                     self._block_sums[block_id] = chk
@@ -653,32 +902,44 @@ class Fragment:
                     out.append((block_id, chk))
             return out
 
-    def _block_rows(self, block_id: int, rows: list[int]) -> np.ndarray:
-        """Materialize one full HASH_BLOCK_SIZE-row extent (absent rows
-        zero) so checksums depend only on logical content."""
+    def _block_positions(
+        self, block_id: int, rows: list[int] | None = None
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Sorted (rows, col-offsets) of every set bit in a block, from
+        both tiers.  ``rows`` (any order) skips the full-dict scan when
+        the caller already grouped rows by block — blocks() would
+        otherwise rescan every row per block."""
         lo = block_id * HASH_BLOCK_SIZE
-        block = np.zeros((HASH_BLOCK_SIZE, bp.WORDS_PER_SLICE), np.uint32)
+        hi = lo + HASH_BLOCK_SIZE
+        if rows is None:
+            rows = [r for r in self._slot_of if lo <= r < hi] + [
+                r for r in self._sparse if lo <= r < hi
+            ]
+        rows = sorted(rows)
+        segs: list[np.ndarray] = []
+        seg_rows: list[int] = []
         for r in rows:
-            block[r - lo] = self._plane[self._slot_of[r]]
-        return block
+            slot = self._slot_of.get(r)
+            if slot is not None:
+                offs = bp.np_row_to_columns(self._plane[slot]).astype(np.int64)
+            else:
+                offs = self._sparse[r].astype(np.int64)
+            if len(offs):
+                segs.append(offs)
+                seg_rows.append(r)
+        if not segs:
+            return np.empty(0, np.int64), np.empty(0, np.int64)
+        lens = np.asarray([len(s) for s in segs])
+        rws = np.repeat(np.asarray(seg_rows, dtype=np.int64), lens)
+        return rws, np.concatenate(segs)
 
     def block_data(self, block_id: int) -> PairSet:
         """All (row, col-offset) bits in a block (reference:
         fragment.go:798-808)."""
         with self._mu:
-            lo = block_id * HASH_BLOCK_SIZE
-            rows = sorted(
-                r for r in self._slot_of if lo <= r < lo + HASH_BLOCK_SIZE
-            )
-            if not rows:
-                return PairSet()
-            block = self._plane[np.asarray([self._slot_of[r] for r in rows])]
-            bits = np.unpackbits(
-                np.ascontiguousarray(block).view(np.uint8), bitorder="little"
-            ).reshape(len(rows), SLICE_WIDTH)
-            rws, cls = np.nonzero(bits)
+            rws, cls = self._block_positions(block_id)
             return PairSet(
-                row_ids=[rows[int(r)] for r in rws],
+                row_ids=[int(r) for r in rws],
                 column_ids=[int(c) for c in cls],
             )
 
@@ -759,9 +1020,7 @@ class Fragment:
         """Stream a tar with "data" (roaring file) and "cache" entries."""
         with self._mu:
             tw = tarfile.open(fileobj=w, mode="w|")
-            data = roaring.encode(
-                roaring.row_map_to_containers(self._row_map(), SLICE_WIDTH)
-            )
+            data = roaring.encode_tiered(*self._containers_tiered())
             info = tarfile.TarInfo("data")
             info.size = len(data)
             info.mtime = int(time.time())
@@ -780,10 +1039,8 @@ class Fragment:
             for member in tr:
                 payload = tr.extractfile(member).read()
                 if member.name == "data":
-                    containers = roaring.decode(payload)
-                    self._load_row_map(
-                        roaring.containers_to_row_map(containers, SLICE_WIDTH)
-                    )
+                    words, arrays, _ = roaring.decode_tiered(payload)
+                    self._load_tiered(words, arrays)
                     self._version += 1
                     self._row_cache.clear()
                     self._op_n = 0
@@ -803,7 +1060,9 @@ class Fragment:
                         continue
                     self.cache = cache_mod.new_cache(self.cache_type, self.cache_size)
                     for row_id in ids:
-                        if isinstance(row_id, int) and row_id in self._slot_of:
+                        if isinstance(row_id, int) and (
+                            row_id in self._slot_of or row_id in self._sparse
+                        ):
                             self.cache.bulk_add(
                                 row_id, self._count_of.get(row_id, 0)
                             )
@@ -821,16 +1080,19 @@ class Fragment:
         unpacked plane — exports and sync walks of big fragments stay
         under 2x plane memory."""
         with self._mu:
-            rows = sorted(self._slot_of)
+            rows = sorted(set(self._slot_of) | set(self._sparse))
         base = self.slice * SLICE_WIDTH
         for r in rows:
             with self._mu:
                 slot = self._slot_of.get(r)
-                if slot is None:
-                    continue
-                words = self._plane[slot].copy()
-            bits = np.unpackbits(words.view(np.uint8), bitorder="little")
-            for c in np.nonzero(bits)[0]:
+                if slot is not None:
+                    offs = bp.np_row_to_columns(self._plane[slot])
+                else:
+                    sp = self._sparse.get(r)
+                    if sp is None:
+                        continue
+                    offs = sp
+            for c in offs:
                 yield r, base + int(c)
 
     def __repr__(self) -> str:

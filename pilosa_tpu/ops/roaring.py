@@ -19,10 +19,12 @@ Layout (all little-endian):
         u8 type (0=add, 1=remove), u64 value, u32 FNV-1a(first 9 bytes)
 
 A container covers 2^16 bit-positions; its key is ``value >> 16``
-(reference: roaring/roaring.go:1786-1787).  In-memory we do not keep
-containers at all — decoding scatters straight into a dense numpy uint32
-bit-plane and encoding re-sparsifies, choosing array vs bitmap form by
-the same ArrayMaxSize = 4096 rule (reference: roaring/roaring.go:893).
+(reference: roaring/roaring.go:1786-1787).  Decoding is TIERED like the
+reference's in-memory forms (roaring/roaring.go:893-906): bitmap
+containers materialize as uint64[1024] word arrays, array containers
+stay as sorted uint32 value arrays (pay-per-bit), and encoding chooses
+the payload form by the same ArrayMaxSize = 4096 rule regardless of the
+in-memory tier (reference: roaring/roaring.go:893).
 """
 
 from __future__ import annotations
@@ -101,7 +103,48 @@ def decode_with_ops(data: bytes) -> tuple[dict[int, np.ndarray], int]:
     return containers, op_n
 
 
-def _decode_containers(data: bytes):
+# Below this many containers, full materialization costs at most
+# ~64 MiB and the compiled C++ codec beats the pure-Python loop; past
+# it (tall-sparse files: one array container per row) staying in value
+# form wins on both memory and time.
+_NATIVE_DECODE_MAX_CONTAINERS = 8192
+
+
+def decode_tiered(
+    data: bytes,
+) -> tuple[dict[int, np.ndarray], dict[int, np.ndarray], int]:
+    """Decode keeping each container in its cheapest form:
+    ``(words, arrays, op_n)`` where ``words[key]`` is uint64[1024] (bitmap
+    containers) and ``arrays[key]`` is a SORTED uint32 value array (array
+    containers, pay-per-bit — never materialized to 8 KiB).  This is the
+    loading path for tall-sparse fragments (e.g. inverse views with one
+    array container per row), where materializing every container would
+    cost rows x 8 KiB (reference keeps the same two forms in memory,
+    roaring/roaring.go:893-906).
+
+    Small (dense-file) inputs dispatch to the C++ codec and return
+    words-form containers; the pure-Python value-form path serves the
+    tall-sparse case the native materializing decoder would hurt."""
+    from pilosa_tpu import native
+
+    if len(data) >= HEADER_SIZE and native.available():
+        (_, key_n) = struct.unpack_from("<II", data, 0)
+        if key_n <= _NATIVE_DECODE_MAX_CONTAINERS:
+            try:
+                res = native.decode(data)
+            except native.NativeCorruptError as e:
+                raise CorruptError(str(e)) from e
+            if res is not None:
+                containers, op_n = res
+                return containers, {}, op_n
+    words, arrays, ops_offset, _ = _decode_containers_tiered(data)
+    op_n = _apply_ops_tiered(words, arrays, data, ops_offset)
+    return words, arrays, op_n
+
+
+def _decode_containers_tiered(data: bytes):
+    """Parse into (words, arrays, ops_offset, infos): bitmap containers
+    as uint64[1024] words, array containers as sorted uint32 values."""
     if len(data) < HEADER_SIZE:
         raise CorruptError("data too small")
     cookie, key_n = struct.unpack_from("<II", data, 0)
@@ -112,24 +155,30 @@ def _decode_containers(data: bytes):
         raise CorruptError(
             f"header claims {key_n} containers but file is {len(data)} bytes"
         )
-    keys = np.zeros(key_n, dtype=np.uint64)
-    ns = np.zeros(key_n, dtype=np.int64)
-    for i in range(key_n):
-        key, n_minus_1 = struct.unpack_from("<QI", data, HEADER_SIZE + i * 12)
-        keys[i] = key
-        ns[i] = n_minus_1 + 1
+    # Vectorized header parse: the key table and offset table read as
+    # one structured view each (a tall-sparse file has one container
+    # per row — hundreds of thousands of entries).
+    ktab = np.frombuffer(
+        data,
+        dtype=np.dtype([("key", "<u8"), ("n1", "<u4")]),
+        count=key_n,
+        offset=HEADER_SIZE,
+    )
+    keys = ktab["key"]
+    ns = ktab["n1"].astype(np.int64) + 1
 
     offsets_at = HEADER_SIZE + key_n * 12
-    containers: dict[int, np.ndarray] = {}
+    offs_tab = np.frombuffer(data, dtype="<u4", count=key_n, offset=offsets_at)
+    words_out: dict[int, np.ndarray] = {}
+    arrays_out: dict[int, np.ndarray] = {}
     ops_offset = offsets_at + key_n * 4
     infos: list[ContainerInfo] = []
     for i in range(key_n):
-        (offset,) = struct.unpack_from("<I", data, offsets_at + i * 4)
+        offset = int(offs_tab[i])
         if offset >= len(data):
             raise CorruptError(f"offset out of bounds: off={offset}, len={len(data)}")
         n = int(ns[i])
         key = int(keys[i])
-        words = np.zeros(CONTAINER_WORDS64, dtype=np.uint64)
         payload_len = n * 4 if n <= ARRAY_MAX_SIZE else CONTAINER_WORDS64 * 8
         if offset + payload_len > len(data):
             raise CorruptError(
@@ -143,26 +192,55 @@ def _decode_containers(data: bytes):
                     f"array value out of range in container key={key}: "
                     f"{int(values.max())}"
                 )
-            widx = (values // 64).astype(np.int64)
-            masks = np.uint64(1) << (values % 64).astype(np.uint64)
-            np.bitwise_or.at(words, widx, masks)
+            # The format requires strictly-ascending array values; the
+            # sparse tier's binary searches depend on it, so fail fast
+            # instead of silently mis-answering on corrupt input.
+            if values.size > 1 and (np.diff(values.astype(np.int64)) <= 0).any():
+                raise CorruptError(
+                    f"array container key={key} is not sorted/unique"
+                )
+            arrays_out[key] = values.astype(np.uint32)
             end = offset + n * 4
             infos.append(ContainerInfo(key, "array", n, n * 4))
         else:
-            words[:] = np.frombuffer(
+            words_out[key] = np.frombuffer(
                 data, dtype="<u8", count=CONTAINER_WORDS64, offset=offset
-            )
+            ).copy()
             end = offset + CONTAINER_WORDS64 * 8
             infos.append(ContainerInfo(key, "bitmap", n, CONTAINER_WORDS64 * 8))
-        containers[key] = words
         ops_offset = max(ops_offset, end)
+    return words_out, arrays_out, ops_offset, infos
+
+
+def values_to_words(values: np.ndarray) -> np.ndarray:
+    """Sorted uint32 container values -> uint64[1024] words."""
+    words = np.zeros(CONTAINER_WORDS64, dtype=np.uint64)
+    if len(values):
+        widx = (values // 64).astype(np.int64)
+        masks = np.uint64(1) << (values % 64).astype(np.uint64)
+        np.bitwise_or.at(words, widx, masks)
+    return words
+
+
+def words_to_values(words: np.ndarray) -> np.ndarray:
+    """uint64[1024] words -> sorted uint32 container values."""
+    bits = np.unpackbits(words.view(np.uint8), bitorder="little")
+    (positions,) = np.nonzero(bits)
+    return positions.astype(np.uint32)
+
+
+def _decode_containers(data: bytes):
+    words_out, arrays_out, ops_offset, infos = _decode_containers_tiered(data)
+    containers = words_out
+    for key, values in arrays_out.items():
+        containers[key] = values_to_words(values)
     return containers, ops_offset, infos
 
 
-def _apply_ops(containers: dict[int, np.ndarray], data: bytes, ops_offset: int) -> int:
-    """Replay the op-log; returns the number of ops applied."""
+def _iter_ops(data: bytes, ops_offset: int):
+    """Validate and yield (typ, value) op-log records — the single
+    parser of the 13-byte wire record, shared by both appliers."""
     pos = ops_offset
-    op_n = 0
     while pos < len(data):
         if len(data) - pos < OP_SIZE:
             raise CorruptError(f"op data out of bounds: len={len(data) - pos}")
@@ -172,6 +250,17 @@ def _apply_ops(containers: dict[int, np.ndarray], data: bytes, ops_offset: int) 
         want = fnv1a32(data[pos : pos + 9])
         if chk != want:
             raise CorruptError(f"checksum mismatch: exp={want:08x}, got={chk:08x}")
+        if typ not in (OP_ADD, OP_REMOVE):
+            raise CorruptError(f"invalid op type: {typ}")
+        yield typ, value
+        pos += OP_SIZE
+
+
+def _apply_ops(containers: dict[int, np.ndarray], data: bytes, ops_offset: int) -> int:
+    """Replay the op-log over words-form containers; returns the number
+    of ops applied."""
+    op_n = 0
+    for typ, value in _iter_ops(data, ops_offset):
         key = value >> 16
         word, shift = divmod(value & 0xFFFF, 64)
         if key not in containers:
@@ -179,11 +268,43 @@ def _apply_ops(containers: dict[int, np.ndarray], data: bytes, ops_offset: int) 
         mask = np.uint64(1) << np.uint64(shift)
         if typ == OP_ADD:
             containers[key][word] |= mask
-        elif typ == OP_REMOVE:
-            containers[key][word] &= ~mask
         else:
-            raise CorruptError(f"invalid op type: {typ}")
-        pos += OP_SIZE
+            containers[key][word] &= ~mask
+        op_n += 1
+    return op_n
+
+
+def _apply_ops_tiered(
+    words: dict[int, np.ndarray],
+    arrays: dict[int, np.ndarray],
+    data: bytes,
+    ops_offset: int,
+) -> int:
+    """Op-log replay over tiered containers; array containers mutate in
+    value form (sorted insert/remove) without materialization."""
+    op_n = 0
+    for typ, value in _iter_ops(data, ops_offset):
+        key = value >> 16
+        low = np.uint32(value & 0xFFFF)
+        if key in words:
+            word, shift = divmod(int(low), 64)
+            mask = np.uint64(1) << np.uint64(shift)
+            if typ == OP_ADD:
+                words[key][word] |= mask
+            else:
+                words[key][word] &= ~mask
+        else:
+            vals = arrays.get(key)
+            if vals is None:
+                vals = np.empty(0, dtype=np.uint32)
+            i = int(np.searchsorted(vals, low))
+            present = i < len(vals) and vals[i] == low
+            if typ == OP_ADD and not present:
+                arrays[key] = np.insert(vals, i, low)
+            elif typ == OP_REMOVE and present:
+                arrays[key] = np.delete(vals, i)
+            elif key not in arrays:
+                arrays[key] = vals
         op_n += 1
     return op_n
 
@@ -195,35 +316,58 @@ def encode(containers: dict[int, np.ndarray]) -> bytes:
     skips c.n == 0).  Containers with <= 4096 bits are written in array
     form, else bitmap form.  Dispatches to the C++ codec when available.
     """
+    return encode_tiered(containers, {})
+
+
+def encode_tiered(
+    words: dict[int, np.ndarray], arrays: dict[int, np.ndarray]
+) -> bytes:
+    """Serialize tiered containers (see decode_tiered) to the reference
+    file format, choosing array vs bitmap payload form by the SAME
+    n <= 4096 rule regardless of the in-memory form; empty containers
+    are dropped.  Peak transient memory is one container.  All-words
+    inputs (the dense-fragment case) dispatch to the C++ codec."""
     from pilosa_tpu import native
 
-    res = native.encode(containers)
-    if res is not None:
-        return res
-    keys = sorted(k for k, w in containers.items() if _words_count(w) > 0)
-    header = bytearray()
-    header += struct.pack("<II", COOKIE, len(keys))
+    if not arrays:
+        res = native.encode(words)
+        if res is not None:
+            return res
+    entries: list[tuple[int, int, object, bool]] = []  # key, n, src, is_vals
+    for key, vals in arrays.items():
+        if key in words:
+            raise ValueError(f"container key={key} present in both tiers")
+        if len(vals):
+            entries.append((int(key), len(vals), vals, True))
+    for key, w in words.items():
+        n = _words_count(w)
+        if n:
+            entries.append((int(key), n, w, False))
+    entries.sort()
 
     payloads: list[bytes] = []
-    ns: list[int] = []
-    for key in keys:
-        words = containers[key]
-        n = _words_count(words)
-        ns.append(n)
+    for key, n, src, is_vals in entries:
         if n <= ARRAY_MAX_SIZE:
-            payloads.append(_words_to_array_bytes(words))
+            vals = src if is_vals else words_to_values(src)
+            payloads.append(np.asarray(vals, dtype="<u4").tobytes())
         else:
-            payloads.append(words.astype("<u8", copy=False).tobytes())
+            w = values_to_words(src) if is_vals else src
+            payloads.append(np.asarray(w, dtype="<u8").tobytes())
 
-    for key, n in zip(keys, ns):
-        header += struct.pack("<QI", key, n - 1)
-    offset = len(header) + 4 * len(keys)
-    for p in payloads:
-        header += struct.pack("<I", offset)
-        offset += len(p)
+    # Vectorized key/offset tables (a tall-sparse fragment serializes
+    # hundreds of thousands of containers).
+    ktab = np.zeros(len(entries), dtype=np.dtype([("key", "<u8"), ("n1", "<u4")]))
+    ktab["key"] = [key for key, _, _, _ in entries]
+    ktab["n1"] = [n - 1 for _, n, _, _ in entries]
+    plens = np.asarray([len(p) for p in payloads], dtype=np.int64)
+    base = HEADER_SIZE + 12 * len(entries) + 4 * len(entries)
+    otab = (base + np.concatenate(([0], np.cumsum(plens[:-1])))
+            if len(entries) else np.empty(0, np.int64)).astype("<u4")
 
     out = io.BytesIO()
-    out.write(bytes(header))
+    out.write(struct.pack("<II", COOKIE, len(entries)))
+    out.write(ktab.tobytes())
+    out.write(otab.tobytes())
     for p in payloads:
         out.write(p)
     return out.getvalue()
@@ -237,12 +381,6 @@ def encode_op(typ: int, value: int) -> bytes:
 
 def _words_count(words: np.ndarray) -> int:
     return int(np.unpackbits(words.view(np.uint8)).sum())
-
-
-def _words_to_array_bytes(words: np.ndarray) -> bytes:
-    bits = np.unpackbits(words.view(np.uint8), bitorder="little")
-    (positions,) = np.nonzero(bits)
-    return positions.astype("<u4").tobytes()
 
 
 def info(data: bytes) -> BitmapInfo:
@@ -314,43 +452,3 @@ def plane_to_containers(plane: np.ndarray, slice_width: int) -> dict[int, np.nda
     return out
 
 
-def containers_to_row_map(
-    containers: dict[int, np.ndarray], slice_width: int
-) -> dict[int, np.ndarray]:
-    """Sparse densify: container dict -> {row_id: uint32[slice_width/32]}.
-
-    Unlike :func:`containers_to_plane`, memory scales with *touched* rows,
-    so tall-sparse fragments (inverse views, high rowIDs) stay cheap —
-    the dense-plane analog of roaring's pay-per-container storage.
-    """
-    per_row = slice_width // CONTAINER_BITS
-    words32_per_container = CONTAINER_BITS // 32
-    out: dict[int, np.ndarray] = {}
-    for key, words in containers.items():
-        row, cidx = divmod(key, per_row)
-        r = out.get(row)
-        if r is None:
-            r = out[row] = np.zeros(slice_width // 32, dtype=np.uint32)
-        lo = cidx * words32_per_container
-        r[lo : lo + words32_per_container] = words.view("<u4").astype(np.uint32)
-    return out
-
-
-def row_map_to_containers(
-    row_map: dict[int, np.ndarray], slice_width: int
-) -> dict[int, np.ndarray]:
-    """Inverse of :func:`containers_to_row_map`; empty containers are
-    dropped (the reference never serializes empty containers)."""
-    per_row = slice_width // CONTAINER_BITS
-    words32_per_container = CONTAINER_BITS // 32
-    out: dict[int, np.ndarray] = {}
-    for row in sorted(row_map):
-        words = row_map[row]
-        for cidx in range(per_row):
-            lo = cidx * words32_per_container
-            chunk = words[lo : lo + words32_per_container]
-            if chunk.any():
-                out[int(row) * per_row + cidx] = (
-                    np.ascontiguousarray(chunk).view(np.uint64).copy()
-                )
-    return out

@@ -314,3 +314,49 @@ def test_remote_opt_executes_local_only(holder):
     )
     assert n == len(local)
     assert not boom.calls
+
+
+def test_inverse_high_cardinality_past_old_row_cap(ex, holder):
+    """An inverse-enabled frame over a high-cardinality slice: one bulk
+    import touching 70k distinct columns gives the inverse fragment 70k
+    distinct rows — past the old 2^16 dense cap — stored in the sparse
+    tier; Bitmap on the inverse view still answers (VERDICT r2 item 4).
+    (Budget shrunk so the test exercises the spill without 8 GiB.)"""
+    import pilosa_tpu.core.fragment as fr
+
+    idx = holder.create_index("i")
+    f = idx.create_frame("f", inverse_enabled=True)
+    n = 70_000
+    rows = [7] * n + [8]
+    cols = list(range(n)) + [999_999]  # row 8's column is outside row 7's range
+    inv_frag_budget = 512
+    orig_init = fr.Fragment.__init__
+
+    def small_init(self, *a, **kw):
+        kw.setdefault("dense_row_budget", inv_frag_budget)
+        orig_init(self, *a, **kw)
+
+    # shrink the budget for fragments created during this import
+    fr.Fragment.__init__ = small_init
+    try:
+        f.import_bulk(rows, cols)
+    finally:
+        fr.Fragment.__init__ = orig_init
+
+    inv = holder.fragment("i", "f", VIEW_INVERSE, 0)
+    assert inv is not None
+    assert len(inv._sparse) >= n - inv_frag_budget
+    assert inv._plane.shape[0] <= inv_frag_budget
+    # inverse query: all original rows with the column set
+    (bm,) = q(ex, "i", "Bitmap(columnID=999999, frame=f)")
+    assert bm.bits() == [8]
+    (bm,) = q(ex, "i", "Bitmap(columnID=123, frame=f)")
+    assert bm.bits() == [7]
+    (bm,) = q(ex, "i", "Bitmap(columnID=69999, frame=f)")
+    assert bm.bits() == [7]
+    # standard orientation still healthy
+    (cnt,) = q(ex, "i", "Count(Bitmap(rowID=7, frame=f))")
+    assert cnt == n
+    # anti-entropy surface over the tall inverse fragment
+    # (70k contiguous rows -> blocks 0..699, plus row 999999's block)
+    assert len(inv.blocks()) == n // 100 + 1
