@@ -30,6 +30,7 @@ from __future__ import annotations
 import fcntl
 import hashlib
 import io
+import itertools
 import json
 import math
 import os
@@ -70,6 +71,25 @@ PROMOTE_BITS = 32 * 1024
 SPARSE_DEVICE_CACHE = 64
 # Largest legal row id: op-log positions are u64 and pos = row*2^20+off.
 MAX_ROW_ID = 1 << 44
+
+# Process-wide mutation epoch: bumped on EVERY fragment content change
+# (point writes, bulk imports, restores).  Read-side caches (the
+# executor's assembled leaf batches) validate in O(1) against it and
+# only fall back to per-fragment version checks when it moved —
+# read-mostly query workloads never pay a per-slice validation walk.
+_write_epoch = 0
+
+
+def _bump_write_epoch() -> None:
+    global _write_epoch
+    _write_epoch += 1
+
+
+def write_epoch() -> int:
+    return _write_epoch
+
+
+_fragment_serials = itertools.count(1)
 
 
 def _apply_pending(dev, pending):
@@ -153,6 +173,9 @@ class Fragment:
 
         self.row_attr_store = None  # wired by Frame
         self.stats = NopStatsClient()  # re-tagged by View._new_fragment
+        # Process-unique identity for cache version vectors: unlike
+        # id(), a serial is never reused by a recreated fragment.
+        self._serial = next(_fragment_serials)
 
         self._mu = threading.RLock()
         # Two-tier row storage.  DENSE: plane row *slots* hold up to
@@ -165,6 +188,12 @@ class Fragment:
         self._sparse: dict[int, np.ndarray] = {}
         # Sparse rows paged to the home device for query leaves (LRU).
         self._sparse_dev: "OrderedDict[int, object]" = OrderedDict()
+        # TopN candidate-row gathers cached per (version, candidate set):
+        # phase 1 (full ranked cache) and phase 2 (winner refetch) of the
+        # same query reuse their submatrices across repeated queries
+        # instead of re-gathering ~rows x 128 KiB from the plane each
+        # time (2 entries = the two phases of one hot query).
+        self._topn_sub: "OrderedDict[tuple, object]" = OrderedDict()
         self._max_row_id = 0
         self._op_n = 0
         self._version = 0
@@ -233,6 +262,11 @@ class Fragment:
                 self._file = None
             self._invalidate_device()
             self._opened = False
+            # A fragment leaving service (shutdown OR frame/index/view
+            # deletion) must invalidate epoch-validated read caches —
+            # deletes would otherwise serve stale batches until some
+            # unrelated write moved the epoch.
+            _bump_write_epoch()
 
     @property
     def cache_path(self) -> str:
@@ -392,6 +426,7 @@ class Fragment:
         self._block_sums.clear()
         self._dirty_blocks.clear()
         self._invalidate_device()
+        _bump_write_epoch()
 
     def _containers_tiered(
         self,
@@ -625,6 +660,7 @@ class Fragment:
 
     def _after_write(self, row_id: int, delta: int) -> None:
         self._version += 1
+        _bump_write_epoch()
         self._row_cache.pop(row_id, None)
         self._sparse_dev.pop(row_id, None)
         self._dirty_blocks.add(row_id // HASH_BLOCK_SIZE)
@@ -688,6 +724,7 @@ class Fragment:
                     self._sparse[int(r)] = merged
 
             self._version += 1
+            _bump_write_epoch()
             self._invalidate_device()
             self._sparse_dev.clear()
             self._row_cache.clear()
@@ -802,12 +839,21 @@ class Fragment:
                 return []
             by_id: dict[int, int] = {}
             if dense_ids:
-                slots = np.asarray(
-                    [self._slot_of[i] for i in dense_ids], dtype=np.int32
-                )
                 # Gather candidate rows from the HBM-resident plane —
-                # only the src row and slot indices travel host->device.
-                sub = self.device_plane()[slots]
+                # only the src row and slot indices travel host->device —
+                # and cache the gathered submatrix per candidate set.
+                sub_key = (self._version, tuple(dense_ids))
+                sub = self._topn_sub.get(sub_key)
+                if sub is None:
+                    slots = np.asarray(
+                        [self._slot_of[i] for i in dense_ids], dtype=np.int32
+                    )
+                    sub = self.device_plane()[slots]
+                    self._topn_sub[sub_key] = sub
+                    while len(self._topn_sub) > 2:
+                        self._topn_sub.popitem(last=False)
+                else:
+                    self._topn_sub.move_to_end(sub_key)
             # Sparse candidates (the low-count tail) score host-side in
             # O(set bits): probe src's words at each offset.
             for rid in sparse_ids:

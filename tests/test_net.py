@@ -456,16 +456,20 @@ class TestMultiNode:
         cols = [1, SLICE_WIDTH + 2, 2 * SLICE_WIDTH + 3, 5 * SLICE_WIDTH + 4]
         for col in cols:
             c0.execute_query("i", f'SetBit(frame="f", rowID=1, columnID={col})')
-        # Count from either coordinator sees all slices.
-        assert c0.execute_pql("i", 'Count(Bitmap(frame="f", rowID=1))') == 4
-        # The CreateSliceMessage broadcast is async; wait for s1 to learn
-        # the cluster max slice before querying it as coordinator.
+        # The CreateSliceMessage broadcast is async; a coordinator only
+        # counts slices it has learned about — wait for BOTH nodes to
+        # know the cluster max slice before asserting counts.
         c1 = InternalClient(s1.host, timeout=10.0)
         deadline = time.time() + 5.0
         while time.time() < deadline:
-            if s1.holder.index("i").max_slice() == 5:
+            if (
+                s0.holder.index("i").max_slice() == 5
+                and s1.holder.index("i").max_slice() == 5
+            ):
                 break
             time.sleep(0.02)
+        # Count from either coordinator sees all slices.
+        assert c0.execute_pql("i", 'Count(Bitmap(frame="f", rowID=1))') == 4
         assert c1.execute_pql("i", 'Count(Bitmap(frame="f", rowID=1))') == 4
         rb = c1.execute_pql("i", 'Bitmap(frame="f", rowID=1)')
         assert codec.bitmap_to_json(rb)["bits"] == sorted(cols)
