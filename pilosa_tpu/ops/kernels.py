@@ -32,10 +32,19 @@ from jax.experimental import pallas as pl
 
 _LANES = 128
 _ROW_SUBLANES = 256  # one slice-row: 256 * 128 = 32768 words
-# Slice-rows processed per grid step: 2 operands x 4 rows x 128 KiB =
+# Preferred slice-rows per grid step: 2 operands x 4 rows x 128 KiB =
 # 1 MiB of VMEM per buffer set — small enough to double-buffer, large
-# enough to amortize per-step overhead.
+# enough to amortize per-step overhead.  The actual step is the largest
+# of (4, 2, 1) dividing the row count, so NO operand is ever padded —
+# a pad would copy the full operand through HBM on the hot path.
 ROWS_PER_STEP = 4
+
+
+def _chunk_for(rows: int) -> int:
+    for c in (ROWS_PER_STEP, 2, 1):
+        if rows % c == 0:
+            return c
+    raise AssertionError("unreachable")
 
 
 def _interpret() -> bool:
@@ -54,15 +63,6 @@ def _combine(op: str, x, y):
     if op == "andnot":
         return x & ~y
     raise ValueError(f"unknown op {op!r}")
-
-
-def _pad_rows(x: jnp.ndarray) -> tuple[jnp.ndarray, int]:
-    """Pad the leading row axis up to a ROWS_PER_STEP multiple."""
-    rows = x.shape[0]
-    pad = (-rows) % ROWS_PER_STEP
-    if pad:
-        x = jnp.pad(x, ((0, pad),) + ((0, 0),) * (x.ndim - 1))
-    return x, rows
 
 
 def _row_tiles(x: jnp.ndarray) -> jnp.ndarray:
@@ -91,43 +91,34 @@ def _count_rows_kernel(a_ref, o_ref):
 def _partials_fused(a_tiles, b_tiles, op: str) -> jnp.ndarray:
     """int32 partial per slice-row of (a OP b); grid over row chunks,
     one VMEM output slot per chunk."""
-    a_tiles, rows = _pad_rows(a_tiles)
-    b_tiles, _ = _pad_rows(b_tiles)
     n = a_tiles.shape[0]
-    out = pl.pallas_call(
+    step = _chunk_for(n)
+    return pl.pallas_call(
         functools.partial(_fused_rows_kernel, op),
-        grid=(n // ROWS_PER_STEP,),
+        grid=(n // step,),
         in_specs=[
-            pl.BlockSpec(
-                (ROWS_PER_STEP, _ROW_SUBLANES, _LANES), lambda i: (i, 0, 0)
-            ),
-            pl.BlockSpec(
-                (ROWS_PER_STEP, _ROW_SUBLANES, _LANES), lambda i: (i, 0, 0)
-            ),
+            pl.BlockSpec((step, _ROW_SUBLANES, _LANES), lambda i: (i, 0, 0)),
+            pl.BlockSpec((step, _ROW_SUBLANES, _LANES), lambda i: (i, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((ROWS_PER_STEP,), lambda i: (i,)),
+        out_specs=pl.BlockSpec((step,), lambda i: (i,)),
         out_shape=jax.ShapeDtypeStruct((n,), jnp.int32),
         interpret=_interpret(),
     )(a_tiles, b_tiles)
-    return out[:rows]
 
 
 def _partials_count(a_tiles) -> jnp.ndarray:
-    a_tiles, rows = _pad_rows(a_tiles)
     n = a_tiles.shape[0]
-    out = pl.pallas_call(
+    step = _chunk_for(n)
+    return pl.pallas_call(
         _count_rows_kernel,
-        grid=(n // ROWS_PER_STEP,),
+        grid=(n // step,),
         in_specs=[
-            pl.BlockSpec(
-                (ROWS_PER_STEP, _ROW_SUBLANES, _LANES), lambda i: (i, 0, 0)
-            )
+            pl.BlockSpec((step, _ROW_SUBLANES, _LANES), lambda i: (i, 0, 0))
         ],
-        out_specs=pl.BlockSpec((ROWS_PER_STEP,), lambda i: (i,)),
+        out_specs=pl.BlockSpec((step,), lambda i: (i,)),
         out_shape=jax.ShapeDtypeStruct((n,), jnp.int32),
         interpret=_interpret(),
     )(a_tiles)
-    return out[:rows]
 
 
 @functools.partial(jax.jit, static_argnames=("op",))
@@ -169,20 +160,17 @@ def top_counts(plane: jnp.ndarray, src_row: jnp.ndarray) -> jnp.ndarray:
     against a resident src tile; each grid step writes its own output
     slot (no shared accumulator)."""
     rows = plane.shape[0]
-    pt, _ = _pad_rows(plane.reshape(rows, _ROW_SUBLANES, _LANES))
+    pt = plane.reshape(rows, _ROW_SUBLANES, _LANES)
     st = src_row.reshape(_ROW_SUBLANES, _LANES)
-    n = pt.shape[0]
-    out = pl.pallas_call(
+    step = _chunk_for(rows)
+    return pl.pallas_call(
         _top_counts_kernel,
-        grid=(n // ROWS_PER_STEP,),
+        grid=(rows // step,),
         in_specs=[
-            pl.BlockSpec(
-                (ROWS_PER_STEP, _ROW_SUBLANES, _LANES), lambda i: (i, 0, 0)
-            ),
+            pl.BlockSpec((step, _ROW_SUBLANES, _LANES), lambda i: (i, 0, 0)),
             pl.BlockSpec((_ROW_SUBLANES, _LANES), lambda i: (0, 0)),
         ],
-        out_specs=pl.BlockSpec((ROWS_PER_STEP,), lambda i: (i,)),
-        out_shape=jax.ShapeDtypeStruct((n,), jnp.int32),
+        out_specs=pl.BlockSpec((step,), lambda i: (i,)),
+        out_shape=jax.ShapeDtypeStruct((rows,), jnp.int32),
         interpret=_interpret(),
     )(pt, st)
-    return out[:rows]

@@ -41,10 +41,12 @@ def test_fused_count(rows, op, fn):
 
 
 def test_top_counts(rng):
-    plane = rng.integers(0, 2 ** 32, size=(4, bp.WORDS_PER_SLICE), dtype=np.uint32)
+    # 5 rows: NOT a multiple of the preferred grid chunk, so the
+    # odd-row-count (step-1) path is exercised too
+    plane = rng.integers(0, 2 ** 32, size=(5, bp.WORDS_PER_SLICE), dtype=np.uint32)
     src = rng.integers(0, 2 ** 32, size=bp.WORDS_PER_SLICE, dtype=np.uint32)
     got = np.asarray(kernels.top_counts(plane, src))
-    for r in range(4):
+    for r in range(5):
         assert got[r] == np_popcount(plane[r] & src)
 
 
