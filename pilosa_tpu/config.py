@@ -77,7 +77,7 @@ class TPUConfig:
     """TPU-native additions (no reference counterpart)."""
 
     mesh_shape: str = ""  # e.g. "8" or "4x2"; empty = all local devices
-    use_pallas: bool = True
+    use_pallas: bool = False
 
 
 @dataclass

@@ -219,7 +219,16 @@ def home_device(slice_i: int):
 
 
 def _use_pallas() -> bool:
+    """Pallas kernels are OPT-IN (``PILOSA_TPU_USE_PALLAS=1`` /
+    ``tpu.use-pallas`` config): the blessed production path is plain
+    XLA, whose fused popcount+reduce measured 4x FASTER than the round-2
+    Pallas kernels on a v5e chip (BENCH_r02).  The restructured kernels
+    (per-row VMEM partials) stay in-tree behind this flag so the
+    keep-or-kill comparison bench.py logs can promote them on
+    measurement, not speculation."""
     if os.environ.get("PILOSA_TPU_DISABLE_PALLAS"):
+        return False
+    if not os.environ.get("PILOSA_TPU_USE_PALLAS"):
         return False
     return jax.default_backend() == "tpu"
 
