@@ -167,3 +167,69 @@ class TestExecutorQuick:
         got = ex2.execute("i", parse_string(f'Bitmap(frame="f", rowID={row})'))[0]
         assert bitmap_to_json(got)["bits"] == sorted(model[row])
         holder2.close()
+
+
+# ---------------------------------------------------------------------------
+# two-tier fragment storage (sparse-tall, r3)
+# ---------------------------------------------------------------------------
+
+
+fragment_ops = st.lists(
+    st.tuples(
+        st.sampled_from(["set", "clear", "snapshot-reopen"]),
+        st.integers(min_value=0, max_value=30),      # row id
+        st.integers(min_value=0, max_value=2**20 - 1),  # column offset
+    ),
+    min_size=1,
+    max_size=60,
+)
+
+
+class TestFragmentTierProperties:
+    @QUICK
+    @given(ops=fragment_ops, budget=st.integers(min_value=1, max_value=8))
+    def test_random_ops_match_set_model(self, ops, budget):
+        """Random set/clear/persistence sequences against a tiny dense
+        budget behave exactly like a pure-Python set model, regardless
+        of which tier each row lands in (the analog of the reference's
+        TestMain_Set_Quick, server/server_test.go:43-122)."""
+        import pathlib
+        import tempfile
+
+        from pilosa_tpu.core.fragment import Fragment
+
+        d = pathlib.Path(tempfile.mkdtemp(prefix="frag-quick-"))
+        f = Fragment(
+            str(d / "0"), "i", "f", "standard", 0,
+            dense_row_budget=budget, max_op_n=10**9,
+        )
+        f.open()
+        model: set[tuple[int, int]] = set()
+        try:
+            for op, row, col in ops:
+                if op == "set":
+                    changed = f.set_bit(row, col)
+                    assert changed == ((row, col) not in model)
+                    model.add((row, col))
+                elif op == "clear":
+                    changed = f.clear_bit(row, col)
+                    assert changed == ((row, col) in model)
+                    model.discard((row, col))
+                else:
+                    f.snapshot()
+                    f.close()
+                    f = Fragment(
+                        str(d / "0"), "i", "f", "standard", 0,
+                        dense_row_budget=budget, max_op_n=10**9,
+                    )
+                    f.open()
+                # spot invariants after every op
+                assert f.count() == len(model)
+            assert sorted(f.for_each_bit()) == sorted(model)
+            by_row: dict[int, set[int]] = {}
+            for r, c in model:
+                by_row.setdefault(r, set()).add(c)
+            for r in range(31):
+                assert f.row(r).bits() == sorted(by_row.get(r, ())), r
+        finally:
+            f.close()
