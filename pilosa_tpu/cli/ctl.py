@@ -349,25 +349,52 @@ def run_bench(args) -> int:
     import random
 
     client = _client(args.host)
-    n = args.num
-    if n <= 0:
-        raise CommandError("--num must be > 0")
-    # Mirror of the reference's random set-bit workload
-    # (reference: ctl/bench.go:70-102): rowID in [0,1000), columnID in
-    # [0,100000).
-    t0 = time.monotonic()
-    batch = []
-    for _ in range(n):
-        row = random.randrange(1000)
-        col = random.randrange(100000)
-        batch.append(f'SetBit(frame="{args.frame}", rowID={row}, columnID={col})')
-        if len(batch) == 1000:
+    if args.operation == "set-bit":
+        n = args.num
+        if n <= 0:
+            raise CommandError("--num must be > 0")
+        # Mirror of the reference's random set-bit workload
+        # (reference: ctl/bench.go:70-102): rowID in [0,1000), columnID in
+        # [0,100000).
+        t0 = time.monotonic()
+        batch = []
+        for _ in range(n):
+            row = random.randrange(1000)
+            col = random.randrange(100000)
+            batch.append(f'SetBit(frame="{args.frame}", rowID={row}, columnID={col})')
+            if len(batch) == 1000:
+                client.execute_query(args.index, "\n".join(batch))
+                batch.clear()
+        if batch:
             client.execute_query(args.index, "\n".join(batch))
-            batch.clear()
-    if batch:
-        client.execute_query(args.index, "\n".join(batch))
-    elapsed = time.monotonic() - t0
-    print(f"executed {n} operations in {elapsed:.3f}s ({n / elapsed:.0f} op/sec)")
+        elapsed = time.monotonic() - t0
+        print(f"executed {n} operations in {elapsed:.3f}s ({n / elapsed:.0f} op/sec)")
+        return 0
+
+    # Read-query benches over EXISTING data (BASELINE.json configs[1-2]):
+    # p50/p95 over --num iterations (default 20) of one PQL query.
+    if args.operation == "intersect-count":
+        pql = (
+            f'Count(Intersect(Bitmap(frame="{args.frame}", rowID={args.row1}),'
+            f' Bitmap(frame="{args.frame}", rowID={args.row2})))'
+        )
+    else:  # topn
+        pql = f'TopN(frame="{args.frame}", n={args.topn_n})'
+    iters = args.num if args.num > 0 else 20
+    result = client.execute_pql(args.index, pql)  # warm (compile/caches)
+    lat = []
+    for _ in range(iters):
+        t0 = time.monotonic()
+        result = client.execute_pql(args.index, pql)
+        lat.append(time.monotonic() - t0)
+    lat.sort()
+    p50 = lat[len(lat) // 2]
+    p95 = lat[min(len(lat) - 1, int(len(lat) * 0.95))]
+    shown = result if isinstance(result, int) else f"{len(result)} pairs"
+    print(
+        f"{args.operation}: {iters} queries, p50 {p50*1e3:.2f} ms,"
+        f" p95 {p95*1e3:.2f} ms (result: {shown})"
+    )
     return 0
 
 

@@ -84,9 +84,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-i", "--index", required=True)
     p.add_argument("-f", "--frame", required=True)
     p.add_argument(
-        "-o", "--operation", default="set-bit", choices=["set-bit"],
+        "-o",
+        "--operation",
+        default="set-bit",
+        choices=["set-bit", "intersect-count", "topn"],
+        help="set-bit: random writes (reference parity, ctl/bench.go);"
+        " intersect-count / topn: the BASELINE.json query configs"
+        " against existing data",
     )
     p.add_argument("-n", "--num", type=int, default=0, help="operations to run")
+    p.add_argument("--row1", type=int, default=1, help="intersect-count row A")
+    p.add_argument("--row2", type=int, default=2, help="intersect-count row B")
+    p.add_argument("--topn-n", type=int, default=100, help="topn result size")
     p.set_defaults(fn=ctl.run_bench)
 
     p = sub.add_parser("sort", help="sort a CSV file by slice for import")

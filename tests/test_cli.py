@@ -203,6 +203,38 @@ class TestCLI:
         )
         assert "op/sec" in capsys.readouterr().out
 
+    def test_bench_query_ops(self, server, capsys):
+        """The BASELINE query configs run through the bench CLI:
+        intersect-count (configs[1]) and topn (configs[2]) report p50/p95
+        against live data."""
+        assert (
+            main(
+                ["bench", "--host", server.host, "-i", "i", "-f", "f",
+                 "-n", "30"]
+            )
+            == 0
+        )
+        capsys.readouterr()
+        assert (
+            main(
+                ["bench", "--host", server.host, "-i", "i", "-f", "f",
+                 "-o", "intersect-count", "-n", "3", "--row1", "1",
+                 "--row2", "2"]
+            )
+            == 0
+        )
+        out = capsys.readouterr().out
+        assert "intersect-count: 3 queries, p50" in out
+        assert (
+            main(
+                ["bench", "--host", server.host, "-i", "i", "-f", "f",
+                 "-o", "topn", "-n", "3", "--topn-n", "5"]
+            )
+            == 0
+        )
+        out = capsys.readouterr().out
+        assert "topn: 3 queries, p50" in out and "pairs" in out
+
     def test_server_dry_run(self, tmp_path, capsys):
         assert (
             main(
