@@ -488,6 +488,12 @@ class Executor:
             )
             return int(sum(int(res[p]) for p in ent["pos_of"].values()))
 
+        # Single device: same limb total-count program, no collective —
+        # 8 bytes home instead of a per-slice partial vector (zero pad
+        # slices contribute nothing).
+        if len(kept_slices) <= plan.MAX_ONDEVICE_COUNT_PARTIALS:
+            limbs = plan.compiled_total_count(ent["expr"])(ent["batch"])
+            return plan.recombine_count_limbs(jax.device_get(limbs))
         res = plan.compiled_batched(ent["expr"], "count")(ent["batch"])
         res = jax.device_get(res)
         return sum(int(res[p]) for p in ent["pos_of"].values())

@@ -148,10 +148,12 @@ def compiled_batched(expr: tuple, reduce: str, fused: bool | None = None):
 MAX_ONDEVICE_COUNT_PARTIALS = 1 << 15
 
 
-def compiled_total_count(expr: tuple, mesh):
+def compiled_total_count(expr: tuple, mesh=None):
     """Count(tree) reduced to one replicated int32[2] = (hi, lo) limb
     pair on-device; total = (hi << 16) + lo, recombined by the caller
-    (recombine_count_limbs).
+    (recombine_count_limbs).  ``mesh=None`` compiles the single-device
+    variant: same limb math, no collective — only 8 bytes return to the
+    host instead of a per-slice partial vector.
 
     Input: uint32[n_slices, n_leaves, *rest, words] sharded P(slices,
     None, ...) over ``mesh``.  The word axis reduces first — every
@@ -183,10 +185,6 @@ def recombine_count_limbs(limbs):
 
 @functools.lru_cache(maxsize=512)
 def _compiled_total_count(expr: tuple, mesh):
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    rep = NamedSharding(mesh, P())
-
     def fn(batch):
         out = _eval_expr(expr, batch.swapaxes(0, 1))
         # Word axis first: each partial <= 2^20 bits, int32-exact.
@@ -197,7 +195,11 @@ def _compiled_total_count(expr: tuple, mesh):
         hi = jnp.sum(partials >> 16)
         return jnp.stack([hi, lo])
 
-    return jax.jit(fn, out_shardings=rep)
+    if mesh is None:
+        return jax.jit(fn)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return jax.jit(fn, out_shardings=NamedSharding(mesh, P()))
 
 
 @functools.lru_cache(maxsize=512)
