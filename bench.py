@@ -38,7 +38,7 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def wait_for_backend(attempts: int = 8, delay_s: float = 60.0) -> None:
+def wait_for_backend(attempts: int = 14, delay_s: float = 60.0) -> None:
     """Probe accelerator init in SUBPROCESSES until one succeeds.
 
     The axon TPU tunnel can be wedged for many minutes after an earlier
