@@ -385,29 +385,37 @@ def _words_count(words: np.ndarray) -> int:
 
 def info(data: bytes) -> BitmapInfo:
     """Container stats + op count for ``inspect`` (reference:
-    roaring.Bitmap.Info, roaring/roaring.go:669-683, ctl/inspect.go)."""
-    containers, ops_offset, infos = _decode_containers(data)
-    op_n = _apply_ops(containers, data, ops_offset)
+    roaring.Bitmap.Info, roaring/roaring.go:669-683, ctl/inspect.go).
+    Runs on the tiered parse — array containers are never materialized,
+    so tall-sparse files inspect in O(file size)."""
+    words, arrays, ops_offset, infos = _decode_containers_tiered(data)
+    op_n = sum(1 for _ in _iter_ops(data, ops_offset))
     return BitmapInfo(ops=op_n, containers=infos)
 
 
 def check(data: bytes) -> list[str]:
     """Consistency check (reference: roaring.Bitmap.Check,
     roaring/roaring.go:686-706, driven by ctl/check.go).  Returns a list
-    of problem strings, empty when healthy."""
+    of problem strings, empty when healthy.  Array containers are
+    validated during the tiered parse (range + sortedness, and their
+    header n IS their length); bitmap containers verify n against the
+    actual popcount; the op-log replays through the shared record
+    parser."""
     errs: list[str] = []
     try:
-        containers, ops_offset, infos = _decode_containers(data)
+        words, arrays, ops_offset, infos = _decode_containers_tiered(data)
     except CorruptError as e:
         return [str(e)]
     for ci in infos:
-        actual = _words_count(containers[ci.key])
-        if ci.n != actual:
-            errs.append(
-                f"container key={ci.key} count mismatch: n={ci.n}, count={actual}"
-            )
+        if ci.type == "bitmap":
+            actual = _words_count(words[ci.key])
+            if ci.n != actual:
+                errs.append(
+                    f"container key={ci.key} count mismatch: n={ci.n}, count={actual}"
+                )
     try:
-        _apply_ops(containers, data, ops_offset)
+        for _ in _iter_ops(data, ops_offset):
+            pass
     except CorruptError as e:
         errs.append(str(e))
     return errs
