@@ -463,14 +463,18 @@ class Fragment:
         return words, arrays
 
     def _row_words_host(self, row_id: int) -> np.ndarray | None:
-        """One row's words on host (copy), whichever tier holds it."""
-        slot = self._slot_of.get(row_id)
-        if slot is not None:
-            return self._plane[slot].copy()
-        offs = self._sparse.get(row_id)
-        if offs is None:
-            return None
-        return bp.np_columns_to_row(offs)
+        """One row's words on host (copy), whichever tier holds it.
+        Takes the fragment lock itself (reentrant) — callers like the
+        executor's host batch assembly read concurrently with writers
+        that replace the plane or migrate rows between tiers."""
+        with self._mu:
+            slot = self._slot_of.get(row_id)
+            if slot is not None:
+                return self._plane[slot].copy()
+            offs = self._sparse.get(row_id)
+            if offs is None:
+                return None
+            return bp.np_columns_to_row(offs)
 
     # ------------------------------------------------------------------
     # reads

@@ -259,18 +259,11 @@ class Executor:
         frag = self.holder.fragment(index, frame, view, slice_i)
         return frag, id_
 
-    def _range_row_device(self, index: str, c: Call, slice_i: int):
-        """Union of rows across time views (reference: executor.go:507-589)."""
-        frame = c.args.get("frame") or DEFAULT_FRAME
-        idx = self.holder.index(index)
-        if idx is None:
-            raise IndexNotFoundError()
+    def _resolve_range(self, idx, f, c: Call):
+        """Shared Range() argument resolution for the device and host
+        row paths: (view_name, id, start, end, quantum)."""
         column_label = idx.column_label
-        f = idx.frame(frame)
-        if f is None:
-            raise FrameNotFoundError()
         row_label = f.row_label
-
         col_id, col_ok = _uint_arg(c, column_label)
         row_id, row_ok = _uint_arg(c, row_label)
         if col_ok and row_ok:
@@ -282,10 +275,18 @@ class Executor:
                 f'Range() must specify either "{column_label}" or "{row_label}"'
             )
         view_name, id_ = (VIEW_INVERSE, col_id) if col_ok else (VIEW_STANDARD, row_id)
+        return view_name, id_, _time_arg(c, "start"), _time_arg(c, "end"), f.time_quantum
 
-        start = _time_arg(c, "start")
-        end = _time_arg(c, "end")
-        quantum = f.time_quantum
+    def _range_row_device(self, index: str, c: Call, slice_i: int):
+        """Union of rows across time views (reference: executor.go:507-589)."""
+        frame = c.args.get("frame") or DEFAULT_FRAME
+        idx = self.holder.index(index)
+        if idx is None:
+            raise IndexNotFoundError()
+        f = idx.frame(frame)
+        if f is None:
+            raise FrameNotFoundError()
+        view_name, id_, start, end, quantum = self._resolve_range(idx, f, c)
         if not quantum:
             return None
 
@@ -299,6 +300,81 @@ class Executor:
                 continue
             acc = row if acc is None else (acc | row)
         return acc
+
+    def _leaf_row_host(self, index: str, c: Call, slice_i: int):
+        """Host-side (numpy) variant of _leaf_row_device: one leaf row's
+        words, or None when the row has no bits."""
+        if c.name == "Bitmap":
+            frag, row_id = self._resolve_bitmap_leaf(index, c, slice_i)
+            if frag is None:
+                return None
+            return frag._row_words_host(row_id)
+        if c.name == "Range":
+            return self._range_row_host(index, c, slice_i)
+        raise plan.PlanError(f"unknown call: {c.name}")
+
+    def _range_row_host(self, index: str, c: Call, slice_i: int):
+        frame = c.args.get("frame") or DEFAULT_FRAME
+        idx = self.holder.index(index)
+        if idx is None:
+            raise IndexNotFoundError()
+        f = idx.frame(frame)
+        if f is None:
+            raise FrameNotFoundError()
+        view_name, id_, start, end, quantum = self._resolve_range(idx, f, c)
+        if not quantum:
+            return None
+        acc = None
+        for view in tq.views_by_time_range(view_name, start, end, quantum):
+            frag = self.holder.fragment(index, frame, view, slice_i)
+            if frag is None:
+                continue
+            row = frag._row_words_host(id_)
+            if row is None:
+                continue
+            acc = row if acc is None else (acc | row)
+        return acc
+
+    def _assemble_host_batch(self, index: str, leaves, slices: list[int]):
+        """Assemble the single-device batch HOST-SIDE: one numpy fill
+        plus ONE device transfer, instead of ~2 device dispatches per
+        (slice, leaf) — at bench scale (954 slices) the dispatch-per-leaf
+        cold path costs thousands of round trips, which a remote-tunnel
+        TPU amplifies badly.  The host plane is authoritative, so this
+        is always coherent.  Returns (batch, kept, empties)."""
+        n_leaves = len(leaves)
+        rows_buf = np.zeros(
+            (len(slices), n_leaves, bp.WORDS_PER_SLICE), dtype=np.uint32
+        )
+        kept: list[int] = []
+        empties: list[int] = []
+        i = 0
+        for s in slices:
+            any_set = False
+            for j, leaf in enumerate(leaves):
+                w = self._leaf_row_host(index, leaf, s)
+                if w is not None:
+                    rows_buf[i, j] = w
+                    any_set = True
+            if not leaves or not any_set:
+                # an empty slice writes nothing, so position i stays
+                # zero-initialized for the next kept slice
+                empties.append(s)
+            else:
+                kept.append(s)
+                i += 1
+        if not kept:
+            return None, kept, empties
+        bucket = 1 << (len(kept) - 1).bit_length()
+        if bucket <= rows_buf.shape[0]:
+            # positions past the last kept slice were never written
+            batch_np = rows_buf[:bucket]
+        else:
+            batch_np = np.zeros(
+                (bucket, n_leaves, bp.WORDS_PER_SLICE), dtype=np.uint32
+            )
+            batch_np[: len(kept)] = rows_buf[: len(kept)]
+        return jnp.asarray(batch_np), kept, empties
 
     def _gather_leaf_stacks(self, index: str, c: Call, slices: list[int]):
         """Fetch every slice's leaf rows onto its home device.
@@ -372,35 +448,40 @@ class Executor:
         versions = (
             self._leaf_versions(index, leaves, slices) if cacheable else None
         )
-        expr, stacks, kept_slices, empties = self._gather_leaf_stacks(
-            index, c, slices
-        )
+        mesh = pmesh.default_slices_mesh()
         ent = {
-            "expr": expr,
-            "empties": empties,
-            "kept": kept_slices,
             "batch": None,
             "pos_of": {},
             "mesh": None,
             "epoch": epoch,
             "versions": versions,
         }
-        if kept_slices:
-            mesh = pmesh.default_slices_mesh()
-            if mesh is not None and len(kept_slices) > 1:
+        if mesh is None:
+            # Single device: assemble HOST-side (one numpy fill + one
+            # transfer; the slice axis pads to a power of two — one
+            # compiled program per (tree shape, bucket), SURVEY.md §7
+            # shape bucketing).
+            batch, kept_slices, empties = self._assemble_host_batch(
+                index, leaves, slices
+            )
+            ent.update(
+                expr=expr,
+                empties=empties,
+                kept=kept_slices,
+                batch=batch,
+                pos_of={s: i for i, s in enumerate(kept_slices)},
+            )
+        else:
+            expr, stacks, kept_slices, empties = self._gather_leaf_stacks(
+                index, c, slices
+            )
+            ent.update(expr=expr, empties=empties, kept=kept_slices)
+            if len(kept_slices) > 1:
                 batch, pos_of = self._assemble_mesh_batch(
                     stacks, kept_slices, mesh
                 )
                 ent.update(batch=batch, pos_of=pos_of, mesh=mesh)
-            else:
-                # Single device: pad the slice axis to a power of two —
-                # one compiled program per (tree shape, bucket) instead
-                # of per slice count (SURVEY.md §7 shape bucketing).
-                n = len(stacks)
-                bucket = 1 << (n - 1).bit_length()
-                if bucket != n:
-                    pad = jnp.zeros_like(stacks[0])
-                    stacks = stacks + [pad] * (bucket - n)
+            elif kept_slices:
                 ent.update(
                     batch=jnp.stack(stacks),
                     pos_of={s: i for i, s in enumerate(kept_slices)},
