@@ -146,11 +146,16 @@ def main() -> None:
     plain_s = time_variant("plain-XLA", plan.compiled_batched(expr, "count", fused=False))
     variants = {"plain-XLA": plain_s}
     if jax.default_backend() == "tpu":
-        variants["fused-pallas"] = time_variant(
-            "fused-pallas", plan.compiled_batched(expr, "count", fused=True)
-        )
-        ratio = plain_s / variants["fused-pallas"]
-        log(f"fused-pallas vs plain-XLA speedup: {ratio:.3f}x")
+        try:
+            variants["fused-pallas"] = time_variant(
+                "fused-pallas", plan.compiled_batched(expr, "count", fused=True)
+            )
+            ratio = plain_s / variants["fused-pallas"]
+            log(f"fused-pallas vs plain-XLA speedup: {ratio:.3f}x")
+        except Exception as e:  # noqa: BLE001 — optional variant must
+            # never sink the bench (e.g. a Mosaic layout rejection of
+            # the opt-in kernels on some TPU generation)
+            log(f"fused-pallas variant failed: {e!r:.300}")
     best = min(variants, key=variants.get)
     dev_s = variants[best]
     log(f"raw-kernel best variant: {best}")
