@@ -1,0 +1,169 @@
+"""TPU perf probe: find the bandwidth-bound formulation of Intersect+Count.
+
+Run on a real TPU (plain `python tools/perf_probe.py`, one process at a
+time through the axon tunnel).  Times 8+ formulations of the headline
+AND+popcount reduce on identical data — plain XLA shapes, manual SWAR,
+MXU-dot reduce, and Pallas variants — so the blessed `ops/bitplane.py`
+formulation is chosen by measurement.
+
+Workload: 954 slices x 2 rows x 32768 u32 words (250 MB total operands).
+v5e HBM ~819 GB/s => floor ~0.305 ms. r02 plain-XLA: 1.91 ms (131 GB/s).
+"""
+import functools
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _interp():
+    return jax.default_backend() != "tpu"
+
+N_SLICES = 954
+WORDS = 32768
+
+def bench(name, fn, *args, iters=20):
+    out = jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    s = (time.perf_counter() - t0) / iters
+    gbps = (N_SLICES * 2 * WORDS * 4) / s / 1e9
+    print(f"{name:40s} {s*1e3:7.3f} ms  {gbps:7.1f} GB/s", flush=True)
+    return out, s
+
+def main():
+    print(f"backend={jax.default_backend()} devices={jax.devices()}", flush=True)
+    rng = np.random.default_rng(7)
+    leaves = rng.integers(0, 2**32, size=(N_SLICES, 2, WORDS), dtype=np.uint32)
+    host = int(np.bitwise_count(leaves[:, 0] & leaves[:, 1]).sum())
+    dev = jnp.asarray(leaves)
+    A = jnp.asarray(np.ascontiguousarray(leaves[:, 0]))
+    B = jnp.asarray(np.ascontiguousarray(leaves[:, 1]))
+    jax.block_until_ready((dev, A, B))
+
+    # 1. current plain-XLA shape: vmap over slices, per-slice scalar
+    @jax.jit
+    def v1(batch):
+        return jax.vmap(lambda l: jnp.sum(jax.lax.population_count(l[0] & l[1]).astype(jnp.int32)))(batch)
+    out, _ = bench("v1 vmap per-slice scalars", v1, dev)
+    assert int(np.asarray(out, np.int64).sum()) == host
+
+    # 2. one flat scalar reduce
+    @jax.jit
+    def v2(a, b):
+        return jnp.sum(jax.lax.population_count(a & b).astype(jnp.int32), dtype=jnp.int64)
+    out, _ = bench("v2 flat scalar (separate A,B)", v2, A, B)
+    assert int(out) == host
+
+    # 2b. flat scalar from the interleaved batch
+    @jax.jit
+    def v2b(batch):
+        return jnp.sum(jax.lax.population_count(batch[:, 0] & batch[:, 1]).astype(jnp.int32), dtype=jnp.int64)
+    out, _ = bench("v2b flat scalar (batch slice)", v2b, dev)
+    assert int(out) == host
+
+    # 3. no popcount — pure bandwidth ceiling probe (xor+sum, wrong answer)
+    @jax.jit
+    def v3(a, b):
+        return jnp.sum((a ^ b).astype(jnp.uint32))
+    bench("v3 xor+sum (no popcount)", v3, A, B)
+
+    # 3b. pure read: sum of A only (125 MB)
+    @jax.jit
+    def v3b(a):
+        return jnp.sum(a)
+    _, s = bench("v3b sum(A) only (125MB)", v3b, A)
+    print(f"    -> one-operand read bw: {N_SLICES*WORDS*4/s/1e9:.1f} GB/s", flush=True)
+
+    # 4. manual SWAR popcount
+    def swar(v):
+        v = v - ((v >> 1) & jnp.uint32(0x55555555))
+        v = (v & jnp.uint32(0x33333333)) + ((v >> 2) & jnp.uint32(0x33333333))
+        v = (v + (v >> 4)) & jnp.uint32(0x0F0F0F0F)
+        return (v * jnp.uint32(0x01010101)) >> 24
+    @jax.jit
+    def v4(a, b):
+        return jnp.sum(swar(a & b).astype(jnp.int32), dtype=jnp.int64)
+    out, _ = bench("v4 manual SWAR popcount", v4, A, B)
+    assert int(out) == host
+
+    # 5. two-stage: per-row int32 partials then jnp.sum
+    @jax.jit
+    def v5(a, b):
+        p = jnp.sum(jax.lax.population_count(a & b).astype(jnp.int32), axis=-1)
+        return jnp.sum(p, dtype=jnp.int64)
+    out, _ = bench("v5 two-stage row partials", v5, A, B)
+    assert int(out) == host
+
+    # 6. MXU reduce: popcount -> bf16, dot with ones
+    @jax.jit
+    def v6(a, b):
+        p = jax.lax.population_count(a & b).astype(jnp.bfloat16)
+        ones = jnp.ones((WORDS,), jnp.bfloat16)
+        return jax.lax.dot_general(p, ones, (((1,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+    out, _ = bench("v6 popcount+MXU-dot reduce", v6, A, B)
+    assert int(np.asarray(out, np.float64).sum()) == host
+
+    # 7. pallas: per-row-chunk partials to VMEM vector out, 8 rows/step
+    R = 8
+    def k7(a_ref, b_ref, o_ref):
+        w = a_ref[:] & b_ref[:]
+        o_ref[:] = jnp.sum(jax.lax.population_count(w).astype(jnp.int32),
+                           axis=-1)
+    @jax.jit
+    def v7(a, b):
+        n = a.shape[0]
+        part = pl.pallas_call(
+            k7,
+            grid=(n // R,),
+            in_specs=[pl.BlockSpec((R, WORDS), lambda i: (i, 0)),
+                      pl.BlockSpec((R, WORDS), lambda i: (i, 0))],
+            out_specs=pl.BlockSpec((R,), lambda i: (i,)),
+            out_shape=jax.ShapeDtypeStruct((n,), jnp.int32),
+            interpret=_interp(),
+        )(a, b)
+        return jnp.sum(part, dtype=jnp.int64)
+    n7 = (N_SLICES // R) * R  # truncate to a whole number of chunks
+    A8, B8 = A[:n7], B[:n7]
+    host8 = int(np.bitwise_count(leaves[:n7, 0] & leaves[:n7, 1]).sum())
+    out, s = bench(f"v7 pallas {R}-row partials->VMEM", v7, A8, B8)
+    print(f"    (bw adj for {n7}/{N_SLICES}: {n7*2*WORDS*4/s/1e9:.1f} GB/s)", flush=True)
+    assert int(out) == host8, (int(out), host8)
+
+    # 8. pallas: 2D block over (rows, words), partial per tile, XLA sums
+    RT, CT = 16, 8192
+    def k8(a_ref, b_ref, o_ref):
+        w = a_ref[:] & b_ref[:]
+        o_ref[0, 0] = jnp.sum(jax.lax.population_count(w).astype(jnp.int32))
+    @jax.jit
+    def v8(a, b):
+        n = a.shape[0]
+        part = pl.pallas_call(
+            k8,
+            grid=(n // RT, WORDS // CT),
+            in_specs=[pl.BlockSpec((RT, CT), lambda i, j: (i, j)),
+                      pl.BlockSpec((RT, CT), lambda i, j: (i, j))],
+            out_specs=pl.BlockSpec((1, 1), lambda i, j: (i, j),
+                                   memory_space=pltpu.SMEM),
+            out_shape=jax.ShapeDtypeStruct((n // RT, WORDS // CT), jnp.int32),
+            interpret=_interp(),
+        )(a, b)
+        return jnp.sum(part, dtype=jnp.int64)
+    n8 = (N_SLICES // RT) * RT
+    if n8:
+        A16, B16 = A[:n8], B[:n8]
+        host16 = int(np.bitwise_count(leaves[:n8, 0] & leaves[:n8, 1]).sum())
+        out, _ = bench("v8 pallas 2D tile SMEM partials", v8, A16, B16)
+        assert int(out) == host16, (int(out), host16)
+
+    print("host count:", host, flush=True)
+
+if __name__ == "__main__":
+    main()
