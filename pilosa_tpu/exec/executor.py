@@ -131,6 +131,8 @@ class Executor:
         # concurrent HTTP request threads, so access is lock-guarded.
         self._batch_cache: "OrderedDict[tuple, dict]" = OrderedDict()
         self._batch_mu = threading.Lock()
+        # slice->node grouping LRU (see _slices_by_node).
+        self._slice_group_cache: "OrderedDict[tuple, dict]" = OrderedDict()
 
     def close(self) -> None:
         self._pool.shutdown(wait=False, cancel_futures=True)
@@ -952,6 +954,17 @@ class Executor:
     def _slices_by_node(
         self, nodes: list[Node], index: str, slices: list[int]
     ) -> dict[str, tuple[Node, list[int]]]:
+        """Group slices by owning node, CACHED per (node set, index,
+        slice list): placement is pure in those inputs (fnv + jump hash,
+        reference: cluster.go:202-244), and at bench scale re-hashing
+        ~1000 slices per query costs more host time than the compiled
+        query program.  Callers treat the result as read-only."""
+        key = (tuple(n.host for n in nodes), index, tuple(slices))
+        with self._batch_mu:
+            hit = self._slice_group_cache.get(key)
+            if hit is not None:
+                self._slice_group_cache.move_to_end(key)
+                return hit
         m: dict[str, tuple[Node, list[int]]] = {}
         node_hosts = {n.host for n in nodes}
         for s in slices:
@@ -961,6 +974,10 @@ class Executor:
                     break
             else:
                 raise SliceUnavailableError()
+        with self._batch_mu:
+            self._slice_group_cache[key] = m
+            while len(self._slice_group_cache) > 8:
+                self._slice_group_cache.popitem(last=False)
         return m
 
     def _map_reduce(self, index, slices, c, opt, map_fn, reduce_fn):
