@@ -434,3 +434,43 @@ def test_batch_cache_invalidated_by_frame_delete(ex, holder):
         q(ex, "i", pql)
     holder.index("i").create_frame("f")
     assert q(ex, "i", pql) == [0]
+
+
+def test_concurrent_queries_and_writes(ex, holder):
+    """Smoke: concurrent queries and writes through one executor (the
+    HTTP server is threaded) never crash on the cache paths, and the
+    final count is exact."""
+    import threading
+
+    must_set_bits(holder, "i", "f", [(1, c) for c in range(50)])
+    must_set_bits(holder, "i", "f", [(2, c) for c in range(0, 50, 2)])
+    errors = []
+
+    def reader():
+        try:
+            for _ in range(40):
+                (n,) = q(ex, "i",
+                         "Count(Intersect(Bitmap(rowID=1, frame=f),"
+                         " Bitmap(rowID=2, frame=f)))")
+                assert n >= 25
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    def writer(base):
+        try:
+            for c in range(base, base + 40):
+                q(ex, "i", f"SetBit(frame=f, rowID=1, columnID={c})")
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=reader) for _ in range(3)] + [
+        threading.Thread(target=writer, args=(100,)),
+        threading.Thread(target=writer, args=(200,)),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    (n,) = q(ex, "i", "Count(Bitmap(rowID=1, frame=f))")
+    assert n == 50 + 80
