@@ -113,6 +113,30 @@ def lib() -> ctypes.CDLL | None:
             ctypes.POINTER(ctypes.c_uint64),
             ctypes.c_int64,
         ]
+        l.ptpu_decode_tiered.restype = ctypes.c_void_p
+        l.ptpu_decode_tiered.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+        l.ptpu_t_error.restype = ctypes.c_char_p
+        l.ptpu_t_error.argtypes = [ctypes.c_void_p]
+        l.ptpu_t_ops.restype = ctypes.c_int64
+        l.ptpu_t_ops.argtypes = [ctypes.c_void_p]
+        l.ptpu_t_counts.restype = None
+        l.ptpu_t_counts.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        l.ptpu_t_extract.restype = None
+        l.ptpu_t_extract.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_uint32),
+        ]
+        l.ptpu_t_free.restype = None
+        l.ptpu_t_free.argtypes = [ctypes.c_void_p]
         _lib = l
         return _lib
 
@@ -156,6 +180,52 @@ def decode(data: bytes):
         return containers, int(ops)
     finally:
         l.ptpu_free(h)
+
+
+def decode_tiered(data: bytes):
+    """Roaring file -> ({key: uint64[1024]}, {key: sorted uint32 values},
+    op_count) or None.  Array containers never materialize to words —
+    the tall-sparse loading path (see ops/roaring.decode_tiered)."""
+    l = lib()
+    if l is None:
+        return None
+    h = l.ptpu_decode_tiered(data, len(data))
+    try:
+        err = l.ptpu_t_error(h)
+        if err is not None:
+            raise NativeCorruptError(err.decode())
+        nw = ctypes.c_int64()
+        na = ctypes.c_int64()
+        tv = ctypes.c_int64()
+        l.ptpu_t_counts(
+            h, ctypes.byref(nw), ctypes.byref(na), ctypes.byref(tv)
+        )
+        nw, na, tv = nw.value, na.value, tv.value
+        ops = l.ptpu_t_ops(h)
+        wkeys = np.zeros(nw, dtype=np.uint64)
+        wwords = np.zeros(nw * 1024, dtype=np.uint64)
+        akeys = np.zeros(na, dtype=np.uint64)
+        alens = np.zeros(na, dtype=np.int64)
+        avals = np.zeros(tv, dtype=np.uint32)
+        if nw or na:
+            l.ptpu_t_extract(
+                h,
+                wkeys.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                wwords.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                akeys.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                alens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                avals.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            )
+        words = {
+            int(wkeys[i]): wwords[i * 1024 : (i + 1) * 1024] for i in range(nw)
+        }
+        bounds = np.concatenate(([0], np.cumsum(alens))).astype(np.int64)
+        arrays = {
+            int(akeys[i]): avals[bounds[i] : bounds[i + 1]] for i in range(na)
+        }
+        return words, arrays, int(ops)
+    finally:
+        l.ptpu_t_free(h)
 
 
 def encode(containers: dict[int, np.ndarray]) -> bytes | None:

@@ -15,6 +15,7 @@
 // ABI: plain C functions over caller-owned buffers + one opaque handle
 // for decode results (ctypes-friendly; no pybind11 dependency).
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
 #include <map>
@@ -169,6 +170,162 @@ const char* ptpu_error(void* h) {
   auto* bm = static_cast<Bitmap*>(h);
   return bm->error.empty() ? nullptr : bm->error.c_str();
 }
+
+// ---------------------------------------------------------------------------
+// tiered decode: array containers stay as sorted value vectors (pay-per-bit;
+// a tall-sparse file has one array container per row), bitmap containers as
+// word vectors.  Mirrors ops/roaring.decode_tiered.
+// ---------------------------------------------------------------------------
+
+struct Tiered {
+  std::map<uint64_t, std::vector<uint64_t>> words;
+  std::map<uint64_t, std::vector<uint32_t>> arrays;
+  int64_t ops = 0;
+  int64_t total_vals = 0;
+  std::string error;
+};
+
+void* ptpu_decode_tiered(const uint8_t* data, int64_t len) {
+  auto* t = new Tiered();
+  if (len < kHeaderSize) {
+    t->error = "data too small";
+    return t;
+  }
+  uint32_t cookie = rd32(data);
+  uint32_t key_n = rd32(data + 4);
+  if (cookie != kCookie) {
+    t->error = "invalid roaring file";
+    return t;
+  }
+  if (kHeaderSize + (int64_t)key_n * 16 > len) {
+    t->error = "header claims " + std::to_string(key_n) +
+               " containers but file is " + std::to_string(len) + " bytes";
+    return t;
+  }
+  const uint8_t* headers = data + kHeaderSize;
+  const uint8_t* offsets = headers + (int64_t)key_n * 12;
+  int64_t ops_offset = kHeaderSize + (int64_t)key_n * 16;
+  for (uint32_t i = 0; i < key_n; i++) {
+    uint64_t key = rd64(headers + (int64_t)i * 12);
+    int64_t n = (int64_t)rd32(headers + (int64_t)i * 12 + 8) + 1;
+    uint32_t offset = rd32(offsets + (int64_t)i * 4);
+    if ((int64_t)offset >= len) {
+      t->error = "offset out of bounds";
+      return t;
+    }
+    int64_t payload = (n <= kArrayMaxSize) ? n * 4 : kContainerWords * 8;
+    if ((int64_t)offset + payload > len) {
+      t->error = "container payload out of bounds";
+      return t;
+    }
+    if (n <= kArrayMaxSize) {
+      std::vector<uint32_t> vals((size_t)n);
+      std::memcpy(vals.data(), data + offset, (size_t)n * 4);
+      uint32_t prev = 0;
+      for (int64_t j = 0; j < n; j++) {
+        uint32_t v = vals[(size_t)j];
+        if (v >= kContainerBits) {
+          t->error = "array value out of range";
+          return t;
+        }
+        if (j > 0 && v <= prev) {
+          t->error = "array container is not sorted/unique";
+          return t;
+        }
+        prev = v;
+      }
+      t->total_vals += n;
+      t->arrays[key] = std::move(vals);
+    } else {
+      std::vector<uint64_t> words(kContainerWords);
+      std::memcpy(words.data(), data + offset, kContainerWords * 8);
+      t->words[key] = std::move(words);
+    }
+    int64_t end = (int64_t)offset + payload;
+    if (end > ops_offset) ops_offset = end;
+  }
+
+  // op-log replay over tiered forms
+  int64_t pos = ops_offset;
+  while (pos < len) {
+    if (len - pos < kOpSize) {
+      t->error = "op data out of bounds";
+      return t;
+    }
+    uint8_t typ = data[pos];
+    uint64_t value = rd64(data + pos + 1);
+    uint32_t chk = rd32(data + pos + 9);
+    if (chk != fnv1a32(data + pos, 9)) {
+      t->error = "checksum mismatch";
+      return t;
+    }
+    if (typ > 1) {
+      t->error = "invalid op type";
+      return t;
+    }
+    uint64_t key = value >> 16;
+    uint32_t low = (uint32_t)(value & 0xFFFF);
+    auto wit = t->words.find(key);
+    if (wit != t->words.end()) {
+      uint64_t mask = (uint64_t)1 << (low & 63);
+      if (typ == 0)
+        wit->second[low >> 6] |= mask;
+      else
+        wit->second[low >> 6] &= ~mask;
+    } else {
+      auto& vals = t->arrays[key];  // creates empty on first touch
+      auto it = std::lower_bound(vals.begin(), vals.end(), low);
+      bool present = it != vals.end() && *it == low;
+      if (typ == 0 && !present) {
+        vals.insert(it, low);
+        t->total_vals++;
+      } else if (typ == 1 && present) {
+        vals.erase(it);
+        t->total_vals--;
+      }
+    }
+    pos += kOpSize;
+    t->ops++;
+  }
+  return t;
+}
+
+const char* ptpu_t_error(void* h) {
+  auto* t = static_cast<Tiered*>(h);
+  return t->error.empty() ? nullptr : t->error.c_str();
+}
+
+int64_t ptpu_t_ops(void* h) { return static_cast<Tiered*>(h)->ops; }
+
+void ptpu_t_counts(void* h, int64_t* n_words, int64_t* n_arrays,
+                   int64_t* total_vals) {
+  auto* t = static_cast<Tiered*>(h);
+  *n_words = (int64_t)t->words.size();
+  *n_arrays = (int64_t)t->arrays.size();
+  *total_vals = t->total_vals;
+}
+
+// Fill wkeys[nw], wwords[nw*1024], akeys[na], alens[na], avals[total].
+void ptpu_t_extract(void* h, uint64_t* wkeys, uint64_t* wwords, uint64_t* akeys,
+                    int64_t* alens, uint32_t* avals) {
+  auto* t = static_cast<Tiered*>(h);
+  int64_t i = 0;
+  for (const auto& [key, w] : t->words) {
+    wkeys[i] = key;
+    std::memcpy(wwords + i * kContainerWords, w.data(), kContainerWords * 8);
+    i++;
+  }
+  int64_t j = 0, at = 0;
+  for (const auto& [key, vals] : t->arrays) {
+    akeys[j] = key;
+    alens[j] = (int64_t)vals.size();
+    std::memcpy(avals + at, vals.data(), vals.size() * 4);
+    at += (int64_t)vals.size();
+    j++;
+  }
+}
+
+void ptpu_t_free(void* h) { delete static_cast<Tiered*>(h); }
 
 int64_t ptpu_nkeys(void* h) {
   return (int64_t)static_cast<Bitmap*>(h)->containers.size();

@@ -103,13 +103,6 @@ def decode_with_ops(data: bytes) -> tuple[dict[int, np.ndarray], int]:
     return containers, op_n
 
 
-# Below this many containers, full materialization costs at most
-# ~64 MiB and the compiled C++ codec beats the pure-Python loop; past
-# it (tall-sparse files: one array container per row) staying in value
-# form wins on both memory and time.
-_NATIVE_DECODE_MAX_CONTAINERS = 8192
-
-
 def decode_tiered(
     data: bytes,
 ) -> tuple[dict[int, np.ndarray], dict[int, np.ndarray], int]:
@@ -122,21 +115,16 @@ def decode_tiered(
     cost rows x 8 KiB (reference keeps the same two forms in memory,
     roaring/roaring.go:893-906).
 
-    Small (dense-file) inputs dispatch to the C++ codec and return
-    words-form containers; the pure-Python value-form path serves the
-    tall-sparse case the native materializing decoder would hurt."""
+    Dispatches to the C++ tiered decoder when available; the pure-Python
+    path below is the fallback and parity oracle."""
     from pilosa_tpu import native
 
-    if len(data) >= HEADER_SIZE and native.available():
-        (_, key_n) = struct.unpack_from("<II", data, 0)
-        if key_n <= _NATIVE_DECODE_MAX_CONTAINERS:
-            try:
-                res = native.decode(data)
-            except native.NativeCorruptError as e:
-                raise CorruptError(str(e)) from e
-            if res is not None:
-                containers, op_n = res
-                return containers, {}, op_n
+    try:
+        res = native.decode_tiered(data)
+    except native.NativeCorruptError as e:
+        raise CorruptError(str(e)) from e
+    if res is not None:
+        return res
     words, arrays, ops_offset, _ = _decode_containers_tiered(data)
     op_n = _apply_ops_tiered(words, arrays, data, ops_offset)
     return words, arrays, op_n

@@ -72,6 +72,23 @@ def test_native_decode_matches_expected(name):
 
 
 @pytest.mark.parametrize("name", FIXTURES)
+def test_native_tiered_decode_matches_expected(name):
+    if not native.available():
+        pytest.skip("native codec not built")
+    res = native.decode_tiered(load(name))
+    assert res is not None
+    words, arrays, op_n = res
+    bits = []
+    for key, w in words.items():
+        vals = roaring.words_to_values(w)
+        bits.extend(int(key) * roaring.CONTAINER_BITS + int(v) for v in vals)
+    for key, vals in arrays.items():
+        bits.extend(int(key) * roaring.CONTAINER_BITS + int(v) for v in vals)
+    assert sorted(bits) == EXPECTED[name]["bits"]
+    assert op_n == EXPECTED[name]["ops"]
+
+
+@pytest.mark.parametrize("name", FIXTURES)
 def test_check_and_info_accept(name):
     data = load(name)
     assert roaring.check(data) == []
