@@ -727,9 +727,22 @@ class Executor:
         self, index: str, c: Call, slices: list[int], opt: ExecOptions
     ) -> list[Pair]:
         def map_fn(local_slices: list[int]):
+            # The src bitmap (if any) evaluates for ALL local slices in
+            # ONE batched program instead of per slice — the per-slice
+            # loop below then only does candidate selection + scoring.
+            src_rows = None
+            if len(c.children) == 1:
+                src_rows = self._eval_tree_slices(
+                    index, c.children[0], local_slices, "row"
+                )
+            elif len(c.children) > 1:
+                raise ExecutorError("TopN() can only have one input bitmap")
             acc: list[Pair] = []
             for s in local_slices:
-                acc = cache_mod.add_pairs(acc, self._execute_topn_slice(index, c, s))
+                acc = cache_mod.add_pairs(
+                    acc,
+                    self._execute_topn_slice(index, c, s, src_rows=src_rows),
+                )
             return acc
 
         def reduce_fn(prev, v):
@@ -738,8 +751,12 @@ class Executor:
         pairs = self._map_reduce(index, slices, c, opt, map_fn, reduce_fn) or []
         return cache_mod.sort_pairs(pairs)
 
-    def _execute_topn_slice(self, index: str, c: Call, slice_i: int) -> list[Pair]:
-        """reference: executor.go:346-415"""
+    def _execute_topn_slice(
+        self, index: str, c: Call, slice_i: int, src_rows=None
+    ) -> list[Pair]:
+        """reference: executor.go:346-415.  ``src_rows`` carries the
+        batch-evaluated src rows from _execute_topn_slices (one program
+        for all local slices)."""
         frame = c.args.get("frame") or DEFAULT_FRAME
         inverse = bool(c.args.get("inverse", False))
         n = _uint_arg(c, "n")[0]
@@ -750,14 +767,11 @@ class Executor:
         tanimoto = _uint_arg(c, "tanimotoThreshold")[0]
 
         src = None
-        if len(c.children) == 1:
-            rows = self._eval_tree_slices(index, c.children[0], [slice_i], "row")
+        if src_rows is not None:
             src = RowBitmap()
-            row = rows.get(slice_i)
+            row = src_rows.get(slice_i)
             if row is not None:
                 src.set_segment(slice_i, np.asarray(row))
-        elif len(c.children) > 1:
-            raise ExecutorError("TopN() can only have one input bitmap")
 
         view = VIEW_INVERSE if inverse else VIEW_STANDARD
         f = self.holder.fragment(index, frame, view, slice_i)
