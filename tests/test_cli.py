@@ -669,3 +669,57 @@ class TestGossipCluster:
                     s.close()
                 except Exception:
                     pass
+
+
+class TestTutorialWorkflow:
+    def test_chemical_similarity_tanimoto(self, server, tmp_path):
+        """The reference's chemical-similarity tutorial shape (reference:
+        docs/tutorials.md:333-342): molecule fingerprints imported as
+        rows via the CLI CSV path, then Tanimoto-thresholded TopN over
+        HTTP — validated against a numpy model."""
+        import json as jsonlib
+        import urllib.request
+
+        import numpy as np
+
+        rng = np.random.default_rng(42)
+        n_mol, n_features = 40, 512
+        # each molecule: a random ~25%-dense 512-bit fingerprint
+        fp = rng.random((n_mol, n_features)) < 0.25
+        fp[7] = fp[3]  # a duplicate molecule: tanimoto 100 with #3
+        rows, cols = np.nonzero(fp)
+        csv_path = tmp_path / "mol.csv"
+        with open(csv_path, "w") as fh:
+            for r, c in zip(rows, cols):
+                fh.write(f"{r},{c}\n")
+
+        assert (
+            main(["import", "--host", server.host, "-i", "i", "-f", "f",
+                  str(csv_path)])
+            == 0
+        )
+
+        # TopN(Bitmap(molecule 3), tanimotoThreshold=70) over HTTP
+        q = ("TopN(Bitmap(frame=\"f\", rowID=3), frame=\"f\", n=10,"
+             " tanimotoThreshold=70)")
+        req = urllib.request.Request(
+            f"http://{server.host}/index/i/query", data=q.encode(),
+            method="POST",
+        )
+        with urllib.request.urlopen(req) as resp:
+            results = jsonlib.load(resp)["results"][0]
+        got = {p["id"]: p["count"] for p in results}
+
+        # numpy oracle: ceil(100*|A&B| / (|A|+|B|-|A&B|)) > 70
+        import math
+        want = {}
+        a = fp[3]
+        for m in range(n_mol):
+            inter = int((a & fp[m]).sum())
+            if inter == 0:
+                continue
+            union = int(a.sum()) + int(fp[m].sum()) - inter
+            if math.ceil(100 * inter / union) > 70:
+                want[m] = inter
+        assert want and got == want
+        assert set(want) >= {3, 7}
