@@ -852,6 +852,13 @@ class Fragment:
                     slots = np.asarray(
                         [self._slot_of[i] for i in dense_ids], dtype=np.int32
                     )
+                    # Pad the gather to a full row block (repeating the
+                    # last slot) so the scorer's row count stays on the
+                    # tile-aligned kernel path; surplus scores are
+                    # discarded below.  The gather copies anyway.
+                    padded = bp.pad_rows(len(slots))
+                    if padded != len(slots):
+                        slots = np.pad(slots, (0, padded - len(slots)), mode="edge")
                     sub = self.device_plane()[slots]
                     self._topn_sub[sub_key] = sub
                     while len(self._topn_sub) > 2:
@@ -867,7 +874,7 @@ class Fragment:
                      & np.uint32(1)).sum()
                 )
         if dense_ids:
-            counts = np.asarray(bp.top_counts(sub, src_words))
+            counts = np.asarray(bp.top_counts(sub, src_words))[: len(dense_ids)]
             by_id.update(zip(dense_ids, (int(c) for c in counts)))
 
         results: list[Pair] = []

@@ -7,14 +7,21 @@ popcntAndSliceAsm, popcntOrSliceAsm, popcntXorSliceAsm, popcntMaskSliceAsm
 (reference: roaring/assembly_asm.go:19-87).
 
 A slice-row is 32,768 uint32 words = one (256, 128) tile = 128 KiB per
-operand.  Kernels walk a grid of row-chunks (ROWS_PER_STEP slice-rows
-per step) and emit ONE int32 partial per slice-row into a VMEM vector
-output block indexed by the grid step — every step writes its own
-output slot, so the pipeline never serializes through a shared
-accumulator (the round-2 kernels accumulated into a single SMEM scalar,
-which defeated double-buffering and measured 4x slower than plain XLA).
-The cross-row partial sum happens outside the kernel where XLA fuses it
-for free.
+operand.  Kernels walk a grid of 8-slice-row chunks and emit LANE
+PARTIALS: each step reduces popcount over the sublane axis only and
+writes one (8, 128) int32 block — exactly one native TPU tile — into its
+own output slot, so the pipeline never serializes through a shared
+accumulator and every store is tile-aligned (Mosaic rejects rank-1
+output blocks that are neither full-array nor multiples of 128, which is
+what sank the round-2/3 formulations on real hardware).  The remaining
+lane-axis sum happens outside the kernel where XLA fuses it for free.
+
+Row counts that are not a multiple of 8 fall back to the pure-XLA
+formulation instead of padding: fragment planes are always padded to
+ROW_BLOCK = 8 rows (ops/bitplane.py:44) and query batches bucket to
+powers of two, so the fallback only triggers on small ad-hoc shapes
+where kernel launch overhead dominates anyway, and a pad here would
+copy the full operand through HBM on the hot path.
 
 Everything here is optional: :mod:`pilosa_tpu.ops.bitplane` falls back
 to pure-XLA (jnp) formulations off-TPU or when PILOSA_TPU_DISABLE_PALLAS
@@ -32,19 +39,10 @@ from jax.experimental import pallas as pl
 
 _LANES = 128
 _ROW_SUBLANES = 256  # one slice-row: 256 * 128 = 32768 words
-# Preferred slice-rows per grid step: 2 operands x 4 rows x 128 KiB =
-# 1 MiB of VMEM per buffer set — small enough to double-buffer, large
-# enough to amortize per-step overhead.  The actual step is the largest
-# of (4, 2, 1) dividing the row count, so NO operand is ever padded —
-# a pad would copy the full operand through HBM on the hot path.
-ROWS_PER_STEP = 4
-
-
-def _chunk_for(rows: int) -> int:
-    for c in (ROWS_PER_STEP, 2, 1):
-        if rows % c == 0:
-            return c
-    raise AssertionError("unreachable")
+# Slice-rows per grid step.  8 rows x (256, 128) words is 1 MiB of VMEM
+# per operand buffer — small enough to double-buffer — and makes the
+# (8, 128) int32 output block exactly one native tile.
+_STEP_ROWS = 8
 
 
 def _interpret() -> bool:
@@ -65,6 +63,14 @@ def _combine(op: str, x, y):
     raise ValueError(f"unknown op {op!r}")
 
 
+def _popcount_reduce(w, axis=None):
+    """The pure-XLA popcount+sum used by every rows-not-tile-aligned
+    fallback — ONE definition so the fallbacks cannot drift from each
+    other (the Pallas paths are asserted bit-identical to this in
+    tests/test_kernels.py)."""
+    return jnp.sum(jax.lax.population_count(w).astype(jnp.int32), axis=axis)
+
+
 def _row_tiles(x: jnp.ndarray) -> jnp.ndarray:
     """View a whole-slice-row-multiple word array as slice-row tiles
     (rows, 256, 128)."""
@@ -75,48 +81,45 @@ def _row_tiles(x: jnp.ndarray) -> jnp.ndarray:
     return x.reshape(total // (_ROW_SUBLANES * _LANES), _ROW_SUBLANES, _LANES)
 
 
-def _fused_rows_kernel(op, a_ref, b_ref, o_ref):
+def _fused_lanes_kernel(op, a_ref, b_ref, o_ref):
     w = _combine(op, a_ref[:], b_ref[:])
+    # Reduce the sublane axis only: (8, 256, 128) -> (8, 128) lane
+    # partials, one native int32 tile per grid step.
+    o_ref[:] = jnp.sum(jax.lax.population_count(w).astype(jnp.int32), axis=1)
+
+
+def _count_lanes_kernel(a_ref, o_ref):
     o_ref[:] = jnp.sum(
-        jax.lax.population_count(w).astype(jnp.int32), axis=(1, 2)
+        jax.lax.population_count(a_ref[:]).astype(jnp.int32), axis=1
     )
 
 
-def _count_rows_kernel(a_ref, o_ref):
-    o_ref[:] = jnp.sum(
-        jax.lax.population_count(a_ref[:]).astype(jnp.int32), axis=(1, 2)
-    )
-
-
-def _partials_fused(a_tiles, b_tiles, op: str) -> jnp.ndarray:
-    """int32 partial per slice-row of (a OP b); grid over row chunks,
-    one VMEM output slot per chunk."""
+def _lane_partials_fused(a_tiles, b_tiles, op: str) -> jnp.ndarray:
+    """int32[rows, 128] lane partials of popcount(a OP b); rows % 8 == 0."""
     n = a_tiles.shape[0]
-    step = _chunk_for(n)
     return pl.pallas_call(
-        functools.partial(_fused_rows_kernel, op),
-        grid=(n // step,),
+        functools.partial(_fused_lanes_kernel, op),
+        grid=(n // _STEP_ROWS,),
         in_specs=[
-            pl.BlockSpec((step, _ROW_SUBLANES, _LANES), lambda i: (i, 0, 0)),
-            pl.BlockSpec((step, _ROW_SUBLANES, _LANES), lambda i: (i, 0, 0)),
+            pl.BlockSpec((_STEP_ROWS, _ROW_SUBLANES, _LANES), lambda i: (i, 0, 0)),
+            pl.BlockSpec((_STEP_ROWS, _ROW_SUBLANES, _LANES), lambda i: (i, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((step,), lambda i: (i,)),
-        out_shape=jax.ShapeDtypeStruct((n,), jnp.int32),
+        out_specs=pl.BlockSpec((_STEP_ROWS, _LANES), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, _LANES), jnp.int32),
         interpret=_interpret(),
     )(a_tiles, b_tiles)
 
 
-def _partials_count(a_tiles) -> jnp.ndarray:
+def _lane_partials_count(a_tiles) -> jnp.ndarray:
     n = a_tiles.shape[0]
-    step = _chunk_for(n)
     return pl.pallas_call(
-        _count_rows_kernel,
-        grid=(n // step,),
+        _count_lanes_kernel,
+        grid=(n // _STEP_ROWS,),
         in_specs=[
-            pl.BlockSpec((step, _ROW_SUBLANES, _LANES), lambda i: (i, 0, 0))
+            pl.BlockSpec((_STEP_ROWS, _ROW_SUBLANES, _LANES), lambda i: (i, 0, 0))
         ],
-        out_specs=pl.BlockSpec((step,), lambda i: (i,)),
-        out_shape=jax.ShapeDtypeStruct((n,), jnp.int32),
+        out_specs=pl.BlockSpec((_STEP_ROWS, _LANES), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, _LANES), jnp.int32),
         interpret=_interpret(),
     )(a_tiles)
 
@@ -124,13 +127,19 @@ def _partials_count(a_tiles) -> jnp.ndarray:
 @functools.partial(jax.jit, static_argnames=("op",))
 def fused_count(a: jnp.ndarray, b: jnp.ndarray, op: str) -> jnp.ndarray:
     """int32 popcount of (a OP b) over whole slice-row-multiple operands."""
-    return jnp.sum(_partials_fused(_row_tiles(a), _row_tiles(b), op))
+    at, bt = _row_tiles(a), _row_tiles(b)
+    if at.shape[0] % _STEP_ROWS:
+        return _popcount_reduce(_combine(op, at, bt))
+    return jnp.sum(_lane_partials_fused(at, bt, op))
 
 
 @jax.jit
 def count(a: jnp.ndarray) -> jnp.ndarray:
     """int32 popcount of a (reference: popcntSliceAsm)."""
-    return jnp.sum(_partials_count(_row_tiles(a)))
+    at = _row_tiles(a)
+    if at.shape[0] % _STEP_ROWS:
+        return _popcount_reduce(at)
+    return jnp.sum(_lane_partials_count(at))
 
 
 @functools.partial(jax.jit, static_argnames=("op",))
@@ -142,14 +151,14 @@ def fused_count_rows(a: jnp.ndarray, b: jnp.ndarray, op: str) -> jnp.ndarray:
     rows = a.shape[0]
     at = a.reshape(rows, _ROW_SUBLANES, _LANES)
     bt = b.reshape(rows, _ROW_SUBLANES, _LANES)
-    return _partials_fused(at, bt, op)
+    if rows % _STEP_ROWS:
+        return _popcount_reduce(_combine(op, at, bt), axis=(1, 2))
+    return jnp.sum(_lane_partials_fused(at, bt, op), axis=-1)
 
 
-def _top_counts_kernel(p_ref, s_ref, o_ref):
+def _top_lanes_kernel(p_ref, s_ref, o_ref):
     w = p_ref[:] & s_ref[:][None, :, :]
-    o_ref[:] = jnp.sum(
-        jax.lax.population_count(w).astype(jnp.int32), axis=(1, 2)
-    )
+    o_ref[:] = jnp.sum(jax.lax.population_count(w).astype(jnp.int32), axis=1)
 
 
 @jax.jit
@@ -157,20 +166,22 @@ def top_counts(plane: jnp.ndarray, src_row: jnp.ndarray) -> jnp.ndarray:
     """Per-row |row AND src| over a (rows, 32768) plane -> int32[rows].
 
     The batched TopN(Src=...) scorer: row chunks stream through VMEM
-    against a resident src tile; each grid step writes its own output
-    slot (no shared accumulator)."""
+    against a resident src tile; each grid step writes its own (8, 128)
+    lane-partial tile (no shared accumulator)."""
     rows = plane.shape[0]
     pt = plane.reshape(rows, _ROW_SUBLANES, _LANES)
     st = src_row.reshape(_ROW_SUBLANES, _LANES)
-    step = _chunk_for(rows)
-    return pl.pallas_call(
-        _top_counts_kernel,
-        grid=(rows // step,),
+    if rows % _STEP_ROWS:
+        return _popcount_reduce(pt & st[None, :, :], axis=(1, 2))
+    lanes = pl.pallas_call(
+        _top_lanes_kernel,
+        grid=(rows // _STEP_ROWS,),
         in_specs=[
-            pl.BlockSpec((step, _ROW_SUBLANES, _LANES), lambda i: (i, 0, 0)),
+            pl.BlockSpec((_STEP_ROWS, _ROW_SUBLANES, _LANES), lambda i: (i, 0, 0)),
             pl.BlockSpec((_ROW_SUBLANES, _LANES), lambda i: (0, 0)),
         ],
-        out_specs=pl.BlockSpec((step,), lambda i: (i,)),
-        out_shape=jax.ShapeDtypeStruct((rows,), jnp.int32),
+        out_specs=pl.BlockSpec((_STEP_ROWS, _LANES), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows, _LANES), jnp.int32),
         interpret=_interpret(),
     )(pt, st)
+    return jnp.sum(lanes, axis=-1)

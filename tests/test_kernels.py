@@ -40,21 +40,25 @@ def test_fused_count(rows, op, fn):
     assert int(kernels.fused_count(a, b, op)) == np_popcount(fn(a, b))
 
 
-def test_top_counts(rng):
-    # 5 rows: NOT a multiple of the preferred grid chunk, so the
-    # odd-row-count (step-1) path is exercised too
-    plane = rng.integers(0, 2 ** 32, size=(5, bp.WORDS_PER_SLICE), dtype=np.uint32)
+# 5 rows exercises the rows-%-8 pure-XLA fallback; 16 rows (two grid
+# steps) exercises the tile-aligned Pallas kernel path (interpret mode
+# off-TPU, compiled on TPU) — BOTH branches must be bit-exact.
+@pytest.mark.parametrize("nrows", [5, 16])
+def test_top_counts(rng, nrows):
+    plane = rng.integers(0, 2 ** 32, size=(nrows, bp.WORDS_PER_SLICE), dtype=np.uint32)
     src = rng.integers(0, 2 ** 32, size=bp.WORDS_PER_SLICE, dtype=np.uint32)
     got = np.asarray(kernels.top_counts(plane, src))
-    for r in range(5):
+    for r in range(nrows):
         assert got[r] == np_popcount(plane[r] & src)
 
 
-def test_multi_row_operand(rng):
-    # fused_count over a whole 4-row plane (flattened)
-    a = rng.integers(0, 2 ** 32, size=(4, bp.WORDS_PER_SLICE), dtype=np.uint32)
-    b = rng.integers(0, 2 ** 32, size=(4, bp.WORDS_PER_SLICE), dtype=np.uint32)
+# 4 rows falls back to plain XLA; 8 rows runs the Pallas grid kernel.
+@pytest.mark.parametrize("nrows", [4, 8])
+def test_multi_row_operand(rng, nrows):
+    a = rng.integers(0, 2 ** 32, size=(nrows, bp.WORDS_PER_SLICE), dtype=np.uint32)
+    b = rng.integers(0, 2 ** 32, size=(nrows, bp.WORDS_PER_SLICE), dtype=np.uint32)
     assert int(kernels.fused_count(a, b, "and")) == np_popcount(a & b)
+    assert int(kernels.count(a)) == np_popcount(a)
 
 
 class TestFusedCountRows:
@@ -67,15 +71,16 @@ class TestFusedCountRows:
         ("xor", lambda a, b: a ^ b),
         ("andnot", lambda a, b: a & ~b),
     ])
-    def test_matches_xla(self, rng, op, fn):
+    @pytest.mark.parametrize("nrows", [5, 8])
+    def test_matches_xla(self, rng, op, fn, nrows):
         import jax
         import jax.numpy as jnp
 
         from pilosa_tpu.ops import kernels
         from pilosa_tpu.ops.bitplane import WORDS_PER_SLICE
 
-        a = rng.integers(0, 2**32, size=(5, WORDS_PER_SLICE), dtype=np.uint32)
-        b = rng.integers(0, 2**32, size=(5, WORDS_PER_SLICE), dtype=np.uint32)
+        a = rng.integers(0, 2**32, size=(nrows, WORDS_PER_SLICE), dtype=np.uint32)
+        b = rng.integers(0, 2**32, size=(nrows, WORDS_PER_SLICE), dtype=np.uint32)
         got = np.asarray(kernels.fused_count_rows(jnp.asarray(a), jnp.asarray(b), op))
         want = [np_popcount(fn(a[i], b[i])) for i in range(a.shape[0])]
         np.testing.assert_array_equal(got, np.asarray(want, dtype=np.int32))
@@ -90,7 +95,7 @@ class TestFusedCountRows:
         q = parse_string("Count(Intersect(Bitmap(rowID=1), Bitmap(rowID=2)))")
         expr, _ = plan.decompose(q.calls[0].children[0])
         batch = jnp.asarray(
-            rng.integers(0, 2**32, size=(4, 2, WORDS_PER_SLICE), dtype=np.uint32)
+            rng.integers(0, 2**32, size=(8, 2, WORDS_PER_SLICE), dtype=np.uint32)
         )
         general = plan.compiled_batched(expr, "count", fused=False)(batch)
         fused = plan.compiled_batched(expr, "count", fused=True)(batch)

@@ -1,0 +1,94 @@
+"""Disentangle axon-tunnel artifacts from real device time.
+
+The naive timing loop (same executable + same args, 20 iters) can be
+distorted by the tunnel: per-dispatch RTT, async queueing, or
+result caching of identical (executable, args) pairs.  This probe:
+
+1. times a trivial scalar program (pure RTT floor),
+2. times the headline AND+popcount over K DISTINCT input batches
+   cycled round-robin (defeats any same-args caching),
+3. times it with the SAME batch repeatedly (what bench.py does),
+and prints all three so the real compute time can be read off.
+"""
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from pilosa_tpu.ops.bitplane import np_count
+
+N_SLICES = 954
+WORDS = 32768
+
+
+def timed(name, thunk, iters=20):
+    jax.block_until_ready(thunk(0))
+    t0 = time.perf_counter()
+    for i in range(iters):
+        out = thunk(i)
+    jax.block_until_ready(out)
+    s = (time.perf_counter() - t0) / iters
+    gbps = (N_SLICES * 2 * WORDS * 4) / s / 1e9
+    print(f"{name:44s} {s*1e3:8.3f} ms  ({gbps:7.1f} GB/s-equiv)", flush=True)
+    return s
+
+
+def main():
+    print(f"backend={jax.default_backend()} devices={jax.devices()}", flush=True)
+    rng = np.random.default_rng(7)
+
+    one = jnp.float32(1.0)
+
+    @jax.jit
+    def trivial(x):
+        return x + 1.0
+
+    timed("trivial scalar add (RTT floor)", lambda i: trivial(one))
+
+    @jax.jit
+    def count(batch):
+        return jax.vmap(
+            lambda l: jnp.sum(jax.lax.population_count(l[0] & l[1]).astype(jnp.int32))
+        )(batch)
+
+    K = 4
+    batches = [
+        jnp.asarray(
+            rng.integers(0, 2**32, size=(N_SLICES, 2, WORDS), dtype=np.uint32)
+        )
+        for _ in range(K)
+    ]
+    jax.block_until_ready(batches)
+    hosts = [
+        int(np_count(np.asarray(b[:, 0]) & np.asarray(b[:, 1])))
+        for b in batches
+    ]
+
+    timed("count, SAME batch every iter", lambda i: count(batches[0]))
+    timed(f"count, {K} distinct batches cycled", lambda i: count(batches[i % K]))
+
+    # verify correctness of the cycled results
+    for k in range(K):
+        got = int(np.asarray(count(batches[k]), np.int64).sum())
+        assert got == hosts[k], (k, got, hosts[k])
+    print("bit-exact on all distinct batches", flush=True)
+
+    # sync-every-iteration timing (no queue pipelining)
+    def sync_timed(name, thunk, iters=20):
+        jax.block_until_ready(thunk(0))
+        lat = []
+        for i in range(iters):
+            t0 = time.perf_counter()
+            jax.block_until_ready(thunk(i))
+            lat.append(time.perf_counter() - t0)
+        p50 = sorted(lat)[len(lat) // 2]
+        print(f"{name:44s} p50 {p50*1e3:8.3f} ms  min {min(lat)*1e3:.3f}", flush=True)
+
+    sync_timed("count SYNC, same batch", lambda i: count(batches[0]))
+    sync_timed(f"count SYNC, {K} distinct cycled", lambda i: count(batches[i % K]))
+    sync_timed("trivial SYNC", lambda i: trivial(one))
+
+
+if __name__ == "__main__":
+    main()
