@@ -777,12 +777,18 @@ class Fragment:
     # ------------------------------------------------------------------
 
     def top(self, opt: TopOptions | None = None) -> list[Pair]:
-        with self._mu:
-            return self._top_locked(opt)
-
-    def _top_locked(self, opt: TopOptions | None = None) -> list[Pair]:
+        """Concurrent-read safe: the candidate listing and the plane
+        gather each take the fragment lock briefly, but the device score
+        fetch runs OUTSIDE it (the gathered submatrix is an immutable
+        device snapshot) — so parallel TopN queries overlap their device
+        round trips instead of serializing on the fragment, matching the
+        reference's RWMutex read-side concurrency (fragment.go:507)."""
         opt = opt or TopOptions()
-        pairs = self._top_candidates(opt.row_ids)
+        with self._mu:
+            pairs = self._top_candidates(opt.row_ids)
+        return self._top_score(pairs, opt)
+
+    def _top_score(self, pairs: list[Pair], opt: TopOptions) -> list[Pair]:
         n = 0 if (opt.row_ids) else opt.n
 
         filters = None

@@ -538,6 +538,19 @@ class Executor:
         out.update({s: res[p] for s, p in ent["pos_of"].items()})
         return out
 
+    def _eval_tree_slices_host(
+        self, index: str, c: Call, slices: list[int]
+    ) -> dict[int, object]:
+        """HOST (numpy) evaluation of a bitmap tree per slice — for
+        consumers that need host words (TopN src).  Authoritative planes
+        are host-resident, so this touches no device state."""
+        expr, leaves = plan.decompose(c)
+        out: dict[int, object] = {}
+        for s in slices:
+            rows = [self._leaf_row_host(index, leaf, s) for leaf in leaves]
+            out[s] = plan.eval_expr_np(expr, rows, bp.WORDS_PER_SLICE)
+        return out
+
     def _count_slices_total(self, index: str, c: Call, slices: list[int]) -> int:
         """Count(tree) over local slices with the cross-slice reduce ON
         DEVICE.
@@ -727,13 +740,14 @@ class Executor:
         self, index: str, c: Call, slices: list[int], opt: ExecOptions
     ) -> list[Pair]:
         def map_fn(local_slices: list[int]):
-            # The src bitmap (if any) evaluates for ALL local slices in
-            # ONE batched program instead of per slice — the per-slice
-            # loop below then only does candidate selection + scoring.
+            # The src bitmap (if any) evaluates HOST-side per slice: the
+            # scorer needs host words anyway (sparse probing + transfer
+            # to the gather kernel), so a device program here would add
+            # a sync round trip per query for no compute win.
             src_rows = None
             if len(c.children) == 1:
-                src_rows = self._eval_tree_slices(
-                    index, c.children[0], local_slices, "row"
+                src_rows = self._eval_tree_slices_host(
+                    index, c.children[0], local_slices
                 )
             elif len(c.children) > 1:
                 raise ExecutorError("TopN() can only have one input bitmap")
@@ -771,7 +785,7 @@ class Executor:
             src = RowBitmap()
             row = src_rows.get(slice_i)
             if row is not None:
-                src.set_segment(slice_i, np.asarray(row))
+                src.set_segment(slice_i, row)
 
         view = VIEW_INVERSE if inverse else VIEW_STANDARD
         f = self.holder.fragment(index, frame, view, slice_i)

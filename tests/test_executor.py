@@ -436,6 +436,54 @@ def test_batch_cache_invalidated_by_frame_delete(ex, holder):
     assert q(ex, "i", pql) == [0]
 
 
+def test_concurrent_topn_and_writes(ex, holder):
+    """Parallel TopN queries racing writes on the SAME fragment: the
+    device score fetch runs outside the fragment lock (core/fragment.py
+    top()), so this exercises the snapshot consistency of the gathered
+    submatrix under mutation.  Every result must be internally
+    consistent (sorted, counts from SOME consistent plane state)."""
+    import threading
+
+    for r in range(8):
+        must_set_bits(holder, "i", "f", [(r, c) for c in range(0, 40 + r, 2)])
+    must_set_bits(holder, "i", "f", [(99, c) for c in range(60)])
+    errors = []
+
+    def topn_reader():
+        try:
+            for _ in range(25):
+                (pairs,) = q(
+                    ex, "i", "TopN(Bitmap(rowID=99, frame=f), frame=f, n=5)"
+                )
+                counts = [p.count for p in pairs]
+                assert counts == sorted(counts, reverse=True)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    def writer(row):
+        try:
+            for c in range(100, 140):
+                q(ex, "i", f"SetBit(frame=f, rowID={row}, columnID={c})")
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=topn_reader) for _ in range(3)] + [
+        threading.Thread(target=writer, args=(3,)),
+        threading.Thread(target=writer, args=(5,)),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    # Quiesced: exact final scores.
+    (pairs,) = q(ex, "i", "TopN(Bitmap(rowID=99, frame=f), frame=f, n=5)")
+    by_id = {p.id: p.count for p in pairs}
+    # rows 3 and 5 now have all even cols in [0,40+r) plus [100,140).
+    assert by_id[3] == len(set(range(0, 43, 2)) & set(range(60)))
+    assert by_id[5] == len(set(range(0, 45, 2)) & set(range(60)))
+
+
 def test_concurrent_queries_and_writes(ex, holder):
     """Smoke: concurrent queries and writes through one executor (the
     HTTP server is threaded) never crash on the cache paths, and the
@@ -474,3 +522,40 @@ def test_concurrent_queries_and_writes(ex, holder):
     assert not errors, errors
     (n,) = q(ex, "i", "Count(Bitmap(rowID=1, frame=f))")
     assert n == 50 + 80
+
+
+@pytest.mark.parametrize(
+    "tree",
+    [
+        "Bitmap(rowID=0, frame=f)",
+        "Intersect(Bitmap(rowID=0, frame=f), Bitmap(rowID=1, frame=f))",
+        "Union(Bitmap(rowID=0, frame=f), Bitmap(rowID=9, frame=f))",
+        "Difference(Bitmap(rowID=0, frame=f), Bitmap(rowID=1, frame=f))",
+        "Xor(Bitmap(rowID=9, frame=f), Bitmap(rowID=1, frame=f))",
+        "Intersect(Bitmap(rowID=9, frame=f), Bitmap(rowID=1, frame=f))",
+        "Difference(Bitmap(rowID=9, frame=f), Bitmap(rowID=1, frame=f))",
+        "Union(Intersect(Bitmap(rowID=0, frame=f), Bitmap(rowID=1, frame=f)),"
+        " Xor(Bitmap(rowID=2, frame=f), Bitmap(rowID=9, frame=f)))",
+    ],
+)
+def test_eval_expr_np_matches_device(ex, holder, tree):
+    """The host (numpy) tree evaluator used for TopN src rows must stay
+    bit-identical to the device path — including the None (= absent
+    row) propagation rules.  rowID=9 never has bits, so every op's
+    empty-operand branch is exercised."""
+    import numpy as np
+
+    must_set_bits(holder, "i", "f", [(0, c) for c in range(0, 64, 3)])
+    must_set_bits(holder, "i", "f", [(1, c) for c in range(0, 64, 2)])
+    must_set_bits(holder, "i", "f", [(2, c) for c in range(5, 40)])
+
+    call = parse_string(tree).calls[0]
+    host_rows = ex._eval_tree_slices_host("i", call, [0])
+    dev_rows = ex._eval_tree_slices("i", call, [0], "row")
+
+    hr, dr = host_rows[0], dev_rows.get(0)
+    if hr is None:
+        assert dr is None or not np.asarray(dr).any()
+    else:
+        want = np.zeros_like(hr) if dr is None else np.asarray(dr)
+        np.testing.assert_array_equal(hr, want)

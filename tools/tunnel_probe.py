@@ -44,7 +44,9 @@ def main():
     def trivial(x):
         return x + 1.0
 
-    timed("trivial scalar add (RTT floor)", lambda i: trivial(one))
+    # NOTE: pipelined — this is amortized per-dispatch overhead, NOT the
+    # synchronous round-trip floor (that is `trivial SYNC` below).
+    timed("trivial scalar add (pipelined dispatch)", lambda i: trivial(one))
 
     @jax.jit
     def count(batch):
