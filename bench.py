@@ -61,12 +61,17 @@ def wait_for_backend(attempts: int = 14, delay_s: float = 60.0) -> None:
     import time as _time
 
     for i in range(attempts):
-        probe = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices(); print('OK')"],
-            capture_output=True,
-            text=True,
-            timeout=900,
-        )
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices(); print('OK')"],
+                capture_output=True,
+                text=True,
+                timeout=900,
+            )
+        except subprocess.TimeoutExpired:
+            log(f"backend probe {i + 1}/{attempts} HUNG (900s); retrying in {delay_s:.0f}s")
+            _time.sleep(delay_s)
+            continue
         if probe.returncode == 0 and "OK" in probe.stdout:
             return
         tail = (probe.stderr or probe.stdout).strip().splitlines()

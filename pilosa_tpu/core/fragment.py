@@ -146,6 +146,27 @@ class TopOptions:
     tanimoto_threshold: int = 0
 
 
+@dataclass
+class TopState:
+    """In-flight TopN work on one fragment, between top_prepare (async
+    kernel dispatch) and top_finish (fetch + selection).  ``done`` short-
+    circuits the src-less / empty cases; otherwise ``dev_counts`` holds
+    the un-fetched device score vector (the executor may bulk-fetch many
+    fragments' vectors in one round trip and hand the result back via
+    ``counts``)."""
+
+    done: list | None = None
+    candidates: list = None
+    dense_ids: list = None
+    by_id: dict = None
+    n: int = 0
+    tanimoto: int = 0
+    src_count: int = 0
+    min_threshold: int = 0
+    dev_counts: object = None
+    counts: object = None
+
+
 class Fragment:
     """One frame-view x slice bit-plane with caches and sync hooks."""
 
@@ -783,12 +804,49 @@ class Fragment:
         device snapshot) — so parallel TopN queries overlap their device
         round trips instead of serializing on the fragment, matching the
         reference's RWMutex read-side concurrency (fragment.go:507)."""
+        return self.top_finish(self.top_prepare(opt))
+
+    def top_prepare(self, opt: TopOptions | None = None) -> "TopState":
+        """Phase 1 of TopN on this fragment: candidate selection, sparse
+        scoring, and the ASYNC dispatch of the dense score kernel —
+        everything except the device->host fetch.  The executor prepares
+        every local slice first and fetches ALL their score vectors in
+        one device round trip (mapperLocal's TPU shape: one transfer per
+        node per phase, not one per slice)."""
         opt = opt or TopOptions()
         with self._mu:
             pairs = self._top_candidates(opt.row_ids)
-        return self._top_score(pairs, opt)
+        return self._top_score_prepare(pairs, opt)
 
-    def _top_score(self, pairs: list[Pair], opt: TopOptions) -> list[Pair]:
+    def top_finish(self, st: "TopState") -> list[Pair]:
+        """Phase 2: resolve the dense score fetch (or accept one already
+        fetched in bulk via ``st.counts``) and apply the final
+        threshold/tanimoto selection."""
+        if st.done is not None:
+            return st.done
+        if st.dense_ids:
+            if st.counts is None:
+                st.counts = np.asarray(st.dev_counts)
+            counts = st.counts[: len(st.dense_ids)]
+            st.by_id.update(zip(st.dense_ids, (int(c) for c in counts)))
+        results: list[Pair] = []
+        for p in st.candidates:
+            cnt = st.by_id.get(p.id, 0)
+            if cnt == 0:
+                continue
+            if st.tanimoto > 0:
+                score = math.ceil(
+                    float(cnt * 100) / float(p.count + st.src_count - cnt)
+                )
+                if score <= st.tanimoto:
+                    continue
+            elif cnt < st.min_threshold:
+                continue
+            results.append(Pair(p.id, cnt))
+        results = cache_mod.sort_pairs(results)
+        return results[: st.n] if st.n else results
+
+    def _top_score_prepare(self, pairs: list[Pair], opt: TopOptions) -> "TopState":
         n = 0 if (opt.row_ids) else opt.n
 
         filters = None
@@ -831,23 +889,24 @@ class Fragment:
             # No intersection: cached counts are final.  Candidates are
             # already count-descending; take the first n.
             result = candidates[:n] if n else candidates
-            return list(result)
+            return TopState(done=list(result))
 
         # Batched intersection scoring: one fused kernel over all
         # candidate rows at once (replaces the reference's sequential
         # threshold-pruned loop, fragment.go:601-627).
         if not candidates:
-            return []
+            return TopState(done=[])
         src_seg = opt.src.segments.get(self.slice)
         if src_seg is None:
-            return []
+            return TopState(done=[])
         src_words = np.asarray(src_seg, dtype=np.uint32)
         with self._mu:
             dense_ids = [p.id for p in candidates if p.id in self._slot_of]
             sparse_ids = [p.id for p in candidates if p.id in self._sparse]
             if not dense_ids and not sparse_ids:
-                return []
+                return TopState(done=[])
             by_id: dict[int, int] = {}
+            sub = None
             if dense_ids:
                 # Gather candidate rows from the HBM-resident plane —
                 # only the src row and slot indices travel host->device —
@@ -879,24 +938,20 @@ class Fragment:
                     ((src_words[offs >> 5] >> (offs & np.uint32(31)))
                      & np.uint32(1)).sum()
                 )
+        st = TopState(
+            candidates=candidates,
+            dense_ids=dense_ids,
+            by_id=by_id,
+            n=n,
+            tanimoto=tanimoto,
+            src_count=src_count,
+            min_threshold=opt.min_threshold,
+        )
         if dense_ids:
-            counts = np.asarray(bp.top_counts(sub, src_words))[: len(dense_ids)]
-            by_id.update(zip(dense_ids, (int(c) for c in counts)))
-
-        results: list[Pair] = []
-        for p in candidates:
-            cnt = by_id.get(p.id, 0)
-            if cnt == 0:
-                continue
-            if tanimoto > 0:
-                score = math.ceil(float(cnt * 100) / float(p.count + src_count - cnt))
-                if score <= tanimoto:
-                    continue
-            elif cnt < opt.min_threshold:
-                continue
-            results.append(Pair(p.id, cnt))
-        results = cache_mod.sort_pairs(results)
-        return results[:n] if n else results
+            # ASYNC dispatch — the fetch happens in top_finish (or in
+            # bulk by the executor across all slices).
+            st.dev_counts = bp.top_counts(sub, src_words)
+        return st
 
     def _top_candidates(self, row_ids: list[int] | None) -> list[Pair]:
         """reference: fragment.go:641-673 topBitmapPairs"""

@@ -535,6 +535,12 @@ class Executor:
             )
         else:
             res = plan.compiled_batched(ent["expr"], reduce)(ent["batch"])
+            if reduce == "row":
+                # Every consumer of row results materializes them on the
+                # host (client responses, merges), so fetch the WHOLE
+                # batch in ONE transfer — per-slice lazy slices would
+                # each pay a device round trip when coerced.
+                res = np.asarray(res)
         out.update({s: res[p] for s, p in ent["pos_of"].items()})
         return out
 
@@ -751,12 +757,32 @@ class Executor:
                 )
             elif len(c.children) > 1:
                 raise ExecutorError("TopN() can only have one input bitmap")
+            # Two passes: prepare every slice (candidates + ASYNC score
+            # kernel dispatch), then resolve ALL dense score vectors in
+            # ONE device->host transfer — one round trip per node per
+            # phase however many slices it owns, the TPU shape of the
+            # reference's goroutine-per-slice mapperLocal fan-in
+            # (reference: executor.go:1246-1282).
+            prepped = [
+                self._prepare_topn_slice(index, c, s, src_rows=src_rows)
+                for s in local_slices
+            ]
+            states = [p for p in prepped if p is not None]
+            pending = [
+                st
+                for _, st in states
+                if st.done is None and st.dev_counts is not None
+            ]
+            if pending:
+                # device_get starts async host copies for EVERY vector
+                # before blocking on any — one overlapped transfer even
+                # when planes live on different home devices.
+                fetched = jax.device_get([st.dev_counts for st in pending])
+                for st, arr in zip(pending, fetched):
+                    st.counts = arr
             acc: list[Pair] = []
-            for s in local_slices:
-                acc = cache_mod.add_pairs(
-                    acc,
-                    self._execute_topn_slice(index, c, s, src_rows=src_rows),
-                )
+            for frag, st in states:
+                acc = cache_mod.add_pairs(acc, frag.top_finish(st))
             return acc
 
         def reduce_fn(prev, v):
@@ -765,12 +791,13 @@ class Executor:
         pairs = self._map_reduce(index, slices, c, opt, map_fn, reduce_fn) or []
         return cache_mod.sort_pairs(pairs)
 
-    def _execute_topn_slice(
+    def _prepare_topn_slice(
         self, index: str, c: Call, slice_i: int, src_rows=None
-    ) -> list[Pair]:
+    ):
         """reference: executor.go:346-415.  ``src_rows`` carries the
-        batch-evaluated src rows from _execute_topn_slices (one program
-        for all local slices)."""
+        host-evaluated src rows from _execute_topn_slices.  Returns
+        ``(fragment, TopState)`` with the score kernel dispatched but
+        NOT fetched, or None when the fragment does not exist."""
         frame = c.args.get("frame") or DEFAULT_FRAME
         inverse = bool(c.args.get("inverse", False))
         n = _uint_arg(c, "n")[0]
@@ -790,12 +817,12 @@ class Executor:
         view = VIEW_INVERSE if inverse else VIEW_STANDARD
         f = self.holder.fragment(index, frame, view, slice_i)
         if f is None:
-            return []
+            return None
         if min_threshold <= 0:
             min_threshold = MIN_THRESHOLD
         if tanimoto > 100:
             raise ExecutorError("Tanimoto Threshold is from 1 to 100 only")
-        return f.top(
+        return f, f.top_prepare(
             TopOptions(
                 n=n,
                 src=src,

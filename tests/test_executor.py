@@ -202,6 +202,35 @@ def test_topn_with_src(ex, holder):
     assert [(p.id, p.count) for p in pairs] == [(0, 3), (10, 2)]
 
 
+def test_topn_src_many_slices(ex, holder):
+    """TopN with a src bitmap spanning MANY slices: the executor
+    prepares every slice then resolves all dense score vectors in one
+    bulk fetch — counts must equal the per-slice sum of |row ∩ src|
+    exactly (two-phase refetch included)."""
+    n_slices = 9
+    bits = []
+    # src row 0: columns 0..9 of every slice EXCEPT slice 4 (that
+    # fragment never exists — prepare must skip it); rows 1..3 overlap
+    # differently per slice.
+    for s in range(n_slices):
+        if s == 4:
+            continue
+        base = s * SLICE_WIDTH
+        bits += [(0, base + c) for c in range(10)]
+        bits += [(1, base + c) for c in range(0, 10, 2)]        # 5/slice
+        bits += [(2, base + c) for c in range(0, 10, 3)]        # 4/slice
+        if s % 2 == 0:
+            bits += [(3, base + c) for c in range(10)]          # 10 on even slices
+    must_set_bits(holder, "i", "f", bits)
+    (pairs,) = q(ex, "i", "TopN(Bitmap(rowID=0, frame=f), frame=f, n=4)")
+    got = {p.id: p.count for p in pairs}
+    populated = n_slices - 1  # slice 4 has no fragment at all
+    assert got[0] == 10 * populated
+    assert got[3] == 10 * 4   # even slices 0,2,6,8
+    assert got[1] == 5 * populated
+    assert got[2] == 4 * populated
+
+
 def test_topn_ids(ex, holder):
     must_set_bits(holder, "i", "f", [(0, 0), (0, 1), (10, 1), (12, 2)])
     (pairs,) = q(ex, "i", "TopN(frame=f, ids=[0, 12])")
