@@ -445,11 +445,15 @@ class Executor:
                     return ent
 
         # Capture validity BEFORE building: a concurrent write during
-        # assembly leaves the entry conservatively stale.
+        # assembly leaves the entry conservatively stale.  The same
+        # sweep counts mirror-less fragments for the cold-path choice.
         epoch = fragment_mod.write_epoch()
-        versions = (
-            self._leaf_versions(index, leaves, slices) if cacheable else None
-        )
+        versions = None
+        n_frag = n_cold = 0
+        if cacheable:
+            versions, n_frag, n_cold = self._leaf_versions(
+                index, leaves, slices, with_cold=True
+            )
         mesh = pmesh.default_slices_mesh()
         ent = {
             "batch": None,
@@ -473,7 +477,27 @@ class Executor:
                 batch=batch,
                 pos_of={s: i for i, s in enumerate(kept_slices)},
             )
+        elif cacheable and n_cold * 2 > n_frag:
+            # MOSTLY-cold fragments: assemble per-device blocks HOST-
+            # side from the authoritative planes — one transfer per
+            # device instead of ~2 device dispatches per (slice, leaf),
+            # and no full-plane uploads just to gather two rows.  A
+            # mostly-WARM set (e.g. one fragment invalidated by a write)
+            # keeps the device-gather path, which re-uploads only the
+            # changed planes.
+            batch, pos_of, kept_slices, empties = self._assemble_mesh_batch_host(
+                index, leaves, slices, mesh
+            )
+            ent.update(expr=expr, empties=empties, kept=kept_slices)
+            if batch is not None:
+                ent.update(
+                    batch=batch,
+                    pos_of=pos_of,
+                    mesh=mesh if len(kept_slices) > 1 else None,
+                )
         else:
+            # Warm device mirrors (or Range trees): gather rows straight
+            # from HBM-resident planes — nothing crosses host<->device.
             expr, stacks, kept_slices, empties = self._gather_leaf_stacks(
                 index, c, slices
             )
@@ -495,10 +519,89 @@ class Executor:
                     self._batch_cache.popitem(last=False)
         return ent
 
-    def _leaf_versions(self, index: str, leaves, slices: list[int]) -> tuple:
+    def _assemble_mesh_batch_host(self, index: str, leaves, slices, mesh):
+        """Host-side mesh batch assembly for COLD fragments: read leaf
+        rows from the authoritative numpy planes, group by home device
+        (slice mod n_devices, same placement as _assemble_mesh_batch,
+        including balanced-chunk spill), and ship ONE block per device.
+        Returns (batch, pos_of, kept, empties); batch is None when
+        nothing is set, and a plain single-device array when only one
+        slice survives (callers then run the non-collective path)."""
+        n_leaves = len(leaves)
+        rows_of: dict[int, np.ndarray] = {}
+        kept: list[int] = []
+        empties: list[int] = []
+        for s in slices:
+            buf = None
+            for j, leaf in enumerate(leaves):
+                w = self._leaf_row_host(index, leaf, s)
+                if w is not None:
+                    if buf is None:
+                        buf = np.zeros(
+                            (n_leaves, bp.WORDS_PER_SLICE), dtype=np.uint32
+                        )
+                    buf[j] = w
+            if buf is None:
+                empties.append(s)
+            else:
+                kept.append(s)
+                rows_of[s] = buf
+        if not kept:
+            return None, {}, kept, empties
+        if len(kept) == 1:
+            return (
+                jnp.asarray(rows_of[kept[0]][None]),
+                {kept[0]: 0},
+                kept,
+                empties,
+            )
+
+        n_dev = int(mesh.devices.size)
+        groups, chunk = self._mesh_placement(kept, n_dev)
+        blocks = []
+        pos_of: dict[int, int] = {}
+        for d in range(n_dev):
+            block = np.zeros(
+                (chunk, n_leaves, bp.WORDS_PER_SLICE), dtype=np.uint32
+            )
+            for i, s in enumerate(groups[d]):
+                block[i] = rows_of[s]
+                pos_of[s] = d * chunk + i
+            blocks.append(jax.device_put(block, mesh.devices.flat[d]))
+        return pmesh.assemble_sharded_batch(blocks, mesh), pos_of, kept, empties
+
+    @staticmethod
+    def _mesh_placement(kept: list[int], n_dev: int):
+        """Slice -> device placement shared by BOTH batch assemblers
+        (device gather and cold host blocks): home device = slice mod
+        n_devices (matching fragment plane placement), chunk = balanced
+        power-of-two (pow2 >= ceil(n/n_devices)), clustered overflow
+        spilled to devices with free rows.  Returns ({device: [slices]},
+        chunk); the two assemblers MUST produce identical pos_of layouts
+        for the same kept set, since their outputs share the batch
+        cache."""
+        groups: dict[int, list[int]] = {d: [] for d in range(n_dev)}
+        for s in kept:
+            groups[s % n_dev].append(s)
+        chunk = 1 << (((len(kept) + n_dev - 1) // n_dev) - 1).bit_length()
+        spill: list[int] = []
+        for d in range(n_dev):
+            while len(groups[d]) > chunk:
+                spill.append(groups[d].pop())
+        for d in range(n_dev):
+            while spill and len(groups[d]) < chunk:
+                groups[d].append(spill.pop())
+        return groups, chunk
+
+    def _leaf_versions(
+        self, index: str, leaves, slices: list[int], with_cold: bool = False
+    ):
         """(fragment identity, version) per (slice, leaf) — the cache
-        validity vector.  Pure dict lookups; no device work."""
+        validity vector.  Pure dict lookups; no device work.  With
+        ``with_cold`` also returns (n_fragments, n_without_device_mirror)
+        from the same sweep, so callers never resolve the pairs twice."""
         out = []
+        n_frag = n_cold = 0
         for s in slices:
             for leaf in leaves:
                 frag, _ = self._resolve_bitmap_leaf(index, leaf, s)
@@ -506,6 +609,11 @@ class Executor:
                     out.append(None)
                 else:
                     out.append((frag._serial, frag._version))
+                    n_frag += 1
+                    if frag._device is None:
+                        n_cold += 1
+        if with_cold:
+            return tuple(out), n_frag, n_cold
         return tuple(out)
 
     def _eval_tree_slices(
@@ -615,33 +723,26 @@ class Executor:
         device's padding to the largest group — at pod scale, mostly-
         zero compute costs more than the occasional spill copy."""
         n_dev = int(mesh.devices.size)
-        groups: dict[int, list[tuple[int, object]]] = {d: [] for d in range(n_dev)}
-        for s, st in zip(kept_slices, stacks):
-            groups[s % n_dev].append((s, st))
-        chunk = 1 << (((len(kept_slices) + n_dev - 1) // n_dev) - 1).bit_length()
-
-        spill: list[tuple[int, object]] = []
-        for d in range(n_dev):
-            while len(groups[d]) > chunk:
-                spill.append(groups[d].pop())
+        stack_of = dict(zip(kept_slices, stacks))
+        groups, chunk = self._mesh_placement(kept_slices, n_dev)
 
         blocks = []
         pos_of: dict[int, int] = {}
         for d in range(n_dev):
-            g = groups[d]
             dev = mesh.devices.flat[d]
-            while spill and len(g) < chunk:
-                s, st = spill.pop()
-                g.append((s, jax.device_put(st, dev)))
-            entries = [st for _, st in g]
+            entries = []
+            for i, s in enumerate(groups[d]):
+                st = stack_of[s]
+                if s % n_dev != d:  # spilled here: one plane-row move
+                    st = jax.device_put(st, dev)
+                entries.append(st)
+                pos_of[s] = d * chunk + i
             if len(entries) < chunk:
                 zero_stack = jnp.stack(
                     [self._zero_row_on(dev)] * stacks[0].shape[0]
                 )
                 entries = entries + [zero_stack] * (chunk - len(entries))
             blocks.append(jnp.stack(entries))
-            for i, (s, _) in enumerate(g):
-                pos_of[s] = d * chunk + i
 
         return pmesh.assemble_sharded_batch(blocks, mesh), pos_of
 

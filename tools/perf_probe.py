@@ -10,14 +10,16 @@ Workload: 954 slices x 2 rows x 32768 u32 words (250 MB total operands).
 v5e HBM ~819 GB/s => floor ~0.305 ms. r02 plain-XLA: 1.91 ms (131 GB/s).
 """
 import functools
+import os
 import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
 
 def _interp():
@@ -111,37 +113,31 @@ def main():
     out, _ = bench("v6 popcount+MXU-dot reduce", v6, A, B)
     assert int(np.asarray(out, np.float64).sum()) == host
 
-    # 7. pallas: per-row-chunk partials to VMEM vector out, 8 rows/step
-    R = 8
-    def k7(a_ref, b_ref, o_ref):
-        w = a_ref[:] & b_ref[:]
-        o_ref[:] = jnp.sum(jax.lax.population_count(w).astype(jnp.int32),
-                           axis=-1)
-    @jax.jit
-    def v7(a, b):
-        n = a.shape[0]
-        part = pl.pallas_call(
-            k7,
-            grid=(n // R,),
-            in_specs=[pl.BlockSpec((R, WORDS), lambda i: (i, 0)),
-                      pl.BlockSpec((R, WORDS), lambda i: (i, 0))],
-            out_specs=pl.BlockSpec((R,), lambda i: (i,)),
-            out_shape=jax.ShapeDtypeStruct((n,), jnp.int32),
-            interpret=_interp(),
-        )(a, b)
-        return jnp.sum(part, dtype=jnp.int64)
-    n7 = (N_SLICES // R) * R  # truncate to a whole number of chunks
+    # 7. pallas production kernel: (8,128) lane-partial tiles per step
+    # (the ops/kernels.py formulation — Mosaic-legal output blocks).
+    from pilosa_tpu.ops import kernels
+
+    n7 = (N_SLICES // 8) * 8  # truncate to whole 8-row grid steps
     A8, B8 = A[:n7], B[:n7]
     host8 = int(np.bitwise_count(leaves[:n7, 0] & leaves[:n7, 1]).sum())
-    out, s = bench(f"v7 pallas {R}-row partials->VMEM", v7, A8, B8)
+
+    @jax.jit
+    def v7(a, b):
+        return jnp.sum(kernels.fused_count_rows(a, b, "and"))
+
+    out, s = bench("v7 pallas (8,128) lane partials", v7, A8, B8)
     print(f"    (bw adj for {n7}/{N_SLICES}: {n7*2*WORDS*4/s/1e9:.1f} GB/s)", flush=True)
     assert int(out) == host8, (int(out), host8)
 
-    # 8. pallas: 2D block over (rows, words), partial per tile, XLA sums
-    RT, CT = 16, 8192
+    # 8. pallas: 2D grid over (row chunks, word chunks), (8,128) lane
+    # partials per tile so wide rows pipeline through smaller VMEM blocks.
+    RT, CT = 8, 8192
     def k8(a_ref, b_ref, o_ref):
         w = a_ref[:] & b_ref[:]
-        o_ref[0, 0] = jnp.sum(jax.lax.population_count(w).astype(jnp.int32))
+        o_ref[:] = jnp.sum(
+            jax.lax.population_count(w).astype(jnp.int32).reshape(RT, CT // 128, 128),
+            axis=1,
+        )
     @jax.jit
     def v8(a, b):
         n = a.shape[0]
@@ -150,18 +146,13 @@ def main():
             grid=(n // RT, WORDS // CT),
             in_specs=[pl.BlockSpec((RT, CT), lambda i, j: (i, j)),
                       pl.BlockSpec((RT, CT), lambda i, j: (i, j))],
-            out_specs=pl.BlockSpec((1, 1), lambda i, j: (i, j),
-                                   memory_space=pltpu.SMEM),
-            out_shape=jax.ShapeDtypeStruct((n // RT, WORDS // CT), jnp.int32),
+            out_specs=pl.BlockSpec((RT, 128), lambda i, j: (i, j)),
+            out_shape=jax.ShapeDtypeStruct((n, (WORDS // CT) * 128), jnp.int32),
             interpret=_interp(),
         )(a, b)
         return jnp.sum(part, dtype=jnp.int64)
-    n8 = (N_SLICES // RT) * RT
-    if n8:
-        A16, B16 = A[:n8], B[:n8]
-        host16 = int(np.bitwise_count(leaves[:n8, 0] & leaves[:n8, 1]).sum())
-        out, _ = bench("v8 pallas 2D tile SMEM partials", v8, A16, B16)
-        assert int(out) == host16, (int(out), host16)
+    out, _ = bench("v8 pallas 2D grid lane partials", v8, A8, B8)
+    assert int(out) == host8, (int(out), host8)
 
     print("host count:", host, flush=True)
 
