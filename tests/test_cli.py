@@ -723,3 +723,76 @@ class TestTutorialWorkflow:
                 want[m] = inter
         assert want and got == want
         assert set(want) >= {3, 7}
+
+    def test_star_trace_workflow(self, tmp_path):
+        """The star-trace tutorial end-to-end (docs/tutorials.md §1,
+        reference: docs/getting-started.md): custom labels, a
+        time-quantum frame and a plain frame, CLI CSV import with
+        timestamps, then Intersect / cross-frame TopN / Range over
+        HTTP — validated against a Python oracle."""
+        import json as jsonlib
+        import urllib.request
+
+        s = Server(data_dir=str(tmp_path / "data"))
+        s.open()
+        try:
+            c = InternalClient(s.host, timeout=10.0)
+            c.create_index("repository", {"columnLabel": "repo_id"})
+            c.create_frame(
+                "repository", "stargazer",
+                {"rowLabel": "stargazer_id", "timeQuantum": "YMD"},
+            )
+            c.create_frame("repository", "language", {"rowLabel": "language_id"})
+
+            # stars: (user, repo, day); language: (lang, repo)
+            stars = [
+                (14, 1, "2024-01-05T00:00"), (14, 2, "2024-02-10T00:00"),
+                (14, 3, "2024-02-20T00:00"), (14, 5, "2024-03-01T00:00"),
+                (19, 2, "2024-01-15T00:00"), (19, 3, "2024-02-11T00:00"),
+                (19, 4, "2024-04-01T00:00"),
+            ]
+            langs = [(0, 1), (0, 2), (1, 3), (1, 4), (2, 5)]
+            star_csv = tmp_path / "stars.csv"
+            with open(star_csv, "w") as fh:
+                for u, r, ts in stars:
+                    fh.write(f"{u},{r},{ts}\n")
+            lang_csv = tmp_path / "langs.csv"
+            with open(lang_csv, "w") as fh:
+                for l, r in langs:
+                    fh.write(f"{l},{r}\n")
+            assert main(["import", "--host", s.host, "-i", "repository",
+                         "-f", "stargazer", str(star_csv)]) == 0
+            assert main(["import", "--host", s.host, "-i", "repository",
+                         "-f", "language", str(lang_csv)]) == 0
+
+            def query(pql):
+                req = urllib.request.Request(
+                    f"http://{s.host}/index/repository/query",
+                    data=pql.encode(), method="POST",
+                )
+                with urllib.request.urlopen(req) as resp:
+                    return jsonlib.load(resp)["results"][0]
+
+            # repos starred by BOTH user 14 and user 19
+            both = query(
+                'Intersect(Bitmap(frame="stargazer", stargazer_id=14),'
+                ' Bitmap(frame="stargazer", stargazer_id=19))'
+            )
+            assert both["bits"] == [2, 3]
+
+            # most-starred languages among user 14's repos:
+            # repos {1,2,3,5} -> lang 0 has {1,2}, lang 1 has {3}, lang 2 has {5}
+            top = query(
+                'TopN(Bitmap(frame="stargazer", stargazer_id=14),'
+                ' frame="language", n=5)'
+            )
+            assert [(p["id"], p["count"]) for p in top] == [(0, 2), (1, 1), (2, 1)]
+
+            # user 14's stars during February 2024 (time-quantum views)
+            feb = query(
+                'Range(frame="stargazer", stargazer_id=14,'
+                ' start="2024-02-01T00:00", end="2024-03-01T00:00")'
+            )
+            assert feb["bits"] == [2, 3]
+        finally:
+            s.close()

@@ -272,6 +272,38 @@ class TestShardedExecutor:
         assert after.hits + after.misses == before.hits + before.misses + 1
 
 
+    def test_cold_and_warm_assembly_identical(self, tmp_path):
+        """The cold (host-blocks) and warm (device-gather) mesh batch
+        assemblers share one placement helper and MUST produce identical
+        pos_of layouts and batch contents for the same slice set — they
+        are interchangeable producers for the same batch cache."""
+        h, ex, parse = self._exec(tmp_path, n_slices=11)
+        from pilosa_tpu.exec import plan as _plan
+
+        call = parse(
+            'Count(Intersect(Bitmap(frame="f", rowID=1),'
+            ' Bitmap(frame="f", rowID=2)))'
+        ).calls[0].children[0]
+        _, leaves = _plan.decompose(call)
+        slices = list(range(11))
+        mesh = __import__(
+            "pilosa_tpu.parallel.mesh", fromlist=["default_slices_mesh"]
+        ).default_slices_mesh()
+        assert mesh is not None
+
+        cold_batch, cold_pos, cold_kept, cold_emp = (
+            ex._assemble_mesh_batch_host("i", leaves, slices, mesh)
+        )
+        expr, stacks, kept, emp = ex._gather_leaf_stacks("i", call, slices)
+        warm_batch, warm_pos = ex._assemble_mesh_batch(stacks, kept, mesh)
+
+        assert cold_kept == kept and cold_emp == emp
+        assert cold_pos == warm_pos
+        np.testing.assert_array_equal(
+            np.asarray(cold_batch), np.asarray(warm_batch)
+        )
+
+
 def test_mesh_shape_config_caps_devices(monkeypatch):
     from pilosa_tpu.ops import bitplane as bp
     from pilosa_tpu.parallel import mesh as pmesh
@@ -444,35 +476,3 @@ def test_multihost_two_process_sharded_count(tmp_path):
         assert f"MH OK {pid}" in out, out
         totals.add(out.strip().split()[-1])
     assert len(totals) == 1  # both processes agree on the reduced total
-
-
-    def test_cold_and_warm_assembly_identical(self, tmp_path):
-        """The cold (host-blocks) and warm (device-gather) mesh batch
-        assemblers share one placement helper and MUST produce identical
-        pos_of layouts and batch contents for the same slice set — they
-        are interchangeable producers for the same batch cache."""
-        h, ex, parse = self._exec(tmp_path, n_slices=11)
-        from pilosa_tpu.exec import plan as _plan
-
-        call = parse(
-            'Count(Intersect(Bitmap(frame="f", rowID=1),'
-            ' Bitmap(frame="f", rowID=2)))'
-        ).calls[0].children[0]
-        _, leaves = _plan.decompose(call)
-        slices = list(range(11))
-        mesh = __import__(
-            "pilosa_tpu.parallel.mesh", fromlist=["default_slices_mesh"]
-        ).default_slices_mesh()
-        assert mesh is not None
-
-        cold_batch, cold_pos, cold_kept, cold_emp = (
-            ex._assemble_mesh_batch_host("i", leaves, slices, mesh)
-        )
-        expr, stacks, kept, emp = ex._gather_leaf_stacks("i", call, slices)
-        warm_batch, warm_pos = ex._assemble_mesh_batch(stacks, kept, mesh)
-
-        assert cold_kept == kept and cold_emp == emp
-        assert cold_pos == warm_pos
-        np.testing.assert_array_equal(
-            np.asarray(cold_batch), np.asarray(warm_batch)
-        )
