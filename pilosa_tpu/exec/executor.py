@@ -836,6 +836,12 @@ class Executor:
         # executor.go:301-321).
         if not pairs or ids_arg or opt.remote:
             return pairs
+        # Phase 2 exists to get EXACT counts for winners that missed
+        # some slice's local candidate list; with a single slice the
+        # phase-1 scores are already exact and complete, so the refetch
+        # would recompute identical counts at double the latency.
+        if len(slices) <= 1:
+            return pairs[:n] if n and n < len(pairs) else pairs
         other = c.clone()
         other.args["ids"] = sorted({p.id for p in pairs})
         trimmed = self._execute_topn_slices(index, other, slices, opt)

@@ -596,3 +596,25 @@ def test_eval_expr_np_matches_device(ex, holder, tree):
     else:
         want = np.zeros_like(hr) if dr is None else np.asarray(dr)
         np.testing.assert_array_equal(hr, want)
+
+
+def test_topn_single_slice_skips_phase2(ex, holder, monkeypatch):
+    """With one slice, phase-1 TopN scores are already exact and
+    complete, so the executor skips the phase-2 refetch (half the
+    device round trips); results must equal the two-phase output."""
+    must_set_bits(
+        holder, "i", "f",
+        [(0, c) for c in range(8)] + [(1, c) for c in range(0, 8, 2)]
+        + [(2, 1), (2, 2)],
+    )
+    calls = []
+    orig = Executor._execute_topn_slices
+
+    def spy(self, index, c, slices, opt):
+        calls.append(str(c))
+        return orig(self, index, c, slices, opt)
+
+    monkeypatch.setattr(Executor, "_execute_topn_slices", spy)
+    (pairs,) = q(ex, "i", "TopN(Bitmap(rowID=0, frame=f), frame=f, n=2)")
+    assert [(p.id, p.count) for p in pairs] == [(0, 8), (1, 4)]
+    assert len(calls) == 1  # no phase-2 pass
