@@ -233,3 +233,102 @@ class TestFragmentTierProperties:
                 assert f.row(r).bits() == sorted(by_row.get(r, ())), r
         finally:
             f.close()
+
+
+# ---------------------------------------------------------------------------
+# random query trees (r3): planner fold semantics vs a set oracle
+# ---------------------------------------------------------------------------
+
+
+query_trees = st.recursive(
+    st.integers(min_value=0, max_value=6).map(lambda r: ("leaf", r)),
+    lambda child: st.tuples(
+        st.sampled_from(["Intersect", "Union", "Difference", "Xor"]),
+        st.lists(child, min_size=1, max_size=3),
+    ),
+    max_leaves=6,
+)
+
+
+def _tree_pql(t) -> str:
+    if t[0] == "leaf":
+        return f'Bitmap(frame="f", rowID={t[1]})'
+    return f"{t[0]}({', '.join(_tree_pql(c) for c in t[1])})"
+
+
+def _tree_oracle(t, rows: dict[int, set]) -> set:
+    if t[0] == "leaf":
+        return set(rows.get(t[1], set()))
+    op, children = t
+    sets = [_tree_oracle(c, rows) for c in children]
+    acc = sets[0]
+    for nxt in sets[1:]:
+        if op == "Intersect":
+            acc = acc & nxt
+        elif op == "Union":
+            acc = acc | nxt
+        elif op == "Difference":
+            acc = acc - nxt
+        elif op == "Xor":
+            acc = acc ^ nxt
+    return acc
+
+
+class TestQueryTreeProperties:
+    """Random nested call trees through the REAL executor (fused
+    program + batch cache + host evaluator) vs a Python set oracle —
+    hardens the planner fold semantics (exec/plan.py decompose /
+    _eval_expr / eval_expr_np) under arbitrary shapes, including
+    absent rows (rowID 6 never has bits) and multi-slice rows."""
+
+    @classmethod
+    def _holder(cls, tmp_path_factory):
+        if not hasattr(cls, "_cached"):
+            from pilosa_tpu.core.holder import Holder
+            from pilosa_tpu.exec.executor import Executor
+            from pilosa_tpu.ops.bitplane import SLICE_WIDTH
+
+            holder = Holder(str(tmp_path_factory.mktemp("trees")))
+            holder.open()
+            idx = holder.create_index("i")
+            f = idx.create_frame("f")
+            rng = np.random.default_rng(11)
+            rows: dict[int, set] = {}
+            for r in range(6):  # row 6 stays absent
+                cols = set(
+                    int(c)
+                    for c in rng.choice(40, size=12, replace=False)
+                ) | {int(SLICE_WIDTH + c) for c in rng.choice(20, size=4, replace=False)}
+                rows[r] = cols
+                for c in cols:
+                    f.set_bit("standard", r, c)
+            cls._cached = (holder, Executor(holder=holder, host="local"), rows)
+        return cls._cached
+
+    @QUICK
+    @given(tree=query_trees)
+    def test_tree_matches_oracle(self, tmp_path_factory, tree):
+        from pilosa_tpu.net.codec import bitmap_to_json
+        from pilosa_tpu.pql.parser import parse_string
+
+        holder, ex, rows = self._holder(tmp_path_factory)
+        want = _tree_oracle(tree, rows)
+        pql = _tree_pql(tree)
+        (bm,) = ex.execute("i", parse_string(pql))
+        assert bitmap_to_json(bm)["bits"] == sorted(want)
+        (n,) = ex.execute("i", parse_string(f"Count({pql})"))
+        assert n == len(want)
+
+        # host evaluator parity (the TopN src path) on every slice
+        call = parse_string(pql).calls[0]
+        host_rows = ex._eval_tree_slices_host("i", call, [0, 1])
+        got_bits = set()
+        from pilosa_tpu.ops.bitplane import SLICE_WIDTH, np_row_to_columns
+
+        for s, words in host_rows.items():
+            if words is None:
+                continue
+            got_bits |= {
+                int(s * SLICE_WIDTH + off) for off in np_row_to_columns(words)
+            }
+        assert got_bits == want
