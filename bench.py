@@ -49,8 +49,9 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def wait_for_backend(attempts: int = 14, delay_s: float = 60.0) -> None:
-    """Probe accelerator init in SUBPROCESSES until one succeeds.
+def wait_for_backend(attempts: int = 14, delay_s: float = 60.0) -> bool:
+    """Probe accelerator init in SUBPROCESSES until one succeeds;
+    returns False if the accelerator never comes up.
 
     The axon TPU tunnel can be wedged for many minutes after an earlier
     killed process (leaked session grant); a failed in-process backend
@@ -73,14 +74,33 @@ def wait_for_backend(attempts: int = 14, delay_s: float = 60.0) -> None:
             _time.sleep(delay_s)
             continue
         if probe.returncode == 0 and "OK" in probe.stdout:
-            return
+            return True
         tail = (probe.stderr or probe.stdout).strip().splitlines()
         log(
             f"backend probe {i + 1}/{attempts} failed"
             f" ({tail[-1] if tail else 'no output'}); retrying in {delay_s:.0f}s"
         )
         _time.sleep(delay_s)
-    log("backend never came up; proceeding (the real error will surface)")
+    return False
+
+
+def reexec_cpu_fallback() -> None:
+    """The accelerator never came up: re-exec this bench on the CPU
+    backend in a fresh process (in-process fallback is impossible — a
+    wedged tunnel HANGS backend init rather than failing it).  The
+    artifact then records an honest, clearly-labeled CPU number instead
+    of a crash (the r01 failure mode)."""
+    import subprocess
+
+    log("TPU backend never came up after all probes; "
+        "re-running the ENTIRE bench on the CPU backend (metric will be "
+        "labeled *_cpu_fallback — NOT comparable to TPU rounds)")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["BENCH_CPU_FALLBACK"] = "1"
+    proc = subprocess.run([sys.executable, os.path.abspath(__file__)], env=env)
+    sys.exit(proc.returncode)
 
 
 def with_retries(label: str, fn, attempts: int = 3, delay_s: float = 90.0):
@@ -125,7 +145,15 @@ def build_holder(leaves: np.ndarray, data_dir: str):
 
 
 def main() -> None:
-    wait_for_backend()
+    # The re-exec marker only counts with the CPU platform actually
+    # forced — a leaked BENCH_CPU_FALLBACK alone must not skip the
+    # wedge-avoiding probe or mislabel a TPU number.
+    cpu_fallback = (
+        os.environ.get("BENCH_CPU_FALLBACK") == "1"
+        and os.environ.get("JAX_PLATFORMS") == "cpu"
+    )
+    if not cpu_fallback and not wait_for_backend():
+        reexec_cpu_fallback()
 
     import jax
     import jax.numpy as jnp
@@ -238,6 +266,9 @@ def main() -> None:
         log(f"e2e executor tier FAILED ({e!r:.400}); falling back to raw kernel metric")
         e2e_s = dev_s
         metric = "intersect_count_1b_columns"
+
+    if cpu_fallback:
+        metric += "_cpu_fallback"
 
     cols_per_s = total_columns / e2e_s
     vs = host_s / e2e_s
