@@ -539,12 +539,59 @@ class TestMultiNode:
             c0.import_bits("i", "f", sl, [(2, sl * SLICE_WIDTH + 1)])
         assert c0.execute_pql("i", 'Count(Bitmap(frame="f", rowID=2))') == 6
 
+    def test_topn_two_phase_across_nodes(self, two_servers):
+        """Distributed two-phase TopN: phase 1 trims to each slice's
+        local top-n, so a row that ranks 3rd on every slice but 2nd
+        globally is undercounted until the phase-2 ids refetch
+        (reference: executor.go:281-321).  The final counts must be
+        exact from EITHER coordinator."""
+        s0, s1 = two_servers
+        self._setup_schema(two_servers)
+        c0 = InternalClient(s0.host, timeout=10.0)
+        c1 = InternalClient(s1.host, timeout=10.0)
+
+        # src row 0: cols 0..19 of both slices.
+        # slice 0: row1 overlaps 10, row2 9, row3 8
+        # slice 1: row4 overlaps 10, row3 9, row5 8
+        # => globally row3 = 17, beaten only by row0 (self, 40).
+        bits = []
+        for base in (0, SLICE_WIDTH):
+            bits += [(0, base + c) for c in range(20)]
+        bits += [(1, c) for c in range(10)]
+        bits += [(2, c) for c in range(9)]
+        bits += [(3, c) for c in range(8)]
+        bits += [(4, SLICE_WIDTH + c) for c in range(10)]
+        bits += [(3, SLICE_WIDTH + c) for c in range(9)]
+        bits += [(5, SLICE_WIDTH + c) for c in range(8)]
+        for row, col in bits:
+            c0.execute_query(
+                "i", f'SetBit(frame="f", rowID={row}, columnID={col})'
+            )
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            if (
+                s0.holder.index("i").max_slice() == 1
+                and s1.holder.index("i").max_slice() == 1
+            ):
+                break
+            time.sleep(0.02)
+
+        want = [
+            {"id": 0, "count": 40},
+            {"id": 3, "count": 17},
+            {"id": 1, "count": 10},
+        ]
+        for c in (c0, c1):
+            got = c.execute_pql(
+                "i", 'TopN(Bitmap(frame="f", rowID=0), frame="f", n=3)'
+            )
+            got = [{"id": p.id, "count": p.count} for p in got]
+            assert got == want, got
+
 
 # ---------------------------------------------------------------------------
 # http broadcast between two servers
 # ---------------------------------------------------------------------------
-
-
 class TestHTTPBroadcast:
     def test_schema_replicates(self, tmp_path):
         recv1 = bc.HTTPBroadcastReceiver()
