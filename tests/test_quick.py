@@ -11,8 +11,12 @@ from hypothesis import strategies as st
 from pilosa_tpu.core.bitmap import RowBitmap
 from pilosa_tpu.ops import roaring
 
+# PILOSA_TPU_QUICK_EXAMPLES scales the property tier into a soak run
+# (e.g. =500 for hours-long shakeouts); default stays CI-fast.
+import os
+
 QUICK = settings(
-    max_examples=25,
+    max_examples=int(os.environ.get("PILOSA_TPU_QUICK_EXAMPLES", "25")),
     deadline=None,
     suppress_health_check=[HealthCheck.function_scoped_fixture],
 )
