@@ -62,15 +62,22 @@ def wait_for_backend(attempts: int = 14, delay_s: float = 60.0) -> bool:
     import time as _time
 
     for i in range(attempts):
+        # Generous timeout early (first compile + wedged-grant expiry);
+        # shorter once the tunnel has proven hung, so a dead tunnel
+        # reaches the CPU fallback in ~1.5h instead of ~3.5h.
+        probe_timeout = 900 if i < 3 else 240
         try:
             probe = subprocess.run(
                 [sys.executable, "-c", "import jax; jax.devices(); print('OK')"],
                 capture_output=True,
                 text=True,
-                timeout=900,
+                timeout=probe_timeout,
             )
         except subprocess.TimeoutExpired:
-            log(f"backend probe {i + 1}/{attempts} HUNG (900s); retrying in {delay_s:.0f}s")
+            log(
+                f"backend probe {i + 1}/{attempts} HUNG ({probe_timeout}s);"
+                f" retrying in {delay_s:.0f}s"
+            )
             _time.sleep(delay_s)
             continue
         if probe.returncode == 0 and "OK" in probe.stdout:
