@@ -61,11 +61,13 @@ def wait_for_backend(attempts: int = 14, delay_s: float = 60.0) -> bool:
     import subprocess
     import time as _time
 
+    hung = 0
     for i in range(attempts):
-        # Generous timeout early (first compile + wedged-grant expiry);
-        # shorter once the tunnel has proven hung, so a dead tunnel
-        # reaches the CPU fallback in ~1.5h instead of ~3.5h.
-        probe_timeout = 900 if i < 3 else 240
+        # Generous timeout until the tunnel has HUNG three times (a
+        # fast-failing probe says nothing about init/compile time);
+        # shorter after that, so a dead tunnel reaches the CPU fallback
+        # in ~1.5h instead of ~3.5h.
+        probe_timeout = 900 if hung < 3 else 240
         try:
             probe = subprocess.run(
                 [sys.executable, "-c", "import jax; jax.devices(); print('OK')"],
@@ -74,6 +76,7 @@ def wait_for_backend(attempts: int = 14, delay_s: float = 60.0) -> bool:
                 timeout=probe_timeout,
             )
         except subprocess.TimeoutExpired:
+            hung += 1
             log(
                 f"backend probe {i + 1}/{attempts} HUNG ({probe_timeout}s);"
                 f" retrying in {delay_s:.0f}s"
