@@ -618,3 +618,22 @@ def test_topn_single_slice_skips_phase2(ex, holder, monkeypatch):
     (pairs,) = q(ex, "i", "TopN(Bitmap(rowID=0, frame=f), frame=f, n=2)")
     assert [(p.id, p.count) for p in pairs] == [(0, 8), (1, 4)]
     assert len(calls) == 1  # no phase-2 pass
+
+
+def test_topn_inverse_orientation(ex, holder):
+    """TopN(inverse=true) ranks COLUMNS by row overlap using the
+    inverse views' own slice list (reference: executor.go:336-344
+    SupportsInverse slice-list swap)."""
+    idx = holder.create_index("i")
+    idx.create_frame("f", inverse_enabled=True)
+    # col 5 appears in rows 0..3; col 9 in rows 0..1; col 2 in row 0.
+    for row, col in [(r, 5) for r in range(4)] + [(r, 9) for r in range(2)] + [(0, 2)]:
+        q(ex, "i", f"SetBit(frame=f, rowID={row}, columnID={col})")
+    (pairs,) = q(ex, "i", "TopN(frame=f, inverse=true, n=2)")
+    assert [(p.id, p.count) for p in pairs] == [(5, 4), (9, 2)]
+    # src: columns sharing rows with column 5 (all rows 0..3)
+    (pairs,) = q(
+        ex, "i",
+        "TopN(Bitmap(columnID=5, frame=f), frame=f, inverse=true, n=3)",
+    )
+    assert [(p.id, p.count) for p in pairs] == [(5, 4), (9, 2), (2, 1)]
