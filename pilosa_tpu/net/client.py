@@ -17,7 +17,6 @@ import json
 import urllib.parse
 from typing import Any
 
-from pilosa_tpu.core.cache import Pair
 from pilosa_tpu.net import codec
 from pilosa_tpu.net import wire_pb2 as wire
 

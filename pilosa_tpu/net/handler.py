@@ -52,7 +52,6 @@ from pilosa_tpu import __version__
 from pilosa_tpu.core import attr as attr_mod
 from pilosa_tpu.core import timequantum as tq
 from pilosa_tpu.core.bitmap import RowBitmap
-from pilosa_tpu.core.fragment import PairSet
 from pilosa_tpu.exec.executor import ExecOptions, TooManyWritesError
 from pilosa_tpu.net import codec
 from pilosa_tpu.net import wire_pb2 as wire

@@ -9,7 +9,6 @@ formulation is chosen by measurement.
 Workload: 954 slices x 2 rows x 32768 u32 words (250 MB total operands).
 v5e HBM ~819 GB/s => floor ~0.305 ms. r02 plain-XLA: 1.91 ms (131 GB/s).
 """
-import functools
 import os
 import sys
 import time
