@@ -130,12 +130,74 @@ def run_server(args) -> int:
     multihost.initialize()
     server.open()
     print(f"listening on http://{server.host}", file=sys.stderr)
+    stop_profile = _start_cpu_profile(
+        getattr(args, "cpuprofile", ""), getattr(args, "cputime", 30)
+    )
+    # SIGTERM must run the shutdown path (close listeners, flush caches,
+    # finalize --cpuprofile), not hard-kill the process.
+    import signal
+
+    def _on_term(_sig, _frame):
+        raise SystemExit(0)
+
+    signal.signal(signal.SIGTERM, _on_term)
     try:
         while True:
             time.sleep(3600)
+    except (KeyboardInterrupt, SystemExit):
+        pass
     finally:
+        # A second TERM during cleanup must not abort server.close();
+        # restore the default disposition so it hard-kills instead of
+        # raising mid-finally.
+        signal.signal(signal.SIGTERM, signal.SIG_DFL)
+        stop_profile()
         server.close()
     return 0
+
+
+def _start_cpu_profile(path: str, seconds: int):
+    """Server-side CPU profiling flags (reference: server/server.go:56-57
+    cpuprofile/cputime): run the same folded-stack sampler the
+    /debug/pprof/profile endpoint uses, in a daemon thread, writing to
+    ``path`` when sampling ends (the --cputime deadline, or shutdown for
+    ``seconds == 0``).  Returns a callable that finalizes the file (a
+    no-op when profiling is off)."""
+    if not path:
+        return lambda: None
+    import threading
+
+    from pilosa_tpu.net import handler as _handler
+
+    stop = threading.Event()
+    # Shared with the sampler thread, which accumulates in place — the
+    # stop path can write a snapshot even if the thread is wedged.
+    counts: dict[str, int] = {}
+
+    def _write() -> None:
+        with open(path, "w") as f:
+            f.write(_handler._fold_counts(counts))
+        print(f"cpu profile written to {path}", file=sys.stderr)
+
+    def _run() -> None:
+        budget = seconds if seconds > 0 else 86400
+        _handler._sample_cpu_counts(budget, stop=stop, counts=counts)
+        _write()
+
+    t = threading.Thread(target=_run, daemon=True, name="cpuprofile")
+    t.start()
+
+    def _stop() -> None:
+        stop.set()
+        t.join(timeout=30)
+        if t.is_alive():
+            print(
+                "warning: cpu profiler did not stop; writing snapshot",
+                file=sys.stderr,
+            )
+            _write()
+
+    return _stop
 
 
 # ---------------------------------------------------------------------------

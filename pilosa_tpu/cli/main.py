@@ -34,6 +34,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-d", "--data-dir", default=None, help="data directory")
     p.add_argument("--bind", default=None, help="host:port to bind (overrides config host)")
     p.add_argument("--dry-run", action="store_true", help="stop before serving")
+    p.add_argument(
+        "--cpuprofile", default="", metavar="PATH",
+        help="write a folded-stack CPU profile of the first --cputime "
+        "seconds to PATH",
+    )
+    p.add_argument(
+        "--cputime", type=int, default=30, metavar="SECONDS",
+        help="with --cpuprofile: sampling duration (0 = until shutdown)",
+    )
     p.set_defaults(fn=ctl.run_server)
 
     p = sub.add_parser("import", help="bulk-import CSV bits (row,col[,ts])")

@@ -814,17 +814,22 @@ class Handler:
                 self.logger(f"broadcast error: {e}")
 
 
-def _sample_cpu_profile(seconds: float, hz: float = 100.0) -> str:
-    """Statistical whole-process CPU profile: sample every thread's
-    stack at ``hz`` for ``seconds`` and fold identical stacks into
-    "frame1;frame2;... count" lines (most-sampled first) — the
-    flamegraph-collapsed equivalent of the reference's pprof CPU
-    profile endpoint."""
-    counts: dict[str, int] = {}
+def _sample_cpu_counts(
+    seconds: float,
+    hz: float = 100.0,
+    stop: "threading.Event | None" = None,
+    counts: "dict[str, int] | None" = None,
+) -> dict[str, int]:
+    """Sample every thread's stack at ``hz`` for up to ``seconds``
+    (``stop`` cuts the run short), accumulating folded-stack sample
+    counts into ``counts`` in place so a caller on another thread can
+    snapshot mid-run."""
+    if counts is None:
+        counts = {}
     me = threading.get_ident()
     deadline = time.monotonic() + seconds
     interval = 1.0 / hz
-    while time.monotonic() < deadline:
+    while time.monotonic() < deadline and not (stop is not None and stop.is_set()):
         for tid, frame in sys._current_frames().items():
             if tid == me:
                 continue  # don't profile the profiler
@@ -837,11 +842,23 @@ def _sample_cpu_profile(seconds: float, hz: float = 100.0) -> str:
             stack = ";".join(reversed(parts)) or "<idle>"
             counts[stack] = counts.get(stack, 0) + 1
         time.sleep(interval)
+    return counts
+
+
+def _fold_counts(counts: dict[str, int]) -> str:
     lines = [
         f"{stack} {n}"
         for stack, n in sorted(counts.items(), key=lambda kv: -kv[1])
     ]
     return "\n".join(lines) + ("\n" if lines else "")
+
+
+def _sample_cpu_profile(seconds: float, hz: float = 100.0) -> str:
+    """Statistical whole-process CPU profile: sample for ``seconds`` and
+    fold identical stacks into "frame1;frame2;... count" lines
+    (most-sampled first) — the flamegraph-collapsed equivalent of the
+    reference's pprof CPU profile endpoint."""
+    return _fold_counts(_sample_cpu_counts(seconds, hz))
 
 
 def _frame_meta_proto(f) -> wire.FrameMeta:

@@ -233,53 +233,65 @@ def _use_pallas() -> bool:
     return jax.default_backend() == "tpu"
 
 
+# The Pallas flag is read BEFORE jit dispatch (never inside a traced body):
+# a traced read would bake the env var into the first compilation and
+# silently ignore mid-process flips, since the jit cache key doesn't
+# include it.  Each public entry point picks the XLA or Pallas jitted
+# callee per call, so both stay independently cached.
+
+
 @jax.jit
+def _count_xla(words):
+    return _popcount_sum(words)
+
+
 def count(words):
     """Popcount of a row/plane (reference: popcntSliceAsm)."""
     if _use_pallas():
         from pilosa_tpu.ops import kernels
 
         return kernels.count(words)
-    return _popcount_sum(words)
+    return _count_xla(words)
 
 
-@jax.jit
+@functools.partial(jax.jit, static_argnames=("op",))
+def _fused_count_xla(a, b, op):
+    if op == "and":
+        return _popcount_sum(a & b)
+    if op == "or":
+        return _popcount_sum(a | b)
+    if op == "xor":
+        return _popcount_sum(a ^ b)
+    if op == "andnot":
+        return _popcount_sum(a & ~b)
+    raise ValueError(f"unknown fused-count op {op!r}")
+
+
+def _fused_count(a, b, op):
+    if _use_pallas():
+        from pilosa_tpu.ops import kernels
+
+        return kernels.fused_count(a, b, op)
+    return _fused_count_xla(a, b, op)
+
+
 def count_and(a, b):
     """|a AND b| without materializing (reference: intersectionCount*,
     roaring/roaring.go:1259-1347, popcntAndSliceAsm)."""
-    if _use_pallas():
-        from pilosa_tpu.ops import kernels
-
-        return kernels.fused_count(a, b, "and")
-    return _popcount_sum(a & b)
+    return _fused_count(a, b, "and")
 
 
-@jax.jit
 def count_or(a, b):
-    if _use_pallas():
-        from pilosa_tpu.ops import kernels
-
-        return kernels.fused_count(a, b, "or")
-    return _popcount_sum(a | b)
+    return _fused_count(a, b, "or")
 
 
-@jax.jit
 def count_xor(a, b):
-    if _use_pallas():
-        from pilosa_tpu.ops import kernels
-
-        return kernels.fused_count(a, b, "xor")
-    return _popcount_sum(a ^ b)
+    return _fused_count(a, b, "xor")
 
 
-@jax.jit
 def count_andnot(a, b):
     """|a AND NOT b| (reference: popcntMaskSliceAsm / differenceCount)."""
-    if _use_pallas():
-        from pilosa_tpu.ops import kernels
-
-        return kernels.fused_count(a, b, "andnot")
-    return _popcount_sum(a & ~b)
+    return _fused_count(a, b, "andnot")
 
 
 # Materializing set algebra (reference: roaring/roaring.go:345-474 dispatch,
@@ -343,6 +355,12 @@ def row_counts(plane):
 
 
 @jax.jit
+def _top_counts_xla(plane, src_row):
+    return jnp.sum(
+        jax.lax.population_count(plane & src_row[None, :]).astype(jnp.int32), axis=-1
+    )
+
+
 def top_counts(plane, src_row):
     """Per-row |row AND src| -> int32[rows]: the batched TopN(Src=...) scorer.
 
@@ -355,9 +373,7 @@ def top_counts(plane, src_row):
         from pilosa_tpu.ops import kernels
 
         return kernels.top_counts(plane, src_row)
-    return jnp.sum(
-        jax.lax.population_count(plane & src_row[None, :]).astype(jnp.int32), axis=-1
-    )
+    return _top_counts_xla(plane, src_row)
 
 
 @functools.partial(jax.jit, static_argnames=("k",))
