@@ -175,13 +175,19 @@ def _start_cpu_profile(path: str, seconds: int):
     counts: dict[str, int] = {}
 
     def _write() -> None:
+        # dict(counts) is a single C-level copy under the GIL, safe even
+        # if the sampler thread is still inserting keys.
         with open(path, "w") as f:
-            f.write(_handler._fold_counts(counts))
+            f.write(_handler._fold_counts(dict(counts)))
         print(f"cpu profile written to {path}", file=sys.stderr)
 
     def _run() -> None:
-        budget = seconds if seconds > 0 else 86400
-        _handler._sample_cpu_counts(budget, stop=stop, counts=counts)
+        if seconds > 0:
+            _handler._sample_cpu_counts(seconds, stop=stop, counts=counts)
+        else:
+            # "until shutdown", literally: re-arm in bounded legs.
+            while not stop.is_set():
+                _handler._sample_cpu_counts(3600, stop=stop, counts=counts)
         _write()
 
     t = threading.Thread(target=_run, daemon=True, name="cpuprofile")
@@ -195,7 +201,10 @@ def _start_cpu_profile(path: str, seconds: int):
                 "warning: cpu profiler did not stop; writing snapshot",
                 file=sys.stderr,
             )
-            _write()
+            try:
+                _write()
+            except OSError as e:  # never abort the shutdown path
+                print(f"warning: cpu profile write failed: {e}", file=sys.stderr)
 
     return _stop
 

@@ -229,6 +229,16 @@ class Fragment:
         # buffers").  (slot, word, mask, op) with op 1=OR / 0=ANDNOT.
         self._device_pending: list[tuple[int, int, int, int]] = []
         self._file = None
+        # Group-commit op-log buffer: point writes append 13-byte op
+        # records here and fsync-free flush happens at boundaries
+        # (threshold / snapshot / close / holder flush loop) instead of
+        # per bit.  The reference gets the same effect from writing ops
+        # into an mmap'd file and letting the page cache carry them
+        # (reference: fragment.go:379-418, roaring/roaring.go:649-660);
+        # durability is identical-in-kind: a crash can lose ops since
+        # the last flush boundary, never committed state.  Reads never
+        # consult the file while open, so read-your-writes holds.
+        self._op_buf = bytearray()
         self._row_cache: dict[int, np.ndarray] = {}
         self.cache = cache_mod.new_cache(cache_type, cache_size)
         # Block checksum cache: blocks() re-hashes only blocks written
@@ -277,6 +287,7 @@ class Fragment:
     def close(self) -> None:
         with self._mu:
             if self._file is not None:
+                self._flush_ops_locked()
                 self.flush_cache()
                 fcntl.flock(self._file.fileno(), fcntl.LOCK_UN)
                 self._file.close()
@@ -695,11 +706,27 @@ class Fragment:
         if self._op_n >= self.max_op_n:
             self.snapshot()
 
+    # Flush the op buffer once it holds this many bytes (~5k ops) even
+    # between boundaries, bounding worst-case loss and memory.
+    _OP_FLUSH_BYTES = 64 << 10
+
     def _append_op(self, typ: int, pos: int) -> None:
         if self._file is not None:
+            self._op_buf += roaring.encode_op(typ, pos)
+            if len(self._op_buf) >= self._OP_FLUSH_BYTES:
+                self._flush_ops_locked()
+
+    def _flush_ops_locked(self) -> None:
+        if self._op_buf and self._file is not None:
             self._file.seek(0, os.SEEK_END)
-            self._file.write(roaring.encode_op(typ, pos))
+            self._file.write(self._op_buf)
             self._file.flush()
+        self._op_buf.clear()
+
+    def flush_ops(self) -> None:
+        """Group-commit boundary: persist buffered op-log records."""
+        with self._mu:
+            self._flush_ops_locked()
 
     def import_bulk(self, row_ids: Sequence[int], column_ids: Sequence[int]) -> None:
         """Bulk load: op-log off, vectorized scatter, cache recount per
@@ -779,6 +806,8 @@ class Fragment:
         file; resets the op count (reference: fragment.go:1032-1074)."""
         with self._mu:
             t0 = time.perf_counter()
+            # Buffered ops are subsumed by the serialized state below.
+            self._op_buf.clear()
             data = roaring.encode_tiered(*self._containers_tiered())
             tmp = self.path + ".snapshotting"
             with open(tmp, "wb") as fh:
@@ -1056,10 +1085,9 @@ class Fragment:
         fragment.go:798-808)."""
         with self._mu:
             rws, cls = self._block_positions(block_id)
-            return PairSet(
-                row_ids=[int(r) for r in rws],
-                column_ids=[int(c) for c in cls],
-            )
+            # .tolist() materializes Python ints in C, not per-element
+            # Python-loop conversion.
+            return PairSet(row_ids=rws.tolist(), column_ids=cls.tolist())
 
     def merge_block(
         self, block_id: int, data: list[PairSet]
@@ -1162,6 +1190,7 @@ class Fragment:
                     self._version += 1
                     self._row_cache.clear()
                     self._op_n = 0
+                    self._op_buf.clear()  # replaced wholesale below
                     # persist
                     with open(self.path + ".snapshotting", "wb") as fh:
                         fh.write(payload)
@@ -1189,17 +1218,17 @@ class Fragment:
 
     # ------------------------------------------------------------------
 
-    def for_each_bit(self) -> Iterable[tuple[int, int]]:
-        """Yield (rowID, absolute columnID) for every set bit, streaming
-        one row-block at a time (reference: fragment.go:487-502 over the
-        container iterators, roaring/roaring.go:742-840).
+    def _iter_row_offsets(self) -> Iterable[tuple[int, np.ndarray]]:
+        """Yield (rowID, sorted uint64 offsets-within-slice) per non-empty
+        row, ascending, taking the lock per row (reference:
+        fragment.go:487-502 over the container iterators).  The single
+        iteration protocol under both for_each_bit and csv_chunks.
 
         Peak extra memory is ONE unpacked row (~1 MiB), not the fully
         unpacked plane — exports and sync walks of big fragments stay
         under 2x plane memory."""
         with self._mu:
             rows = sorted(set(self._slot_of) | set(self._sparse))
-        base = self.slice * SLICE_WIDTH
         for r in rows:
             with self._mu:
                 slot = self._slot_of.get(r)
@@ -1210,8 +1239,50 @@ class Fragment:
                     if sp is None:
                         continue
                     offs = sp
+            if len(offs):
+                yield r, offs
+
+    def for_each_bit(self) -> Iterable[tuple[int, int]]:
+        """Yield (rowID, absolute columnID) for every set bit."""
+        base = self.slice * SLICE_WIDTH
+        for r, offs in self._iter_row_offsets():
             for c in offs:
                 yield r, base + int(c)
+
+    def csv_chunks(self, chunk_pairs: int = 1 << 20) -> Iterable[bytes]:
+        """Vectorized CSV export: yield "row,col\\n" byte chunks of up to
+        ``chunk_pairs`` records, rows ascending (reference: the
+        fragment.go:487-502 iterator feeding ctl/export.go — but
+        formatted a row-block at a time through the native formatter
+        instead of one Python tuple per bit)."""
+        base = self.slice * SLICE_WIDTH
+        pend_r: list[np.ndarray] = []
+        pend_c: list[np.ndarray] = []
+        pending = 0
+        for r, offs in self._iter_row_offsets():
+            pend_r.append(np.full(len(offs), r, dtype=np.uint64))
+            pend_c.append(offs.astype(np.uint64) + np.uint64(base))
+            pending += len(offs)
+            if pending >= chunk_pairs:
+                yield self._format_pairs(np.concatenate(pend_r), np.concatenate(pend_c))
+                pend_r, pend_c, pending = [], [], 0
+        if pending:
+            yield self._format_pairs(np.concatenate(pend_r), np.concatenate(pend_c))
+
+    @staticmethod
+    def _format_pairs(rws: np.ndarray, cls: np.ndarray) -> bytes:
+        from pilosa_tpu import native
+
+        blob = native.format_csv(rws, cls)
+        if blob is not None:
+            return blob
+        # numpy fallback: C-loop string conversion, still no per-bit
+        # Python iteration.
+        out = np.char.add(
+            np.char.add(rws.astype("S20"), b","),
+            np.char.add(cls.astype("S20"), b"\n"),
+        )
+        return b"".join(out.tolist())
 
     def __repr__(self) -> str:
         return (

@@ -142,10 +142,12 @@ class Holder:
             }
 
     def flush_caches(self) -> None:
-        """Persist every fragment's TopN cache (reference:
-        holder.go:318-352)."""
+        """Persist every fragment's TopN cache and group-commit its
+        buffered op-log records (reference: holder.go:318-352; the flush
+        loop doubles as the op-log durability interval here)."""
         for index in self.indexes().values():
             for frame in index.frames().values():
                 for view in frame.views().values():
                     for frag in view.fragments():
+                        frag.flush_ops()
                         frag.flush_cache()

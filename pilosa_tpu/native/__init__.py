@@ -113,6 +113,14 @@ def lib() -> ctypes.CDLL | None:
             ctypes.POINTER(ctypes.c_uint64),
             ctypes.c_int64,
         ]
+        l.ptpu_format_csv.restype = ctypes.c_int64
+        l.ptpu_format_csv.argtypes = [
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_int64,
+        ]
         l.ptpu_decode_tiered.restype = ctypes.c_void_p
         l.ptpu_decode_tiered.argtypes = [ctypes.c_char_p, ctypes.c_int64]
         l.ptpu_t_error.restype = ctypes.c_char_p
@@ -277,3 +285,31 @@ def parse_csv(data: bytes):
     if n < 0:
         return None
     return rows[:n], cols[:n]
+
+
+def format_csv(rows: np.ndarray, cols: np.ndarray) -> bytes | None:
+    """Format parallel row/col arrays as "row,col\\n" CSV bytes, or None
+    when the native library is unavailable (caller falls back to numpy
+    string formatting)."""
+    l = lib()
+    if l is None or len(rows) == 0:
+        return b"" if l is not None else None
+    rows = np.ascontiguousarray(rows, dtype=np.uint64)
+    cols = np.ascontiguousarray(cols, dtype=np.uint64)
+    # Exact per-record width bound from the widest values present.
+    digits_r = len(str(int(rows.max())))
+    digits_c = len(str(int(cols.max())))
+    # +43 slack: the C side pre-checks worst-case record width, not the
+    # actual one, so the buffer needs one worst-case record of headroom.
+    cap = len(rows) * (digits_r + digits_c + 2) + 43
+    out = np.empty(cap, dtype=np.uint8)  # no memset, unlike ctypes buffers
+    n = l.ptpu_format_csv(
+        rows.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        cols.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        len(rows),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        cap,
+    )
+    if n < 0:
+        return None
+    return out[:n].tobytes()

@@ -475,4 +475,35 @@ int64_t ptpu_parse_csv(const uint8_t* buf, int64_t len, uint64_t* rows,
   return n;
 }
 
+// ---------------------------------------------------------------------------
+// CSV bit formatting (export hot path; reference: fragment.go:487-502 feeds
+// ctl/export.go via buffered container iterators)
+// ---------------------------------------------------------------------------
+
+static inline int64_t fmt_u64(uint64_t v, uint8_t* out) {
+  uint8_t tmp[20];
+  int64_t n = 0;
+  do {
+    tmp[n++] = '0' + (v % 10);
+    v /= 10;
+  } while (v);
+  for (int64_t i = 0; i < n; i++) out[i] = tmp[n - 1 - i];
+  return n;
+}
+
+// Format n records as "row,col\n" into out (capacity cap bytes).
+// Returns bytes written, or -3 if out ran out of space.
+int64_t ptpu_format_csv(const uint64_t* rows, const uint64_t* cols, int64_t n,
+                        uint8_t* out, int64_t cap) {
+  int64_t w = 0;
+  for (int64_t i = 0; i < n; i++) {
+    if (w + 43 > cap) return -3;  // 20 + ',' + 20 + '\n' worst case
+    w += fmt_u64(rows[i], out + w);
+    out[w++] = ',';
+    w += fmt_u64(cols[i], out + w);
+    out[w++] = '\n';
+  }
+  return w;
+}
+
 }  // extern "C"

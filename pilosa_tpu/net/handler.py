@@ -636,10 +636,9 @@ class Handler:
         frag = self.holder.fragment(index, frame, view, slice_i)
         if frag is None:
             return Response.error("fragment not found", 404)
-        buf = io.StringIO()
-        for row_id, col_id in frag.for_each_bit():
-            buf.write(f"{row_id},{col_id}\n")
-        return Response(body=buf.getvalue().encode(), content_type="text/csv")
+        return Response(
+            body=b"".join(frag.csv_chunks()), content_type="text/csv"
+        )
 
     # ------------------------------------------------------------------
     # fragment internals (sync/backup data plane)

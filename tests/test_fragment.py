@@ -660,3 +660,71 @@ def test_sparse_topn_candidates(tmp_path):
         assert [(p.id, p.count) for p in got] == [(0, 50), (1, 25), (2, 10)]
     finally:
         f.close()
+
+
+def test_oplog_group_commit(tmp_path):
+    """Point writes buffer op records (no per-bit file growth) and every
+    flush boundary — explicit flush_ops, threshold, close — persists
+    them; a reopen replays the flushed ops."""
+    f = Fragment(str(tmp_path / "0"), "i", "f", "standard", 0, max_op_n=10_000)
+    f.open()
+    base = os.path.getsize(f.path)
+    f.set_bit(1, 10)
+    f.set_bit(1, 20)
+    assert os.path.getsize(f.path) == base, "ops must buffer, not write per bit"
+    assert len(f._op_buf) > 0
+    f.flush_ops()
+    assert os.path.getsize(f.path) > base
+    assert len(f._op_buf) == 0
+    # close() is a flush boundary for whatever is still buffered
+    f.set_bit(2, 30)
+    f2 = reopen(f)
+    assert f2.row(1).bits() == [10, 20]
+    assert f2.row(2).bits() == [30]
+    # threshold flush: exceed _OP_FLUSH_BYTES without any boundary
+    n_ops = Fragment._OP_FLUSH_BYTES // 13 + 2
+    before = os.path.getsize(f2.path)
+    for i in range(n_ops):
+        f2.set_bit(3, i)
+    assert os.path.getsize(f2.path) > before, "threshold flush did not fire"
+    f2.close()
+
+
+def test_csv_chunks_matches_for_each_bit(frag):
+    frag.set_bit(0, 1)
+    frag.set_bit(2, 5)
+    frag.set_bit(2, SW - 1)
+    blob = b"".join(frag.csv_chunks())
+    want = "".join(f"{r},{c}\n" for r, c in sorted(frag.for_each_bit()))
+    assert blob.decode() == want
+
+
+def test_csv_chunks_vectorized_batching(tmp_path, monkeypatch):
+    """Export must be row-block vectorized: the formatter is handed whole
+    record arrays (a handful of calls for millions of bits), never driven
+    per bit.  Structural check, deterministic on any CI speed; the
+    measured throughput (~14M pairs/s vs ~1M for the old per-bit loop)
+    is recorded in BASELINE.md."""
+    f = Fragment(str(tmp_path / "0"), "i", "f", "standard", 0, max_op_n=10**9)
+    f.open()
+    rng = np.random.default_rng(3)
+    for r in range(8):
+        cols = np.unique(rng.integers(0, SW, SW // 8))
+        f.import_bulk(np.full(len(cols), r), cols)
+    total = f.count()
+    assert total > 500_000
+
+    calls = []
+    real = Fragment._format_pairs
+
+    def spy(rws, cls):
+        calls.append(len(rws))
+        return real(rws, cls)
+
+    monkeypatch.setattr(Fragment, "_format_pairs", staticmethod(spy))
+    pairs = sum(chunk.count(b"\n") for chunk in f.csv_chunks(chunk_pairs=1 << 18))
+    f.close()
+    assert pairs == total
+    assert sum(calls) == total
+    # ceil(total / chunk) + 1 slack: each call must carry ~chunk records
+    assert len(calls) <= total // (1 << 18) + 2, calls

@@ -153,3 +153,32 @@ class TestNativeCSV:
         rows, cols = native.parse_csv(blob)
         assert rows.tolist() == r.tolist()
         assert cols.tolist() == c.tolist()
+
+
+class TestFormatCSV:
+    def test_round_trip_with_parse(self, rng):
+        n = 50_000
+        r = rng.integers(0, 1000, n).astype(np.uint64)
+        c = rng.integers(0, 10_000_000, n).astype(np.uint64)
+        blob = native.format_csv(r, c)
+        if blob is None:
+            pytest.skip("native library unavailable")
+        rows, cols = native.parse_csv(blob)
+        assert rows.tolist() == r.tolist()
+        assert cols.tolist() == c.tolist()
+
+    def test_edge_values(self):
+        r = np.array([0, 18446744073709551615], dtype=np.uint64)
+        c = np.array([18446744073709551615, 0], dtype=np.uint64)
+        blob = native.format_csv(r, c)
+        if blob is None:
+            pytest.skip("native library unavailable")
+        assert blob == (
+            b"0,18446744073709551615\n18446744073709551615,0\n"
+        )
+
+    def test_empty(self):
+        blob = native.format_csv(
+            np.empty(0, np.uint64), np.empty(0, np.uint64)
+        )
+        assert blob in (b"", None)
