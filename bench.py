@@ -11,8 +11,16 @@ Measures three tiers on the accelerator, logging all to stderr:
    parsing, leaf resolution, batch assembly/caching, reduce
    (reference path: handlePostQuery -> mapReduce,
    executor.go:1246-1282).  BASELINE's north-star metric is THIS.
-3. TopN — the real two-phase executor path over ranked-cache
-   candidates (reference: fragment.go:505-639, executor.go:281-321).
+3. TopN — the real executor path over ranked-cache candidates
+   (reference: fragment.go:505-639, executor.go:281-321; all-local
+   queries take the folded single-device-fetch protocol, which returns
+   results identical to the reference's two-phase refetch).
+
+BANDWIDTH ACCOUNTING: the fused Intersect+Count reads two operands of
+total_columns/8 bytes each and writes nothing that leaves the chip, so
+effective bytes/query = total_columns/4.  Every Gcols/s figure is
+accompanied by effective GB/s and % of HBM peak (v5e ~819 GB/s) so the
+distance to the memory-bound ceiling is visible in the artifacts.
 
 THROUGHPUT vs LATENCY: the executor tiers report (a) single-query
 synchronous p50 latency and (b) per-query time under CONCURRENT load
@@ -282,20 +290,33 @@ def main() -> None:
 
     cols_per_s = total_columns / e2e_s
     vs = host_s / e2e_s
+    # Effective traffic: 2 operands x 1/8 B/col, nothing written back.
+    bytes_per_query = total_columns / 4
+    hbm_peak = 819e9 if jax.default_backend() == "tpu" else None  # v5e
+    raw_gbs = bytes_per_query / dev_s / 1e9
+    e2e_gbs = bytes_per_query / e2e_s / 1e9
+
+    def pct_peak(gbs: float) -> str:
+        return f" = {gbs*1e9/hbm_peak*100:.1f}% of HBM peak" if hbm_peak else ""
+
     log(
-        f"raw-kernel ceiling: {total_columns/dev_s/1e9:.1f} Gcols/s;"
+        f"raw-kernel ceiling: {total_columns/dev_s/1e9:.1f} Gcols/s"
+        f" ({raw_gbs:.0f} GB/s{pct_peak(raw_gbs)});"
         f" headline: {cols_per_s/1e9:.1f} Gcols/s"
+        f" ({e2e_gbs:.0f} GB/s{pct_peak(e2e_gbs)})"
     )
-    print(
-        json.dumps(
-            {
-                "metric": metric,
-                "value": round(cols_per_s / 1e9, 3),
-                "unit": "Gcols/s",
-                "vs_baseline": round(vs, 2),
-            }
-        )
-    )
+    out = {
+        "metric": metric,
+        "value": round(cols_per_s / 1e9, 3),
+        "unit": "Gcols/s",
+        "vs_baseline": round(vs, 2),
+        "effective_gb_s": round(e2e_gbs, 1),
+        "raw_kernel_gb_s": round(raw_gbs, 1),
+    }
+    if hbm_peak:
+        out["pct_hbm_peak"] = round(e2e_gbs * 1e9 / hbm_peak * 100, 2)
+        out["raw_kernel_pct_hbm_peak"] = round(raw_gbs * 1e9 / hbm_peak * 100, 2)
+    print(json.dumps(out))
 
 
 def measure_query(
@@ -360,10 +381,11 @@ def run_executor_tiers(leaves, host_count, rng, dev_s) -> float:
             f" ({e2e_s/dev_s:.2f}x raw kernel)"
         )
 
-        # --- tier 3: TopN two-phase through the executor ----------------
+        # --- tier 3: TopN through the executor --------------------------
         # 2048 ranked-cache candidate rows in one fragment, scored against
-        # a src row; phase 2 re-fetches exact counts for the winners
-        # (reference: executor.go:281-321, BASELINE configs[2]).
+        # a src row (reference: executor.go:281-321, BASELINE configs[2]).
+        # All slices are local, so this takes the folded protocol: ONE
+        # device fetch per query where r03 paid two phases.
         from pilosa_tpu.ops import bitplane as bpl
 
         cand = rng.integers(
@@ -395,8 +417,8 @@ def run_executor_tiers(leaves, host_count, rng, dev_s) -> float:
             ex, "i", tq, check_topn, n_conc=32
         )
         log(
-            f"e2e executor TopN(n=100) two-phase over 2048 rows:"
-            f" sync p50 {t_p50*1e3:.2f} ms (incl. tunnel round trips);"
+            f"e2e executor TopN(n=100) folded single-fetch over 2048 rows:"
+            f" sync p50 {t_p50*1e3:.2f} ms (incl. tunnel round trip);"
             f" CONCURRENT {t_per_q*1e3:.2f} ms/query throughput,"
             f" p50 latency under load {t_conc_p50*1e3:.2f} ms"
         )

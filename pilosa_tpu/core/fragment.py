@@ -875,9 +875,22 @@ class Fragment:
         results = cache_mod.sort_pairs(results)
         return results[: st.n] if st.n else results
 
-    def _top_score_prepare(self, pairs: list[Pair], opt: TopOptions) -> "TopState":
-        n = 0 if (opt.row_ids) else opt.n
+    def top_candidates(self, opt: TopOptions | None = None) -> list[Pair]:
+        """The filtered candidate list phase-1 scoring would use (cache
+        ranking + threshold/tanimoto-window/attr filters) — host-only, no
+        device work.  The executor's folded TopN uses this to form the
+        cross-slice candidate union before any scoring dispatch."""
+        opt = opt or TopOptions()
+        with self._mu:
+            pairs = self._top_candidates(opt.row_ids)
+        candidates, _, _ = self._filter_candidates(pairs, opt)
+        return candidates
 
+    def _filter_candidates(
+        self, pairs: list[Pair], opt: TopOptions
+    ) -> tuple[list[Pair], int, int]:
+        """Candidate filtering on cached counts (cheap, host-side).
+        Returns (candidates, tanimoto, src_count)."""
         filters = None
         if opt.filter_field and opt.filter_values:
             filters = set()
@@ -896,7 +909,6 @@ class Fragment:
             min_tan = float(src_count * tanimoto) / 100
             max_tan = float(src_count * 100) / float(tanimoto)
 
-        # Candidate filtering on cached counts (cheap, host-side).
         candidates: list[Pair] = []
         for p in pairs:
             if p.count <= 0:
@@ -913,6 +925,11 @@ class Fragment:
                 if not attrs or attrs.get(opt.filter_field) not in filters:
                     continue
             candidates.append(p)
+        return candidates, tanimoto, src_count
+
+    def _top_score_prepare(self, pairs: list[Pair], opt: TopOptions) -> "TopState":
+        n = 0 if (opt.row_ids) else opt.n
+        candidates, tanimoto, src_count = self._filter_candidates(pairs, opt)
 
         if opt.src is None:
             # No intersection: cached counts are final.  Candidates are

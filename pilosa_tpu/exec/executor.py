@@ -27,7 +27,7 @@ from __future__ import annotations
 import threading
 from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from datetime import datetime
 
 import jax
@@ -41,7 +41,7 @@ from pilosa_tpu.core import timequantum as tq
 from pilosa_tpu.core.bitmap import RowBitmap
 from pilosa_tpu.core.cache import Pair
 from pilosa_tpu.core import fragment as fragment_mod
-from pilosa_tpu.core.fragment import TopOptions
+from pilosa_tpu.core.fragment import TopOptions, TopState
 from pilosa_tpu.core.view import VIEW_INVERSE, VIEW_STANDARD
 from pilosa_tpu.exec import plan
 from pilosa_tpu.ops import bitplane as bp
@@ -831,6 +831,14 @@ class Executor:
         ids_arg = _uint_slice_arg(c, "ids")
         n = _uint_arg(c, "n")[0]
 
+        # Folded single-round-trip path: when every slice is owned
+        # locally (single node — the common and benchmarked shape), both
+        # phases compute from ONE union scoring pass with ONE device
+        # fetch; results are identical to the two-phase protocol below.
+        if not ids_arg and not opt.remote and len(slices) > 1:
+            if self._all_slices_local(index, slices):
+                return self._execute_topn_folded(index, c, slices, opt)
+
         pairs = self._execute_topn_slices(index, c, slices, opt)
         # Phase 2 refetch only on the originating node (reference:
         # executor.go:301-321).
@@ -848,6 +856,118 @@ class Executor:
         if n and n < len(trimmed):
             trimmed = trimmed[:n]
         return trimmed
+
+    def _execute_topn_two_phase(
+        self, index: str, c: Call, slices: list[int], opt: ExecOptions, n: int
+    ) -> list[Pair]:
+        """The reference's two-round protocol, used when the folded
+        path's union guard trips."""
+        pairs = self._execute_topn_slices(index, c, slices, opt)
+        if not pairs:
+            return pairs
+        other = c.clone()
+        other.args["ids"] = sorted({p.id for p in pairs})
+        trimmed = self._execute_topn_slices(index, other, slices, opt)
+        if n and n < len(trimmed):
+            trimmed = trimmed[:n]
+        return trimmed
+
+    def _all_slices_local(self, index: str, slices: list[int]) -> bool:
+        try:
+            m = self._slices_by_node(list(self.cluster.nodes), index, slices)
+        except SliceUnavailableError:
+            return False
+        return set(m.keys()) == {self.host}
+
+    def _execute_topn_folded(
+        self, index: str, c: Call, slices: list[int], opt: ExecOptions
+    ) -> list[Pair]:
+        """Both TopN phases from one scoring pass (reference protocol:
+        executor.go:281-321 — two map/reduce rounds; here the cross-slice
+        candidate union is known after a host-only cache walk, so every
+        slice scores the WHOLE union once and the phase-1 winner
+        selection plus the phase-2 exact counts both read those scores.
+        One device round trip instead of two.)"""
+        n = _uint_arg(c, "n")[0]
+        src_rows = None
+        if len(c.children) == 1:
+            src_rows = self._eval_tree_slices_host(index, c.children[0], slices)
+        elif len(c.children) > 1:
+            raise ExecutorError("TopN() can only have one input bitmap")
+
+        # Pass 1 (host-only): per-slice filtered candidate lists.
+        per: list[tuple] = []
+        for s in slices:
+            prep = self._topn_options_for_slice(index, c, s, src_rows)
+            if prep is None:
+                continue
+            frag, topt = prep
+            per.append((frag, topt, frag.top_candidates(topt)))
+        union = sorted({p.id for _, _, cand in per for p in cand})
+        if not union:
+            return []
+        # Guard against disjoint caches: every slice scores the WHOLE
+        # union, so when the union dwarfs the largest per-slice candidate
+        # list the folded pass does more device gather+score work than
+        # the two saved round trips are worth — use the two-phase
+        # protocol instead.  Overlapping hot rows (the common shape)
+        # keep union ~= per-slice candidates and stay folded.
+        max_cand = max(len(cand) for _, _, cand in per)
+        if len(union) > max(2 * max_cand, 512):
+            return self._execute_topn_two_phase(index, c, slices, opt, n)
+
+        # Pass 2: score the union on every slice; ONE bulk fetch.
+        states: list[tuple] = []
+        for frag, topt, cand in per:
+            u_opt = replace(topt, row_ids=union)
+            states.append((frag, topt, cand, frag.top_prepare(u_opt)))
+        pending = [
+            st for _, _, _, st in states
+            if st.done is None and st.dev_counts is not None
+        ]
+        if pending:
+            fetched = jax.device_get([st.dev_counts for st in pending])
+            for st, arr in zip(pending, fetched):
+                st.counts = arr
+
+        # Phase-1 winner selection per slice, from the same scores the
+        # two-phase protocol's first round would have produced for the
+        # slice's own candidates (cand is a subset of the union).
+        merged_phase1: list[Pair] = []
+        fulls: list[list[Pair]] = []
+        for frag, topt, cand, st in states:
+            full = frag.top_finish(st)  # exact filtered pairs over union
+            fulls.append(full)
+            if topt.src is None:
+                winners = cand[: topt.n] if topt.n else cand
+            elif st.done is not None:
+                # The union scoring short-circuited (src segment absent
+                # from this slice, or no union candidate present in its
+                # tiers): phase 1 over the slice's own candidates — a
+                # subset — would have short-circuited identically.
+                winners = st.done
+            else:
+                own = TopState(
+                    candidates=cand,
+                    by_id=dict(st.by_id),
+                    n=topt.n,
+                    tanimoto=st.tanimoto,
+                    src_count=st.src_count,
+                    min_threshold=st.min_threshold,
+                )
+                winners = frag.top_finish(own)
+            merged_phase1 = cache_mod.add_pairs(merged_phase1, winners)
+        ids2 = {p.id for p in merged_phase1}
+        if not ids2:
+            return []
+
+        # Phase-2 equivalent: exact counts for the winner union, already
+        # in hand.
+        final: list[Pair] = []
+        for full in fulls:
+            final = cache_mod.add_pairs(final, [p for p in full if p.id in ids2])
+        final = cache_mod.sort_pairs(final)
+        return final[:n] if n and n < len(final) else final
 
     def _execute_topn_slices(
         self, index: str, c: Call, slices: list[int], opt: ExecOptions
@@ -898,13 +1018,11 @@ class Executor:
         pairs = self._map_reduce(index, slices, c, opt, map_fn, reduce_fn) or []
         return cache_mod.sort_pairs(pairs)
 
-    def _prepare_topn_slice(
-        self, index: str, c: Call, slice_i: int, src_rows=None
-    ):
+    def _topn_options_for_slice(self, index: str, c: Call, slice_i: int, src_rows=None):
         """reference: executor.go:346-415.  ``src_rows`` carries the
         host-evaluated src rows from _execute_topn_slices.  Returns
-        ``(fragment, TopState)`` with the score kernel dispatched but
-        NOT fetched, or None when the fragment does not exist."""
+        ``(fragment, TopOptions)``, or None when the fragment does not
+        exist."""
         frame = c.args.get("frame") or DEFAULT_FRAME
         inverse = bool(c.args.get("inverse", False))
         n = _uint_arg(c, "n")[0]
@@ -929,17 +1047,26 @@ class Executor:
             min_threshold = MIN_THRESHOLD
         if tanimoto > 100:
             raise ExecutorError("Tanimoto Threshold is from 1 to 100 only")
-        return f, f.top_prepare(
-            TopOptions(
-                n=n,
-                src=src,
-                row_ids=row_ids,
-                filter_field=fld,
-                filter_values=list(filters) if filters else None,
-                min_threshold=min_threshold,
-                tanimoto_threshold=tanimoto,
-            )
+        return f, TopOptions(
+            n=n,
+            src=src,
+            row_ids=row_ids,
+            filter_field=fld,
+            filter_values=list(filters) if filters else None,
+            min_threshold=min_threshold,
+            tanimoto_threshold=tanimoto,
         )
+
+    def _prepare_topn_slice(
+        self, index: str, c: Call, slice_i: int, src_rows=None
+    ):
+        """``(fragment, TopState)`` with the score kernel dispatched but
+        NOT fetched, or None when the fragment does not exist."""
+        prep = self._topn_options_for_slice(index, c, slice_i, src_rows)
+        if prep is None:
+            return None
+        f, topt = prep
+        return f, f.top_prepare(topt)
 
     # ------------------------------------------------------------------
     # writes (reference: executor.go:642-840)

@@ -637,3 +637,115 @@ def test_topn_inverse_orientation(ex, holder):
         "TopN(Bitmap(columnID=5, frame=f), frame=f, inverse=true, n=3)",
     )
     assert [(p.id, p.count) for p in pairs] == [(5, 4), (9, 2), (2, 1)]
+
+
+def test_topn_folded_matches_two_phase(holder):
+    """The folded single-round-trip TopN must return exactly what the
+    two-phase protocol returns, across random multi-slice data, with and
+    without a src bitmap / n / threshold."""
+    import numpy as np
+
+    rng = np.random.default_rng(11)
+    c = new_cluster(1)
+    e = Executor(holder, host=c.nodes[0].host, cluster=c)
+    holder.create_index("i").create_frame("f", cache_size=8)
+    bits = []
+    for s in range(5):
+        base = s * SLICE_WIDTH
+        for r in range(20):
+            for col in rng.integers(0, 200, rng.integers(1, 40)):
+                bits.append((r, base + int(col)))
+    must_set_bits(holder, "i", "f", bits)
+
+    # Row 90 exists ONLY in slice 0: a src that is absent from the other
+    # slices' fragments exercises the short-circuited TopState branch.
+    bits2 = [(90, int(c)) for c in rng.integers(0, 200, 30)]
+    must_set_bits(holder, "i", "f", bits2)
+
+    queries = [
+        "TopN(frame=f, n=3)",
+        "TopN(frame=f)",
+        "TopN(Bitmap(rowID=0, frame=f), frame=f, n=4)",
+        "TopN(Bitmap(rowID=1, frame=f), frame=f)",
+        "TopN(Bitmap(rowID=2, frame=f), frame=f, n=5, threshold=2)",
+        "TopN(Bitmap(rowID=0, frame=f), frame=f, n=3, tanimotoThreshold=20)",
+        "TopN(Bitmap(rowID=90, frame=f), frame=f, n=4)",
+    ]
+    for pql in queries:
+        (folded,) = q(e, "i", pql)
+        # Force the two-phase protocol by pretending not all local.
+        orig = Executor._all_slices_local
+        Executor._all_slices_local = lambda self, index, slices: False
+        try:
+            (two_phase,) = q(e, "i", pql)
+        finally:
+            Executor._all_slices_local = orig
+        assert [(p.id, p.count) for p in folded] == [
+            (p.id, p.count) for p in two_phase
+        ], pql
+
+
+def test_topn_folded_single_device_fetch(holder, monkeypatch):
+    """The folded path issues at most ONE jax.device_get for the whole
+    query (the two-phase path needs one per phase)."""
+    import jax as _jax
+
+    c = new_cluster(1)
+    e = Executor(holder, host=c.nodes[0].host, cluster=c)
+    holder.create_index("i").create_frame("f")
+    bits = []
+    for s in range(4):
+        base = s * SLICE_WIDTH
+        bits += [(r, base + col) for r in range(6) for col in range(0, 50, r + 1)]
+    must_set_bits(holder, "i", "f", bits)
+
+    calls = []
+    real = _jax.device_get
+
+    def spy(x):
+        calls.append(1)
+        return real(x)
+
+    monkeypatch.setattr(_jax, "device_get", spy)
+    (pairs,) = q(e, "i", "TopN(Bitmap(rowID=0, frame=f), frame=f, n=3)")
+    assert pairs
+    assert sum(calls) <= 1, f"folded TopN used {sum(calls)} device fetches"
+
+
+def test_topn_folded_disjoint_caches_guard(holder):
+    """Slices whose ranked caches hold disjoint hot rows: the union
+    guard must route to the two-phase protocol (no O(S^2) union scoring)
+    and results must stay exact."""
+    import numpy as np
+
+    c = new_cluster(1)
+    e = Executor(holder, host=c.nodes[0].host, cluster=c)
+    holder.create_index("i").create_frame("f", cache_size=600)
+    bits = []
+    # 4 slices x 600 distinct rows each (rows don't overlap across
+    # slices), so the union is ~4x any per-slice candidate list.
+    for s in range(4):
+        base = s * SLICE_WIDTH
+        for r in range(s * 600, (s + 1) * 600):
+            bits.append((r, base + (r % 100)))
+            if r % 3 == 0:
+                bits.append((r, base + 200 + (r % 50)))
+    must_set_bits(holder, "i", "f", bits)
+
+    calls = []
+    orig = Executor._execute_topn_two_phase
+
+    def spy(self, *a, **k):
+        calls.append(1)
+        return orig(self, *a, **k)
+
+    Executor._execute_topn_two_phase = spy
+    try:
+        (pairs,) = q(e, "i", "TopN(frame=f, n=5)")
+    finally:
+        Executor._execute_topn_two_phase = orig
+    assert calls, "union guard did not fall back to two-phase"
+    assert len(pairs) == 5
+    # every returned count must be exact (2 bits for rows % 3 == 0)
+    for p in pairs:
+        assert p.count == 2
