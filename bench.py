@@ -137,6 +137,23 @@ def with_retries(label: str, fn, attempts: int = 3, delay_s: float = 90.0):
             wait_for_backend(attempts=3, delay_s=60.0)
 
 
+def hbm_peak_bytes_s(jax_mod) -> float | None:
+    """Per-generation HBM peak for the %-of-peak roofline figure; None
+    (omit the percentage) for unrecognized device kinds rather than
+    reporting against the wrong ceiling."""
+    kind = jax_mod.devices()[0].device_kind.lower()
+    for pat, peak in (
+        ("v5 lite", 819e9), ("v5e", 819e9), ("v5litepod", 819e9),
+        ("v6 lite", 1640e9), ("v6e", 1640e9),
+        ("v5p", 2765e9), ("v5", 2765e9),
+        ("v4", 1228e9), ("v3", 900e9), ("v2", 700e9),
+    ):
+        if pat in kind:
+            return peak
+    log(f"unknown TPU device kind {kind!r}: omitting %-of-HBM-peak")
+    return None
+
+
 def build_holder(leaves: np.ndarray, data_dir: str):
     """A real Holder with one fragment per slice holding rows {1, 2}
     from ``leaves`` (uint32[n_slices, 2, words]) — plane-injected (the
@@ -292,7 +309,7 @@ def main() -> None:
     vs = host_s / e2e_s
     # Effective traffic: 2 operands x 1/8 B/col, nothing written back.
     bytes_per_query = total_columns / 4
-    hbm_peak = 819e9 if jax.default_backend() == "tpu" else None  # v5e
+    hbm_peak = hbm_peak_bytes_s(jax) if jax.default_backend() == "tpu" else None
     raw_gbs = bytes_per_query / dev_s / 1e9
     e2e_gbs = bytes_per_query / e2e_s / 1e9
 

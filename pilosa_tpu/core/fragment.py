@@ -927,6 +927,28 @@ class Fragment:
             candidates.append(p)
         return candidates, tanimoto, src_count
 
+    def top_select(self, st: "TopState", candidates: list[Pair], n: int) -> list[Pair]:
+        """Winner selection for a candidate SUBSET of a union scoring
+        pass (the executor's folded TopN): returns what phase-1 scoring
+        of exactly ``candidates`` would have produced, reading scores
+        from ``st``.  Calls top_finish(st) itself, so it is correct
+        regardless of whether the caller already resolved ``st``."""
+        self.top_finish(st)  # idempotent; guarantees st.by_id is complete
+        if st.done is not None:
+            # Union scoring short-circuited (no src segment here / no
+            # union candidate in this fragment's tiers): scoring the
+            # subset would short-circuit identically.
+            return st.done
+        own = TopState(
+            candidates=candidates,
+            by_id=dict(st.by_id),
+            n=n,
+            tanimoto=st.tanimoto,
+            src_count=st.src_count,
+            min_threshold=st.min_threshold,
+        )
+        return self.top_finish(own)
+
     def _top_score_prepare(self, pairs: list[Pair], opt: TopOptions) -> "TopState":
         n = 0 if (opt.row_ids) else opt.n
         candidates, tanimoto, src_count = self._filter_candidates(pairs, opt)

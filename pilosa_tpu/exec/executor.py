@@ -41,7 +41,7 @@ from pilosa_tpu.core import timequantum as tq
 from pilosa_tpu.core.bitmap import RowBitmap
 from pilosa_tpu.core.cache import Pair
 from pilosa_tpu.core import fragment as fragment_mod
-from pilosa_tpu.core.fragment import TopOptions, TopState
+from pilosa_tpu.core.fragment import TopOptions
 from pilosa_tpu.core.view import VIEW_INVERSE, VIEW_STANDARD
 from pilosa_tpu.exec import plan
 from pilosa_tpu.ops import bitplane as bp
@@ -850,12 +850,7 @@ class Executor:
         # would recompute identical counts at double the latency.
         if len(slices) <= 1:
             return pairs[:n] if n and n < len(pairs) else pairs
-        other = c.clone()
-        other.args["ids"] = sorted({p.id for p in pairs})
-        trimmed = self._execute_topn_slices(index, other, slices, opt)
-        if n and n < len(trimmed):
-            trimmed = trimmed[:n]
-        return trimmed
+        return self._topn_refetch(index, c, slices, opt, n, pairs)
 
     def _execute_topn_two_phase(
         self, index: str, c: Call, slices: list[int], opt: ExecOptions, n: int
@@ -865,6 +860,18 @@ class Executor:
         pairs = self._execute_topn_slices(index, c, slices, opt)
         if not pairs:
             return pairs
+        return self._topn_refetch(index, c, slices, opt, n, pairs)
+
+    def _topn_refetch(
+        self,
+        index: str,
+        c: Call,
+        slices: list[int],
+        opt: ExecOptions,
+        n: int,
+        pairs: list[Pair],
+    ) -> list[Pair]:
+        """Phase 2: exact counts for the phase-1 winner union."""
         other = c.clone()
         other.args["ids"] = sorted({p.id for p in pairs})
         trimmed = self._execute_topn_slices(index, other, slices, opt)
@@ -889,22 +896,23 @@ class Executor:
         selection plus the phase-2 exact counts both read those scores.
         One device round trip instead of two.)"""
         n = _uint_arg(c, "n")[0]
-        src_rows = None
-        if len(c.children) == 1:
-            src_rows = self._eval_tree_slices_host(index, c.children[0], slices)
-        elif len(c.children) > 1:
+        has_src = len(c.children) == 1
+        if len(c.children) > 1:
             raise ExecutorError("TopN() can only have one input bitmap")
 
-        # Pass 1 (host-only): per-slice filtered candidate lists.
+        # Pass 1 (host-only): per-slice candidate lists, WITHOUT
+        # evaluating the src tree yet — the union guard below must be
+        # able to fall back before any src work is spent.  A src only
+        # shrinks candidate lists (tanimoto count-window), so the
+        # src-free walk is a conservative union estimate.
         per: list[tuple] = []
         for s in slices:
-            prep = self._topn_options_for_slice(index, c, s, src_rows)
+            prep = self._topn_options_for_slice(index, c, s, None)
             if prep is None:
                 continue
             frag, topt = prep
             per.append((frag, topt, frag.top_candidates(topt)))
-        union = sorted({p.id for _, _, cand in per for p in cand})
-        if not union:
+        if not per:
             return []
         # Guard against disjoint caches: every slice scores the WHOLE
         # union, so when the union dwarfs the largest per-slice candidate
@@ -912,9 +920,27 @@ class Executor:
         # the two saved round trips are worth — use the two-phase
         # protocol instead.  Overlapping hot rows (the common shape)
         # keep union ~= per-slice candidates and stay folded.
+        union_est = {p.id for _, _, cand in per for p in cand}
+        if not union_est:
+            return []
         max_cand = max(len(cand) for _, _, cand in per)
-        if len(union) > max(2 * max_cand, 512):
+        if len(union_est) > max(2 * max_cand, 512):
             return self._execute_topn_two_phase(index, c, slices, opt, n)
+
+        if has_src:
+            # Now pay the src tree eval and re-derive candidates with
+            # the real src (tanimoto windows and scoring need it).
+            src_rows = self._eval_tree_slices_host(index, c.children[0], slices)
+            per = []
+            for s in slices:
+                prep = self._topn_options_for_slice(index, c, s, src_rows)
+                if prep is None:
+                    continue
+                frag, topt = prep
+                per.append((frag, topt, frag.top_candidates(topt)))
+        union = sorted({p.id for _, _, cand in per for p in cand})
+        if not union:
+            return []
 
         # Pass 2: score the union on every slice; ONE bulk fetch.
         states: list[tuple] = []
@@ -940,22 +966,8 @@ class Executor:
             fulls.append(full)
             if topt.src is None:
                 winners = cand[: topt.n] if topt.n else cand
-            elif st.done is not None:
-                # The union scoring short-circuited (src segment absent
-                # from this slice, or no union candidate present in its
-                # tiers): phase 1 over the slice's own candidates — a
-                # subset — would have short-circuited identically.
-                winners = st.done
             else:
-                own = TopState(
-                    candidates=cand,
-                    by_id=dict(st.by_id),
-                    n=topt.n,
-                    tanimoto=st.tanimoto,
-                    src_count=st.src_count,
-                    min_threshold=st.min_threshold,
-                )
-                winners = frag.top_finish(own)
+                winners = frag.top_select(st, cand, topt.n)
             merged_phase1 = cache_mod.add_pairs(merged_phase1, winners)
         ids2 = {p.id for p in merged_phase1}
         if not ids2:
