@@ -19,9 +19,16 @@ gossip.go:124-149).  Receivers dedup message ids so retries stay
 exactly-once.  send_async sends to ``gossip_fanout`` random members
 and relies on periodic exchange for convergence (reference:
 TransmitLimitedQueue, gossip.go:152-164).
-Liveness: members not heard from within ``suspect_after`` are marked
-DOWN (reference surface: memberlist NotifyLeave → node state DOWN,
-cluster.go:161-173).
+Liveness is SWIM-shaped, like memberlist's: a member silent past
+``suspect_after`` becomes SUSPECT — still live — and triggers one more
+direct PING plus ``indirect_probes`` PING-REQ messages through random
+third parties (relay pings the target; the target's ACK at the relay
+produces an IND-ACK back to the requester, refreshing the suspect
+without direct contact).  Only continued silence on BOTH paths for
+another ``suspect_after`` confirms DOWN, so an asymmetric partition
+(A↔B blocked, both reach C) never flaps placement (reference surface:
+memberlist indirect probing + NotifyLeave → node state DOWN,
+gossip/gossip.go:31-45, cluster.go:161-173).
 
 State sync piggybacks a ``state_provider()`` blob on PING/ACK and feeds
 received blobs to ``state_merger(blob)`` — the server wires these to
@@ -106,8 +113,19 @@ class GossipNodeSet:
         self._closing = threading.Event()
         self._threads: list[threading.Thread] = []
         self._mu = threading.Lock()
-        # member -> {addr: (ip, port), last_seen: float, state: UP|DOWN}
+        # member -> {addr: (ip, port), last_seen: float,
+        #            state: UP|SUSPECT|DOWN}.  SUSPECT is SWIM's middle
+        # state: direct pings went unanswered, indirect probes through
+        # third parties are in flight, and the member still counts as
+        # live until they too fail (memberlist semantics behind
+        # reference: gossip/gossip.go:31-45).
         self._members: dict[str, dict] = {}
+        # SWIM ping-req relay bookkeeping: suspect host -> list of
+        # (requester gossip addr, deadline) to answer with ind-ack when
+        # the suspect acks one of OUR pings.
+        self._relay_pending: dict[str, list[tuple[tuple, float]]] = {}
+        # Indirect probes to issue per suspect per tick.
+        self.indirect_probes = 2
         self.on_membership_change = None  # callback(list[(host, state)])
         # Reliable send_sync machinery: per-message ack events on the
         # sender, an id-dedup LRU on the receiver (retries stay
@@ -132,9 +150,13 @@ class GossipNodeSet:
 
     def nodes(self) -> list[str]:
         """Live members only — presence here means UP (the
-        broadcast.NodeSet contract consumed by Cluster.node_states)."""
+        broadcast.NodeSet contract consumed by Cluster.node_states).
+        SUSPECT members are still live: SWIM keeps a member until
+        indirect probes through third parties also fail."""
         with self._mu:
-            return sorted(h for h, m in self._members.items() if m["state"] == "UP")
+            return sorted(
+                h for h, m in self._members.items() if m["state"] != "DOWN"
+            )
 
     def member_states(self) -> dict[str, str]:
         with self._mu:
@@ -219,7 +241,7 @@ class GossipNodeSet:
         # retry budget, not the sum over unresponsive peers.
         threads = []
         for host, member in self._snapshot().items():
-            if host == self.host or member["state"] != "UP":
+            if host == self.host or member["state"] == "DOWN":
                 continue
             t = threading.Thread(target=deliver, args=(host, member), daemon=True)
             t.start()
@@ -236,7 +258,7 @@ class GossipNodeSet:
         peers = [
             m
             for h, m in self._snapshot().items()
-            if h != self.host and m["state"] == "UP"
+            if h != self.host and m["state"] != "DOWN"
         ]
         random.shuffle(peers)
         for member in peers[: self.gossip_fanout]:
@@ -277,14 +299,24 @@ class GossipNodeSet:
                 m["addr"] = tuple(addr)
                 m["last_seen"] = time.monotonic()
                 if m["state"] != "UP":
+                    # Only DOWN->UP is externally visible: SUSPECT
+                    # collapses to UP at the _notify boundary, so a
+                    # SUSPECT->UP refresh must not fire a spurious
+                    # membership callback every probe cycle.
+                    changed = m["state"] == "DOWN"
                     m["state"] = "UP"
-                    changed = True
         if changed:
             self._notify()
 
     def _notify(self) -> None:
         if self.on_membership_change is not None:
-            states = self.member_states()
+            # SUSPECT is internal to the SWIM protocol; the NodeSet
+            # contract (and the reference's status surface) knows only
+            # UP/DOWN, and a suspected member is still UP.
+            states = {
+                h: ("UP" if s != "DOWN" else "DOWN")
+                for h, s in self.member_states().items()
+            }
             try:
                 self.on_membership_change(sorted(states.items()))
             except Exception as e:  # noqa: BLE001
@@ -371,6 +403,50 @@ class GossipNodeSet:
             self._register(sender, _parse_addr(obj["gaddr"]))
             self._merge_members(obj.get("members", []))
             self._merge_state(obj)
+            # SWIM relay leg 3: if someone asked us to probe this
+            # sender, tell them it answered.
+            with self._mu:
+                waiters = self._relay_pending.pop(sender, [])
+            now = time.monotonic()
+            for req_addr, deadline in waiters:
+                if now <= deadline:
+                    self._send_logged(
+                        req_addr,
+                        {
+                            "t": "ind-ack",
+                            "from": self.host,
+                            "target": sender,
+                            "taddr": obj["gaddr"],
+                        },
+                    )
+        elif typ == "ping-req":
+            # SWIM relay leg 2: probe the target on the requester's
+            # behalf; our eventual ack from the target triggers ind-ack.
+            self._register(sender, _parse_addr(obj["gaddr"]))
+            target = obj.get("target", "")
+            if not target:
+                return
+            taddr = _parse_addr(obj["taddr"])
+            with self._mu:
+                self._relay_pending.setdefault(target, []).append(
+                    (_parse_addr(obj["gaddr"]), time.monotonic() + 4 * self.suspect_after)
+                )
+            self._send_logged(
+                taddr,
+                {
+                    "t": "ping",
+                    "from": self.host,
+                    "gaddr": _fmt_addr(self.advertise),
+                    "members": self._member_list(),
+                    **self._state_field(),
+                },
+            )
+        elif typ == "ind-ack":
+            # SWIM relay leg 4: a third party reached the suspect —
+            # refresh it without direct contact.
+            target = obj.get("target", "")
+            if target:
+                self._register(target, _parse_addr(obj["taddr"]))
         elif typ == "user":
             mid = obj.get("id")
             if self._handler is None:
@@ -565,17 +641,71 @@ class GossipNodeSet:
                         **self._state_field(),
                     },
                 )
-            # suspect timeouts
+            # SWIM suspect machinery: silence past suspect_after marks a
+            # member SUSPECT and fans indirect probes through third
+            # parties; only continued silence — direct AND indirect —
+            # past another suspect_after confirms DOWN.  An asymmetric
+            # partition (we can't reach B, C can) therefore never flaps
+            # B to DOWN: C's ind-ack refreshes it.
             now = time.monotonic()
             changed = False
+            suspects: list[tuple[str, dict]] = []
             with self._mu:
                 for h, m in self._members.items():
                     if h == self.host:
                         m["last_seen"] = now
                         continue
-                    if m["state"] == "UP" and now - m["last_seen"] > self.suspect_after:
+                    silent = now - m["last_seen"]
+                    if m["state"] == "UP" and silent > self.suspect_after:
+                        m["state"] = "SUSPECT"
+                    if (
+                        m["state"] == "SUSPECT"
+                        and silent > 2 * self.suspect_after
+                    ):
                         m["state"] = "DOWN"
                         changed = True
+                    elif m["state"] == "SUSPECT":
+                        # Probed EVERY tick while suspect (not only on
+                        # the transition): a lost probe round must not
+                        # be able to confirm a reachable member DOWN.
+                        suspects.append((h, dict(m)))
+                relays = [
+                    (h, m["addr"])
+                    for h, m in self._members.items()
+                    if h != self.host and m["state"] == "UP"
+                ]
+                # Expire stale relay bookkeeping.
+                for tgt in list(self._relay_pending):
+                    self._relay_pending[tgt] = [
+                        (a, d) for a, d in self._relay_pending[tgt] if d >= now
+                    ]
+                    if not self._relay_pending[tgt]:
+                        del self._relay_pending[tgt]
+            for h, m in suspects:
+                # One more direct attempt plus k indirect probes.
+                self._send_logged(
+                    m["addr"],
+                    {
+                        "t": "ping",
+                        "from": self.host,
+                        "gaddr": _fmt_addr(self.advertise),
+                        "members": self._member_list(),
+                        **self._state_field(),
+                    },
+                )
+                pool = [r for r in relays if r[0] != h]
+                random.shuffle(pool)
+                for _, relay_addr in pool[: self.indirect_probes]:
+                    self._send_logged(
+                        relay_addr,
+                        {
+                            "t": "ping-req",
+                            "from": self.host,
+                            "gaddr": _fmt_addr(self.advertise),
+                            "target": h,
+                            "taddr": _fmt_addr(m["addr"]),
+                        },
+                    )
             if changed:
                 self._notify()
 
