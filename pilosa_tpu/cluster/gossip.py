@@ -120,10 +120,12 @@ class GossipNodeSet:
         # live until they too fail (memberlist semantics behind
         # reference: gossip/gossip.go:31-45).
         self._members: dict[str, dict] = {}
-        # SWIM ping-req relay bookkeeping: suspect host -> list of
-        # (requester gossip addr, deadline) to answer with ind-ack when
-        # the suspect acks one of OUR pings.
-        self._relay_pending: dict[str, list[tuple[tuple, float]]] = {}
+        # SWIM ping-req relay bookkeeping: suspect host -> {requester
+        # gossip addr: deadline} to answer with ind-ack when the suspect
+        # acks one of OUR pings.  Keyed by requester so repeated
+        # ping-reqs from the same suspecting node refresh one entry
+        # instead of accumulating an ind-ack burst.
+        self._relay_pending: dict[str, dict[tuple, float]] = {}
         # Indirect probes to issue per suspect per tick.
         self.indirect_probes = 2
         self.on_membership_change = None  # callback(list[(host, state)])
@@ -406,7 +408,7 @@ class GossipNodeSet:
             # SWIM relay leg 3: if someone asked us to probe this
             # sender, tell them it answered.
             with self._mu:
-                waiters = self._relay_pending.pop(sender, [])
+                waiters = list(self._relay_pending.pop(sender, {}).items())
             now = time.monotonic()
             for req_addr, deadline in waiters:
                 if now <= deadline:
@@ -428,9 +430,9 @@ class GossipNodeSet:
                 return
             taddr = _parse_addr(obj["taddr"])
             with self._mu:
-                self._relay_pending.setdefault(target, []).append(
-                    (_parse_addr(obj["gaddr"]), time.monotonic() + 4 * self.suspect_after)
-                )
+                self._relay_pending.setdefault(target, {})[
+                    _parse_addr(obj["gaddr"])
+                ] = time.monotonic() + 4 * self.suspect_after
             self._send_logged(
                 taddr,
                 {
@@ -658,9 +660,15 @@ class GossipNodeSet:
                     silent = now - m["last_seen"]
                     if m["state"] == "UP" and silent > self.suspect_after:
                         m["state"] = "SUSPECT"
+                        # DOWN is anchored to SUSPECT entry, not to
+                        # last_seen: even after a tick-loop stall the
+                        # member gets one full probed window before it
+                        # can be confirmed DOWN.
+                        m["suspect_since"] = now
                     if (
                         m["state"] == "SUSPECT"
-                        and silent > 2 * self.suspect_after
+                        and now - m.get("suspect_since", now)
+                        > self.suspect_after
                     ):
                         m["state"] = "DOWN"
                         changed = True
@@ -676,9 +684,9 @@ class GossipNodeSet:
                 ]
                 # Expire stale relay bookkeeping.
                 for tgt in list(self._relay_pending):
-                    self._relay_pending[tgt] = [
-                        (a, d) for a, d in self._relay_pending[tgt] if d >= now
-                    ]
+                    self._relay_pending[tgt] = {
+                        a: d for a, d in self._relay_pending[tgt].items() if d >= now
+                    }
                     if not self._relay_pending[tgt]:
                         del self._relay_pending[tgt]
             for h, m in suspects:

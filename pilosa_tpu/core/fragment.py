@@ -33,6 +33,7 @@ import io
 import itertools
 import json
 import math
+import mmap
 import os
 import tarfile
 import threading
@@ -265,24 +266,55 @@ class Fragment:
                 self._file.close()
                 self._file = None
                 raise FragmentError(f"fragment file locked: {self.path}") from e
-            self._file.seek(0)
-            data = self._file.read()
-            if not data:
-                # Seed an empty roaring header so subsequent op-log appends
-                # produce a parseable file (reference: fragment.go:187-242
-                # unmarshals the file before attaching the op writer).
-                self._file.write(roaring.encode({}))
-                self._file.flush()
-            else:
-                # Tiered decode: array containers stay as value arrays,
-                # so a tall-sparse file loads in O(set bits).
-                words, arrays, op_n = roaring.decode_tiered(data)
-                self._load_tiered(words, arrays)
-                # replayed-op count feeds snapshot bookkeeping
-                self._op_n = op_n
-            self._open_cache()
+            try:
+                self._open_storage()
+                self._open_cache()
+            except BaseException:
+                # A failed open must not leave the file locked — the
+                # flock would block every retry (and any other Fragment
+                # on the path) until process exit.
+                fcntl.flock(self._file.fileno(), fcntl.LOCK_UN)
+                self._file.close()
+                self._file = None
+                raise
             self._version += 1
             self._opened = True
+
+    def _open_storage(self) -> None:
+        size = os.fstat(self._file.fileno()).st_size
+        if size == 0:
+            # Seed an empty roaring header so subsequent op-log appends
+            # produce a parseable file (reference: fragment.go:187-242
+            # unmarshals the file before attaching the op writer).
+            self._file.write(roaring.encode({}))
+            self._file.flush()
+            return
+        # Tiered decode straight out of an mmap of the file: the
+        # bytes are never duplicated on the heap, so peak RSS on
+        # open is the TIER size, not 2x the file (reference
+        # mmaps and zero-copies containers, fragment.go:154-242,
+        # roaring/roaring.go:567-620).  Array containers stay as
+        # value arrays, so a tall-sparse file loads in O(set
+        # bits).
+        mm = mmap.mmap(self._file.fileno(), 0, access=mmap.ACCESS_READ)
+        err = None
+        try:
+            words, arrays, op_n = roaring.decode_tiered(mm)
+        except roaring.CorruptError as e:
+            # A decode failure's traceback frames hold buffer
+            # views of the mmap; closing it here would raise
+            # BufferError and mask the corruption diagnosis.
+            # Capture the message, let the except block drop the
+            # traceback (and with it the views), then close and
+            # re-raise cleanly.
+            err = str(e)
+        if err is not None:
+            mm.close()
+            raise roaring.CorruptError(err)
+        mm.close()
+        self._load_tiered(words, arrays)
+        # replayed-op count feeds snapshot bookkeeping
+        self._op_n = op_n
 
     def close(self) -> None:
         with self._mu:

@@ -190,14 +190,23 @@ def decode(data: bytes):
         l.ptpu_free(h)
 
 
-def decode_tiered(data: bytes):
-    """Roaring file -> ({key: uint64[1024]}, {key: sorted uint32 values},
-    op_count) or None.  Array containers never materialize to words —
-    the tall-sparse loading path (see ops/roaring.decode_tiered)."""
+def decode_tiered(data):
+    """Roaring file bytes OR buffer (mmap/memoryview) ->
+    ({key: uint64[1024]}, {key: sorted uint32 values}, op_count) or
+    None.  Array containers never materialize to words — the
+    tall-sparse loading path (see ops/roaring.decode_tiered).  Buffer
+    inputs are read in place (no bytes copy): fragment open mmaps the
+    file and decodes straight out of the page cache."""
     l = lib()
     if l is None:
         return None
-    h = l.ptpu_decode_tiered(data, len(data))
+    if isinstance(data, (bytes, bytearray)):
+        buf, buf_len = bytes(data), len(data)
+    else:
+        # Zero-copy pointer into the buffer; `arr` pins it for the call.
+        arr = np.frombuffer(data, dtype=np.uint8)
+        buf, buf_len = ctypes.c_char_p(arr.ctypes.data), len(arr)
+    h = l.ptpu_decode_tiered(buf, buf_len)
     try:
         err = l.ptpu_t_error(h)
         if err is not None:

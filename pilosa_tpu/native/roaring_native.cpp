@@ -177,16 +177,47 @@ const char* ptpu_error(void* h) {
 // word vectors.  Mirrors ops/roaring.decode_tiered.
 // ---------------------------------------------------------------------------
 
+// Tiered decode result.  Containers UNTOUCHED by the op-log are kept as
+// offsets into the caller's input buffer (typically an mmap of the data
+// file) and only memcpy'd once, straight into the caller's numpy
+// arrays at extract time; op-touched containers materialize
+// copy-on-write.  Post-snapshot files carry at most a few thousand ops,
+// so this keeps peak native heap at O(touched) instead of O(file).
+// The input pointer must stay valid until ptpu_t_extract — the Python
+// wrapper performs decode+extract in one call while holding the mmap.
 struct Tiered {
-  std::map<uint64_t, std::vector<uint64_t>> words;
-  std::map<uint64_t, std::vector<uint32_t>> arrays;
+  const uint8_t* input = nullptr;
+  std::map<uint64_t, int64_t> word_offs;    // key -> input offset
+  std::map<uint64_t, std::vector<uint64_t>> words;  // op-touched
+  struct ArrRef { int64_t off; int64_t n; };
+  std::map<uint64_t, ArrRef> array_offs;    // key -> input run
+  std::map<uint64_t, std::vector<uint32_t>> arrays;  // op-touched
   int64_t ops = 0;
   int64_t total_vals = 0;
   std::string error;
+
+  void materialize_words(uint64_t key) {
+    auto it = word_offs.find(key);
+    if (it == word_offs.end()) return;
+    std::vector<uint64_t> w(kContainerWords);
+    std::memcpy(w.data(), input + it->second, kContainerWords * 8);
+    words[key] = std::move(w);
+    word_offs.erase(it);
+  }
+
+  void materialize_array(uint64_t key) {
+    auto it = array_offs.find(key);
+    if (it == array_offs.end()) return;
+    std::vector<uint32_t> vals((size_t)it->second.n);
+    std::memcpy(vals.data(), input + it->second.off, (size_t)it->second.n * 4);
+    arrays[key] = std::move(vals);
+    array_offs.erase(it);
+  }
 };
 
 void* ptpu_decode_tiered(const uint8_t* data, int64_t len) {
   auto* t = new Tiered();
+  t->input = data;
   if (len < kHeaderSize) {
     t->error = "data too small";
     return t;
@@ -219,11 +250,10 @@ void* ptpu_decode_tiered(const uint8_t* data, int64_t len) {
       return t;
     }
     if (n <= kArrayMaxSize) {
-      std::vector<uint32_t> vals((size_t)n);
-      std::memcpy(vals.data(), data + offset, (size_t)n * 4);
+      // Validate in place; store only the input run.
       uint32_t prev = 0;
       for (int64_t j = 0; j < n; j++) {
-        uint32_t v = vals[(size_t)j];
+        uint32_t v = rd32(data + offset + j * 4);
         if (v >= kContainerBits) {
           t->error = "array value out of range";
           return t;
@@ -235,11 +265,9 @@ void* ptpu_decode_tiered(const uint8_t* data, int64_t len) {
         prev = v;
       }
       t->total_vals += n;
-      t->arrays[key] = std::move(vals);
+      t->array_offs[key] = Tiered::ArrRef{(int64_t)offset, n};
     } else {
-      std::vector<uint64_t> words(kContainerWords);
-      std::memcpy(words.data(), data + offset, kContainerWords * 8);
-      t->words[key] = std::move(words);
+      t->word_offs[key] = (int64_t)offset;
     }
     int64_t end = (int64_t)offset + payload;
     if (end > ops_offset) ops_offset = end;
@@ -265,6 +293,9 @@ void* ptpu_decode_tiered(const uint8_t* data, int64_t len) {
     }
     uint64_t key = value >> 16;
     uint32_t low = (uint32_t)(value & 0xFFFF);
+    // Copy-on-write: an op touching an offset-tier container
+    // materializes it first.
+    t->materialize_words(key);
     auto wit = t->words.find(key);
     if (wit != t->words.end()) {
       uint64_t mask = (uint64_t)1 << (low & 63);
@@ -273,6 +304,7 @@ void* ptpu_decode_tiered(const uint8_t* data, int64_t len) {
       else
         wit->second[low >> 6] &= ~mask;
     } else {
+      t->materialize_array(key);
       auto& vals = t->arrays[key];  // creates empty on first touch
       auto it = std::lower_bound(vals.begin(), vals.end(), low);
       bool present = it != vals.end() && *it == low;
@@ -300,27 +332,57 @@ int64_t ptpu_t_ops(void* h) { return static_cast<Tiered*>(h)->ops; }
 void ptpu_t_counts(void* h, int64_t* n_words, int64_t* n_arrays,
                    int64_t* total_vals) {
   auto* t = static_cast<Tiered*>(h);
-  *n_words = (int64_t)t->words.size();
-  *n_arrays = (int64_t)t->arrays.size();
+  *n_words = (int64_t)(t->words.size() + t->word_offs.size());
+  *n_arrays = (int64_t)(t->arrays.size() + t->array_offs.size());
   *total_vals = t->total_vals;
 }
 
 // Fill wkeys[nw], wwords[nw*1024], akeys[na], alens[na], avals[total].
 void ptpu_t_extract(void* h, uint64_t* wkeys, uint64_t* wwords, uint64_t* akeys,
                     int64_t* alens, uint32_t* avals) {
+  // Two-way sorted merge of the offset tier (copied straight from the
+  // caller's input buffer — its single copy) and the op-touched tier.
   auto* t = static_cast<Tiered*>(h);
   int64_t i = 0;
-  for (const auto& [key, w] : t->words) {
-    wkeys[i] = key;
-    std::memcpy(wwords + i * kContainerWords, w.data(), kContainerWords * 8);
+  auto wo = t->word_offs.begin();
+  auto wm = t->words.begin();
+  while (wo != t->word_offs.end() || wm != t->words.end()) {
+    bool take_off =
+        wm == t->words.end() ||
+        (wo != t->word_offs.end() && wo->first < wm->first);
+    if (take_off) {
+      wkeys[i] = wo->first;
+      std::memcpy(wwords + i * kContainerWords, t->input + wo->second,
+                  kContainerWords * 8);
+      ++wo;
+    } else {
+      wkeys[i] = wm->first;
+      std::memcpy(wwords + i * kContainerWords, wm->second.data(),
+                  kContainerWords * 8);
+      ++wm;
+    }
     i++;
   }
   int64_t j = 0, at = 0;
-  for (const auto& [key, vals] : t->arrays) {
-    akeys[j] = key;
-    alens[j] = (int64_t)vals.size();
-    std::memcpy(avals + at, vals.data(), vals.size() * 4);
-    at += (int64_t)vals.size();
+  auto ao = t->array_offs.begin();
+  auto am = t->arrays.begin();
+  while (ao != t->array_offs.end() || am != t->arrays.end()) {
+    bool take_off =
+        am == t->arrays.end() ||
+        (ao != t->array_offs.end() && ao->first < am->first);
+    if (take_off) {
+      akeys[j] = ao->first;
+      alens[j] = ao->second.n;
+      std::memcpy(avals + at, t->input + ao->second.off, ao->second.n * 4);
+      at += ao->second.n;
+      ++ao;
+    } else {
+      akeys[j] = am->first;
+      alens[j] = (int64_t)am->second.size();
+      std::memcpy(avals + at, am->second.data(), am->second.size() * 4);
+      at += (int64_t)am->second.size();
+      ++am;
+    }
     j++;
   }
 }

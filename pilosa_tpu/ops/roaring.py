@@ -104,7 +104,7 @@ def decode_with_ops(data: bytes) -> tuple[dict[int, np.ndarray], int]:
 
 
 def decode_tiered(
-    data: bytes,
+    data,
 ) -> tuple[dict[int, np.ndarray], dict[int, np.ndarray], int]:
     """Decode keeping each container in its cheapest form:
     ``(words, arrays, op_n)`` where ``words[key]`` is uint64[1024] (bitmap
@@ -114,6 +114,13 @@ def decode_tiered(
     array container per row), where materializing every container would
     cost rows x 8 KiB (reference keeps the same two forms in memory,
     roaring/roaring.go:893-906).
+
+    ``data`` may be bytes or any readable buffer (mmap, memoryview):
+    both decoders read it in place and every returned array is a fresh
+    copy, so the buffer can be closed immediately after (reference
+    analog: zero-copy container attach straight out of the mmap,
+    roaring/roaring.go:567-620 — here tiers are materialized instead,
+    but the FILE bytes are never duplicated in memory).
 
     Dispatches to the C++ tiered decoder when available; the pure-Python
     path below is the fallback and parity oracle."""

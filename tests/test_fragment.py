@@ -728,3 +728,24 @@ def test_csv_chunks_vectorized_batching(tmp_path, monkeypatch):
     assert sum(calls) == total
     # ceil(total / chunk) + 1 slack: each call must carry ~chunk records
     assert len(calls) <= total // (1 << 18) + 2, calls
+
+
+def test_open_corrupt_file_raises_corrupt_error(tmp_path):
+    """A corrupt data file must surface roaring.CorruptError from open,
+    not a BufferError from closing the mmap while decode-exception
+    traceback frames still hold buffer views of it."""
+    from pilosa_tpu.ops import roaring as roaring_mod
+
+    path = tmp_path / "0"
+    path.write_bytes(b"\x00" * 64)  # wrong cookie
+    f = Fragment(str(path), "i", "f", "standard", 0)
+    with pytest.raises(roaring_mod.CorruptError):
+        f.open()
+    # and with the native decoder disabled (pure-Python buffer views)
+    import os as _os
+    from unittest import mock
+
+    f2 = Fragment(str(path), "i", "f", "standard", 0)
+    with mock.patch.dict(_os.environ, {"PILOSA_TPU_DISABLE_NATIVE": "1"}):
+        with pytest.raises(roaring_mod.CorruptError):
+            f2.open()

@@ -136,3 +136,43 @@ def test_malformed_header_and_values():
     with pytest.raises(roaring.CorruptError, match="out of range"):
         roaring.decode(bytes(corrupt))
     assert roaring.check(bytes(corrupt))
+
+
+def test_decode_tiered_mmap_parity(tmp_path):
+    """decode_tiered over an mmap (the fragment-open path: zero heap
+    copy of the file bytes, offset-tier + copy-on-write op replay in
+    the native decoder) must equal decode_tiered over bytes, including
+    ops that mutate both container kinds."""
+    import mmap as mmap_mod
+
+    # bitmap container (key 0) + array container (key 9)
+    words = {0: np.zeros(1024, dtype=np.uint64)}
+    words[0][:] = np.arange(1024, dtype=np.uint64) * np.uint64(2654435761)
+    arrays = {9: np.array([1, 5, 1000], dtype=np.uint32)}
+    blob = bytearray(roaring.encode_tiered(words, arrays))
+    # ops: set+clear in the bitmap container, insert in the array one,
+    # and create a brand-new key
+    blob += roaring.encode_op(roaring.OP_ADD, 7)
+    blob += roaring.encode_op(roaring.OP_REMOVE, 64)
+    blob += roaring.encode_op(roaring.OP_ADD, 9 * (1 << 16) + 6)
+    blob += roaring.encode_op(roaring.OP_ADD, 33 * (1 << 16) + 2)
+    path = tmp_path / "d"
+    path.write_bytes(bytes(blob))
+
+    w_b, a_b, ops_b = roaring.decode_tiered(bytes(blob))
+    with open(path, "rb") as f:
+        mm = mmap_mod.mmap(f.fileno(), 0, access=mmap_mod.ACCESS_READ)
+        try:
+            w_m, a_m, ops_m = roaring.decode_tiered(mm)
+        finally:
+            mm.close()
+    assert ops_b == ops_m == 4
+    assert sorted(w_b) == sorted(w_m)
+    for k in w_b:
+        np.testing.assert_array_equal(w_b[k], w_m[k])
+    assert sorted(a_b) == sorted(a_m)
+    for k in a_b:
+        np.testing.assert_array_equal(a_b[k], a_m[k])
+    # the returned arrays must be OWNING copies, valid after mm.close()
+    assert all(w.flags.owndata or w.base is not mm for w in w_m.values())
+    assert int(w_m[0][0]) == int(w_b[0][0])
