@@ -39,7 +39,7 @@ import tarfile
 import threading
 import time
 from collections import OrderedDict
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from typing import Any, Iterable, Sequence
 
 import numpy as np
@@ -1062,14 +1062,42 @@ class Fragment:
             return self.cache.top()
         pairs = []
         for row_id in row_ids:
-            n = self.cache.get(row_id)
-            if n > 0:
-                pairs.append(Pair(row_id, n))
-                continue
-            n = self.row(row_id).count()
+            n = self._row_count_locked(row_id)
             if n > 0:
                 pairs.append(Pair(row_id, n))
         return cache_mod.sort_pairs(pairs)
+
+    def _row_count_locked(self, row_id: int) -> int:
+        """Count resolution for candidate listing (callers hold _mu):
+        cached ranking first, then the O(1) maintained count, with
+        full-row materialization (128 KiB unpack) only as a consistency
+        safety net."""
+        n = self.cache.get(row_id)
+        if n <= 0 and (row_id in self._slot_of or row_id in self._sparse):
+            n = self._count_of.get(row_id, 0)
+            if n <= 0:
+                n = self.row(row_id).count()
+        return n
+
+    def top_prepare_union(
+        self, union: list[int], cand: list[Pair], opt: TopOptions
+    ) -> "TopState":
+        """The folded executor TopN's union scoring pass: equivalent to
+        ``top_prepare(replace(opt, row_ids=union))`` but reuses the
+        already-listed candidate Pairs, constructing new ones only for
+        union ids this slice's own cache walk didn't produce (foreign
+        winners) — O(missing) host work instead of O(union)."""
+        have = {p.id for p in cand}
+        pairs = list(cand)
+        with self._mu:
+            for rid in union:
+                if rid in have:
+                    continue
+                n = self._row_count_locked(rid)
+                if n > 0:
+                    pairs.append(Pair(rid, n))
+        pairs = cache_mod.sort_pairs(pairs)
+        return self._top_score_prepare(pairs, replace(opt, row_ids=union))
 
     # ------------------------------------------------------------------
     # block checksums + sync (reference: fragment.go:694-934)

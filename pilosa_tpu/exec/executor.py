@@ -27,7 +27,7 @@ from __future__ import annotations
 import threading
 from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
-from dataclasses import dataclass, field, replace
+from dataclasses import dataclass, field
 from datetime import datetime
 
 import jax
@@ -942,11 +942,14 @@ class Executor:
         if not union:
             return []
 
-        # Pass 2: score the union on every slice; ONE bulk fetch.
+        # Pass 2: score the union on every slice; ONE bulk fetch.  The
+        # union pass reuses each slice's candidate Pairs and constructs
+        # only the foreign winners' (top_prepare_union).
         states: list[tuple] = []
         for frag, topt, cand in per:
-            u_opt = replace(topt, row_ids=union)
-            states.append((frag, topt, cand, frag.top_prepare(u_opt)))
+            states.append(
+                (frag, topt, cand, frag.top_prepare_union(union, cand, topt))
+            )
         pending = [
             st for _, _, _, st in states
             if st.done is None and st.dev_counts is not None
