@@ -305,6 +305,18 @@ def main() -> None:
             dtype=jnp.int32,
         ),
     )
+    # Per-row partials instead of a full scalar reduce: if this is much
+    # faster than and+popcount-sum, the scalar reduce is breaking XLA's
+    # fusion (materializing the popcount array in HBM) and a partial-
+    # emitting kernel (the Pallas path) is the fix.
+    probe(
+        "and+popcount-rowsum",
+        lambda d: jnp.sum(
+            jax.lax.population_count(d[:, 0] & d[:, 1]).astype(jnp.int32),
+            axis=-1,
+            dtype=jnp.int32,
+        ),
+    )
 
     # Keep-or-kill evidence for the (opt-in) fused Pallas kernel path:
     # time it against the blessed plain-XLA formulation on the same
