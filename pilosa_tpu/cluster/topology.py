@@ -18,7 +18,7 @@ compatible while intra-node reduces ride ICI collectives.
 from __future__ import annotations
 
 import threading
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 # reference: cluster.go:22-31
 DEFAULT_PARTITION_N = 256

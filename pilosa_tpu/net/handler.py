@@ -55,7 +55,6 @@ from pilosa_tpu.core.bitmap import RowBitmap
 from pilosa_tpu.exec.executor import ExecOptions, TooManyWritesError
 from pilosa_tpu.net import codec
 from pilosa_tpu.net import wire_pb2 as wire
-from pilosa_tpu.ops import bitplane as bp
 from pilosa_tpu.pql.parser import parse_string
 
 PROTOBUF = "application/x-protobuf"

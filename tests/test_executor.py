@@ -5,7 +5,7 @@ from datetime import datetime
 
 import pytest
 
-from pilosa_tpu.cluster.topology import Cluster, Node, new_cluster
+from pilosa_tpu.cluster.topology import new_cluster
 from pilosa_tpu.core.holder import Holder
 from pilosa_tpu.core.view import VIEW_INVERSE, VIEW_STANDARD
 from pilosa_tpu.exec import ExecOptions, Executor, ExecutorError, TooManyWritesError

@@ -365,7 +365,7 @@ def test_blocks_checksum_canonical_across_padding(tmp_path):
 
 def test_read_from_rejects_negative_cache_id(tmp_path, frag):
     frag.set_bit(0, 1)
-    import io as _io, json as _json, tarfile as _tar, time as _time
+    import io as _io, json as _json, tarfile as _tar
     buf = _io.BytesIO()
     frag.write_to(buf)
     # rebuild the tar with a poisoned cache member

@@ -7,10 +7,8 @@ port 0 in one process, exercised through the real client.
 
 import io
 import json
-import threading
 import time
 
-import numpy as np
 import pytest
 
 from pilosa_tpu.cluster import broadcast as bc
