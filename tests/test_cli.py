@@ -688,6 +688,53 @@ class TestGossip:
                 n.close()
 
 
+    def test_send_sync_reaches_suspect_member(self):
+        """A SUSPECT member is still live: send_sync must deliver to it
+        (a slow-but-reachable node must not silently miss schema
+        broadcasts while under suspicion)."""
+        from pilosa_tpu.cluster.gossip import GossipNodeSet
+        from pilosa_tpu.net import wire_pb2 as wire
+
+        received = []
+
+        class H:
+            def receive_message(self, msg):
+                received.append(msg)
+
+        a = GossipNodeSet(host="127.0.0.1:1", gossip_interval=0.05,
+                          suspect_after=5.0)
+        a.bind = ("127.0.0.1", _free_udp_port())
+        a.start(H())
+        a.open()
+        b = GossipNodeSet(
+            host="127.0.0.1:2", seed=f"{a.bind[0]}:{a.bind[1]}",
+            gossip_interval=0.05, suspect_after=5.0,
+        )
+        b.bind = ("127.0.0.1", _free_udp_port())
+        b.start(H())
+        b.open()
+        try:
+            deadline = time.time() + 5.0
+            while time.time() < deadline and "127.0.0.1:2" not in a.nodes():
+                time.sleep(0.02)
+            assert "127.0.0.1:2" in a.nodes(), "join timed out"
+            # Quiesce gossip traffic so nothing can flip the forced
+            # state back to UP before send_sync reads it, then force B
+            # into SUSPECT at A (as if probes were lost).
+            a.gossip_interval = b.gossip_interval = 60.0
+            time.sleep(0.15)  # drain in-flight ping/ack datagrams
+            with a._mu:
+                a._members["127.0.0.1:2"]["state"] = "SUSPECT"
+            a.send_sync(wire.DeleteIndexMessage(Index="x"))
+            assert received and received[-1].Index == "x"
+            with a._mu:
+                state = a._members["127.0.0.1:2"]["state"]
+            assert state == "SUSPECT", "state flipped mid-test; not exercised"
+        finally:
+            a.close()
+            b.close()
+
+
 def _free_udp_port() -> int:
     s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
     s.bind(("127.0.0.1", 0))
