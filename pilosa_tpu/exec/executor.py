@@ -1308,12 +1308,24 @@ class Executor:
                 result = reduce_fn(result, resp.result)
                 break
             m = self._slices_by_node(nodes, index, want)
-            futures = {
-                self._pool.submit(self._map_node, node, node_slices, index, c, opt, map_fn)
-                for _, (node, node_slices) in m.items()
-            }
-            for fut in futures:
-                resp = fut.result()
+            if len(m) == 1:
+                # Single target (the whole single-node case): run the
+                # mapper inline.  A pool hop would add a context switch
+                # per query and cap request concurrency at the pool
+                # size — the caller's own thread is the parallelism.
+                ((node, node_slices),) = m.values()
+                responses = [
+                    self._map_node(node, node_slices, index, c, opt, map_fn)
+                ]
+            else:
+                futures = {
+                    self._pool.submit(
+                        self._map_node, node, node_slices, index, c, opt, map_fn
+                    )
+                    for _, (node, node_slices) in m.items()
+                }
+                responses = [fut.result() for fut in futures]
+            for resp in responses:
                 if resp.error is not None:
                     remaining = [n for n in nodes if n.host != resp.node.host]
                     try:
