@@ -514,8 +514,15 @@ def run_executor_tiers(leaves, host_count, rng, dev_s) -> float:
         log(
             f"e2e executor TopN(n=100) folded single-fetch over 2048 rows:"
             f" sync p50 {t_p50*1e3:.2f} ms (incl. tunnel round trip);"
-            f" CONCURRENT {t_per_q*1e3:.2f} ms/query throughput,"
+            f" CONCURRENT(16) {t_per_q*1e3:.2f} ms/query throughput,"
             f" p50 latency under load {t_conc_p50*1e3:.2f} ms"
+        )
+        _, t_64, _ = measure_query(
+            ex, "i", tq, check_topn, n_serial=0, n_conc=128, threads=64
+        )
+        log(
+            f"e2e executor TopN(n=100) CONCURRENT(64): {t_64*1e3:.2f}"
+            f" ms/query throughput"
         )
         ex.close()
         holder.close()
