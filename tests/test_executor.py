@@ -662,6 +662,11 @@ def test_topn_folded_matches_two_phase(holder):
     bits2 = [(90, int(c)) for c in rng.integers(0, 200, 30)]
     must_set_bits(holder, "i", "f", bits2)
 
+    # Row attributes for the filters= shape (even rows tagged "a").
+    store = holder.frame("i", "f").row_attr_store
+    for r in range(0, 20, 2):
+        store.set_attrs(r, {"cat": "a"})
+
     queries = [
         "TopN(frame=f, n=3)",
         "TopN(frame=f)",
@@ -670,6 +675,8 @@ def test_topn_folded_matches_two_phase(holder):
         "TopN(Bitmap(rowID=2, frame=f), frame=f, n=5, threshold=2)",
         "TopN(Bitmap(rowID=0, frame=f), frame=f, n=3, tanimotoThreshold=20)",
         "TopN(Bitmap(rowID=90, frame=f), frame=f, n=4)",
+        'TopN(Bitmap(rowID=0, frame=f), frame=f, n=4, field="cat", filters=["a"])',
+        'TopN(frame=f, n=3, field="cat", filters=["a"])',
     ]
     for pql in queries:
         (folded,) = q(e, "i", pql)
