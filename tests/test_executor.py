@@ -690,6 +690,12 @@ def test_topn_folded_matches_two_phase(holder):
         assert [(p.id, p.count) for p in folded] == [
             (p.id, p.count) for p in two_phase
         ], pql
+        if "filters" in pql:
+            # Equivalence alone can't catch filters being silently
+            # ignored (both paths share the filter code): assert the
+            # semantics directly — only tagged (even) rows may appear.
+            assert folded, pql
+            assert all(p.id % 2 == 0 for p in folded), (pql, folded)
 
 
 def test_topn_folded_single_device_fetch(holder, monkeypatch):
