@@ -274,15 +274,23 @@ def _import_parsed_block(client, args, block: bytes) -> bool:
     if parsed is None:
         return False
     rows, cols = parsed
-    # Chunk on the numpy arrays so at most buffer_size records are ever
-    # materialized as Python objects at once.
-    for lo in range(0, len(rows), args.buffer_size):
-        chunk = [
-            (int(r), int(c), 0)
-            for r, c in zip(rows[lo : lo + args.buffer_size],
-                            cols[lo : lo + args.buffer_size])
-        ]
-        _flush_bits(client, args, chunk)
+    import numpy as np
+
+    from pilosa_tpu.ops.bitplane import np_group_by
+
+    # Fully vectorized: one stable sort groups by slice (no per-bit
+    # Python objects, no per-slice full-array rescans), shipped to the
+    # client in buffer_size chunks so request payloads stay bounded.
+    slices = cols // np.uint64(SLICE_WIDTH)
+    for s, (r_s, c_s) in np_group_by(slices, rows, cols):
+        print(f"importing slice: {s}, n={len(r_s)}", file=sys.stderr)
+        for lo in range(0, len(r_s), args.buffer_size):
+            client.import_bits(
+                args.index,
+                args.frame,
+                s,
+                (r_s[lo : lo + args.buffer_size], c_s[lo : lo + args.buffer_size]),
+            )
     return True
 
 

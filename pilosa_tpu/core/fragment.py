@@ -777,15 +777,19 @@ class Fragment:
             uniq = np.unique(rows)
             slot_of = {int(r): self._ensure_slot(int(r)) for r in uniq}
 
-            dense_mask = np.asarray(
-                [slot_of[int(r)] is not None for r in rows], dtype=bool
+            # Per-row slot resolution through a per-UNIQUE-row table:
+            # O(unique) Python work + one vectorized gather, instead of
+            # a per-bit comprehension.
+            slot_table = np.asarray(
+                [-1 if slot_of[int(r)] is None else slot_of[int(r)] for r in uniq],
+                dtype=np.int64,
             )
+            slots_all = slot_table[np.searchsorted(uniq, rows)]
+            dense_mask = slots_all >= 0
             if dense_mask.any():
-                d_rows = rows[dense_mask]
-                slots = np.asarray(
-                    [slot_of[int(r)] for r in d_rows], dtype=np.int64
+                bp.np_set_bulk(
+                    self._plane, slots_all[dense_mask], offs[dense_mask]
                 )
-                bp.np_set_bulk(self._plane, slots, offs[dense_mask])
             if not dense_mask.all():
                 s_rows = rows[~dense_mask]
                 s_offs = offs[~dense_mask].astype(np.uint32)

@@ -18,6 +18,8 @@ import os
 import threading
 from datetime import datetime
 
+import numpy as np
+
 from pilosa_tpu.core import cache as cache_mod
 from pilosa_tpu.core import timequantum as tq
 from pilosa_tpu.core.attr import AttrStore
@@ -235,9 +237,22 @@ class Frame:
         """Bulk import grouped by (view, slice) (reference:
         frame.go:527-604)."""
         n = len(row_ids)
-        timestamps = timestamps if timestamps is not None else [None] * n
-        if self.time_quantum == "" and any(t is not None for t in timestamps):
+        has_ts = timestamps is not None and any(
+            t is not None for t in timestamps
+        )
+        if self.time_quantum == "" and has_ts:
             raise FrameError("time quantum not set in either index or frame")
+
+        if not has_ts:
+            # Vectorized fast path: every bit goes to the standard view
+            # (and the mirrored inverse view), so grouping by slice is a
+            # numpy mask per unique slice, not a Python loop per bit.
+            rows = np.asarray(row_ids, dtype=np.int64)
+            cols = np.asarray(column_ids, dtype=np.int64)
+            self._import_grouped(VIEW_STANDARD, cols // SLICE_WIDTH, rows, cols)
+            if self.inverse_enabled:
+                self._import_grouped(VIEW_INVERSE, rows // SLICE_WIDTH, cols, rows)
+            return
 
         by_fragment: dict[tuple[str, int], tuple[list[int], list[int]]] = {}
 
@@ -265,6 +280,14 @@ class Frame:
             view = self.create_view_if_not_exists(view_name)
             frag = view.create_fragment_if_not_exists(slice_i)
             frag.import_bulk(rows, cols)
+
+    def _import_grouped(self, view_name, slices, rows, cols) -> None:
+        from pilosa_tpu.ops.bitplane import np_group_by
+
+        view = self.create_view_if_not_exists(view_name)
+        for s, (r_s, c_s) in np_group_by(slices, rows, cols):
+            frag = view.create_fragment_if_not_exists(s)
+            frag.import_bulk(r_s, c_s)
 
     def schema_dict(self) -> dict:
         return {

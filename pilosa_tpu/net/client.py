@@ -14,6 +14,8 @@ import base64
 import http.client
 import io
 import json
+
+import numpy as np
 import urllib.parse
 from typing import Any
 
@@ -164,17 +166,39 @@ class InternalClient:
         index: str,
         frame: str,
         slice_i: int,
-        bits: list[tuple[int, int] | tuple[int, int, int]],
+        bits,
     ) -> None:
         """POST one slice's bits to every replica node (reference:
-        client.go:314-401)."""
+        client.go:314-401).
+
+        ``bits``: either a list of ``(row, col[, ts])`` tuples, or the
+        vectorized form — a tuple of parallel numpy arrays ``(rows,
+        cols[, timestamps])`` (discriminated by the ndarray element, so
+        a tuple-of-bit-tuples is still treated as bit tuples)."""
         pb = wire.ImportRequest(Index=index, Frame=frame, Slice=slice_i)
-        has_ts = any(len(b) > 2 and b[2] for b in bits)
-        for b in bits:
-            pb.RowIDs.append(b[0])
-            pb.ColumnIDs.append(b[1])
+        if (
+            isinstance(bits, tuple)
+            and len(bits) in (2, 3)
+            and isinstance(bits[0], np.ndarray)
+        ):
+            # Vectorized form: (rows, cols[, timestamps]) parallel
+            # arrays — no per-bit Python objects anywhere on the path.
+            rows, cols = bits[0], bits[1]
+            ts = bits[2] if len(bits) > 2 else None
+            pb.RowIDs.extend(np.asarray(rows, dtype=np.uint64).tolist())
+            pb.ColumnIDs.extend(np.asarray(cols, dtype=np.uint64).tolist())
+            if ts is not None and np.any(ts):
+                pb.Timestamps.extend(np.asarray(ts, dtype=np.int64).tolist())
+        else:
+            has_ts = any(len(b) > 2 and b[2] for b in bits)
+            # Bulk extend: one C-level copy per field, not a Python
+            # append per bit.
+            pb.RowIDs.extend([b[0] for b in bits])
+            pb.ColumnIDs.extend([b[1] for b in bits])
             if has_ts:
-                pb.Timestamps.append(b[2] if len(b) > 2 else 0)
+                pb.Timestamps.extend(
+                    [b[2] if len(b) > 2 and b[2] else 0 for b in bits]
+                )
         payload = pb.SerializeToString()
         nodes = self.fragment_nodes(index, slice_i)
         if not nodes:

@@ -572,7 +572,11 @@ class Handler:
             None if ts == 0 else _dt_from_unix(ts) for ts in pb.Timestamps
         ] if pb.Timestamps else None
         try:
-            f.import_bulk(list(pb.RowIDs), list(pb.ColumnIDs), timestamps)
+            f.import_bulk(
+                np.asarray(pb.RowIDs, dtype=np.int64),
+                np.asarray(pb.ColumnIDs, dtype=np.int64),
+                timestamps,
+            )
         except Exception as e:  # noqa: BLE001
             return Response.proto(wire.ImportResponse(Err=str(e)), status=500)
         return Response.proto(wire.ImportResponse())

@@ -391,3 +391,18 @@ def top_k(counts, k: int):
 def batch_rows(rows: list[np.ndarray]) -> np.ndarray:
     """Stack slice-rows for batched device transfer."""
     return np.stack(rows) if rows else np.zeros((0, WORDS_PER_SLICE), np.uint32)
+
+
+def np_group_by(keys: np.ndarray, *arrays: np.ndarray):
+    """Yield ``(key, (aligned subarrays...))`` per unique key: ONE stable
+    sort plus contiguous slicing — O(n log n) regardless of key
+    cardinality, where a per-key boolean mask would re-scan the full
+    array per key.  Used by the bulk-import slice grouping."""
+    order = np.argsort(keys, kind="stable")
+    sk = keys[order]
+    sorted_arrays = [a[order] for a in arrays]
+    uniq, starts = np.unique(sk, return_index=True)
+    bounds = np.append(starts, len(sk))
+    for i, k in enumerate(uniq):
+        lo, hi = int(bounds[i]), int(bounds[i + 1])
+        yield int(k), tuple(a[lo:hi] for a in sorted_arrays)
