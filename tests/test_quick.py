@@ -5,6 +5,7 @@ validated against a pure-Python set model.
 """
 
 import numpy as np
+import pytest
 from hypothesis import HealthCheck, given, settings
 from hypothesis import strategies as st
 
@@ -336,3 +337,116 @@ class TestQueryTreeProperties:
                 int(s * SLICE_WIDTH + off) for off in np_row_to_columns(words)
             }
         assert got_bits == want
+
+
+# ---------------------------------------------------------------------------
+# distributed property test: 2 real servers, random writes via alternating
+# coordinators (reference: server/server_test.go:43-122 TestMain_Set_Quick,
+# strengthened to a real 2-node cluster)
+# ---------------------------------------------------------------------------
+
+
+cluster_write_sequences = st.lists(
+    st.tuples(
+        st.booleans(),                                   # set / clear
+        st.integers(min_value=0, max_value=40),          # row id
+        st.integers(min_value=0, max_value=3 * 2**20 - 1),  # col (3 slices)
+        st.booleans(),                                   # coordinator 0/1
+    ),
+    min_size=1,
+    max_size=40,
+)
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _close_cluster_quick_servers():
+    yield
+    if TestClusterQuick._servers is not None:
+        servers, _ = TestClusterQuick._servers
+        for s in servers:
+            try:
+                s.close()
+            except Exception:
+                pass
+        TestClusterQuick._servers = None
+
+
+class TestClusterQuick:
+    """Random write sequences through BOTH coordinators of a real
+    two-node cluster; every row's bits and counts must match a set
+    model when queried from EITHER node."""
+
+    _servers = None
+
+    @classmethod
+    def _boot(cls, tmp_root):
+        from pilosa_tpu.cluster.topology import Cluster
+        from pilosa_tpu.net.client import InternalClient
+        from pilosa_tpu.net.server import Server
+
+        servers = []
+        for i in range(2):
+            s = Server(
+                data_dir=str(tmp_root / f"cq{i}"),
+                cluster=Cluster(replica_n=1),
+                anti_entropy_interval=3600,
+                polling_interval=3600,
+                cache_flush_interval=3600,
+            )
+            s.open()
+            servers.append(s)
+        hosts = sorted(s.host for s in servers)
+        for s in servers:
+            for h in hosts:
+                if s.cluster.node_by_host(h) is None:
+                    s.cluster.add_node(h)  # add_node keeps the list sorted
+        clients = [InternalClient(s.host, timeout=15.0) for s in servers]
+        return servers, clients
+
+    @QUICK
+    @given(seq=cluster_write_sequences, case=st.integers(0, 10**9))
+    def test_random_cluster_writes_match_model(
+        self, tmp_path_factory, seq, case
+    ):
+        if TestClusterQuick._servers is None:
+            TestClusterQuick._servers = self._boot(
+                tmp_path_factory.mktemp("clusterquick")
+            )
+        servers, clients = TestClusterQuick._servers
+        index = f"q{case}"
+        # No broadcaster in this fixture: create the schema on every
+        # node directly (the gossip/http broadcast path has its own
+        # tests).
+        for s in servers:
+            s.holder.create_index_if_not_exists(index)
+            s.holder.index(index).create_frame_if_not_exists("f")
+        try:
+            model: dict[int, set] = {}
+            for is_set, row, col, coord in seq:
+                verb = "SetBit" if is_set else "ClearBit"
+                clients[int(coord)].execute_query(
+                    index, f'{verb}(frame="f", rowID={row}, columnID={col})'
+                )
+                if is_set:
+                    model.setdefault(row, set()).add(col)
+                else:
+                    model.setdefault(row, set()).discard(col)
+            # max-slice convergence (no broadcaster in this fixture)
+            for s in servers:
+                s._tick_max_slices()
+            from pilosa_tpu.net.codec import bitmap_to_json
+
+            for row, want in model.items():
+                for c in clients:
+                    n = c.execute_pql(
+                        index, f'Count(Bitmap(frame="f", rowID={row}))'
+                    )
+                    assert n == len(want), (row, n, len(want))
+                for c in clients:
+                    rb = c.execute_pql(
+                        index, f'Bitmap(frame="f", rowID={row})'
+                    )
+                    assert bitmap_to_json(rb)["bits"] == sorted(want)
+        finally:
+            for s in servers:
+                s.holder.delete_index(index)
