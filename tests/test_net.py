@@ -935,3 +935,49 @@ class TestClusterFailure:
                     s.close()
                 except Exception:
                     pass
+
+
+class TestConcurrentLoad:
+    def test_concurrent_writers_and_readers_exact(self, server):
+        """8 writer threads (disjoint column ranges) + 4 reader threads
+        hammer one server; no request may error, and the final count
+        must be exactly the union of all writes (fragment/cache/executor
+        locks under real HTTP concurrency)."""
+        import concurrent.futures
+
+        c = InternalClient(server.host, timeout=30.0)
+        c.create_index("cc")
+        c.create_frame("cc", "f")
+        per_thread = 120
+
+        def writer(t):
+            cw = InternalClient(server.host, timeout=30.0)
+            base = t * 1000
+            changed = 0
+            for i in range(per_thread):
+                (res,) = cw.execute_query(
+                    "cc", f'SetBit(frame="f", rowID=1, columnID={base + i})'
+                )
+                changed += bool(res)
+            return changed  # every column is fresh: all must report changed
+
+        def reader(_t):
+            cr = InternalClient(server.host, timeout=30.0)
+            last = 0
+            for _ in range(40):
+                n = cr.execute_pql("cc", 'Count(Bitmap(frame="f", rowID=1))')
+                # monotonic under set-only writes
+                assert n >= last, (n, last)
+                last = n
+            return last
+
+        with concurrent.futures.ThreadPoolExecutor(12) as pool:
+            w = [pool.submit(writer, t) for t in range(8)]
+            r = [pool.submit(reader, t) for t in range(4)]
+            total = sum(f.result() for f in w)
+            for f in r:
+                f.result()
+        assert total == 8 * per_thread
+        assert (
+            c.execute_pql("cc", 'Count(Bitmap(frame="f", rowID=1))') == total
+        )
