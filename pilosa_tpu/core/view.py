@@ -105,6 +105,14 @@ class View:
         with self._mu:
             return list(self._fragments.values())
 
+    def fragment_slices(self) -> set[int]:
+        """Snapshot of the slice numbers that have fragments — lets the
+        executor's per-slice host walks skip slices this view never
+        materialized (a frame rarely spans the whole index slice range;
+        missing fragments contribute nothing to any query)."""
+        with self._mu:
+            return set(self._fragments)
+
     def max_slice(self) -> int:
         with self._mu:
             return max(self._fragments.keys(), default=0)

@@ -27,7 +27,7 @@ from __future__ import annotations
 import threading
 from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace
 from datetime import datetime
 
 import jax
@@ -879,6 +879,24 @@ class Executor:
             trimmed = trimmed[:n]
         return trimmed
 
+    def _existing_topn_slices(
+        self, index: str, c: Call, slices: list[int]
+    ) -> list[int]:
+        """Subset of ``slices`` whose fragment of the TopN frame/view
+        actually exists.  A missing fragment contributes nothing
+        (``_topn_options_for_slice`` returns None for it), so skipping
+        turns the per-slice host walk from O(max_slice) into
+        O(existing fragments) — at bench scale (954 index slices, one
+        frame fragment) that walk dominated warm TopN host time."""
+        frame, view = self._topn_frame_view(c)
+        idx = self.holder.index(index)
+        f = idx.frame(frame) if idx is not None else None
+        v = f.view(view) if f is not None else None
+        if v is None:
+            return []
+        have = v.fragment_slices()
+        return [s for s in slices if s in have]
+
     def _all_slices_local(self, index: str, slices: list[int]) -> bool:
         try:
             m = self._slices_by_node(list(self.cluster.nodes), index, slices)
@@ -899,6 +917,10 @@ class Executor:
         has_src = len(c.children) == 1
         if len(c.children) > 1:
             raise ExecutorError("TopN() can only have one input bitmap")
+
+        # Only slices whose fragment exists can contribute; restricting
+        # up front turns every per-slice walk below into O(fragments).
+        slices = self._existing_topn_slices(index, c, slices)
 
         # Pass 1 (host-only): per-slice candidate lists, WITHOUT
         # evaluating the src tree yet — the union guard below must be
@@ -927,18 +949,32 @@ class Executor:
         if len(union_est) > max(2 * max_cand, 512):
             return self._execute_topn_two_phase(index, c, slices, opt, n)
 
+        union = sorted(union_est)
         if has_src:
-            # Now pay the src tree eval and re-derive candidates with
-            # the real src (tanimoto windows and scoring need it).
             src_rows = self._eval_tree_slices_host(index, c.children[0], slices)
-            per = []
-            for s in slices:
-                prep = self._topn_options_for_slice(index, c, s, src_rows)
-                if prep is None:
-                    continue
-                frag, topt = prep
-                per.append((frag, topt, frag.top_candidates(topt)))
-        union = sorted({p.id for _, _, cand in per for p in cand})
+            if _uint_arg(c, "tanimotoThreshold")[0] > 0:
+                # Tanimoto count-windows depend on the src count, so
+                # re-derive candidates (and the union) with the real src.
+                per = []
+                for s in slices:
+                    prep = self._topn_options_for_slice(index, c, s, src_rows)
+                    if prep is None:
+                        continue
+                    frag, topt = prep
+                    per.append((frag, topt, frag.top_candidates(topt)))
+                union = sorted({p.id for _, _, cand in per for p in cand})
+            else:
+                # Without tanimoto, candidate filtering never reads the
+                # src — only the scorer does.  Attach it to the pass-1
+                # options instead of re-walking every candidate list.
+                attached = []
+                for frag, topt, cand in per:
+                    src = RowBitmap()
+                    row = src_rows.get(frag.slice)
+                    if row is not None:
+                        src.set_segment(frag.slice, row)
+                    attached.append((frag, replace(topt, src=src), cand))
+                per = attached
         if not union:
             return []
 
@@ -961,33 +997,66 @@ class Executor:
 
         # Phase-1 winner selection per slice, from the same scores the
         # two-phase protocol's first round would have produced for the
-        # slice's own candidates (cand is a subset of the union).
-        merged_phase1: list[Pair] = []
-        fulls: list[list[Pair]] = []
+        # slice's own candidates (cand is a subset of the union) — all
+        # in numpy: at union scale, Pair-object bookkeeping in Python
+        # dominated warm TopN host time.
+        winner_ids: list[np.ndarray] = []
+        fulls: list[tuple[np.ndarray, np.ndarray]] = []
         for frag, topt, cand, st in states:
-            full = frag.top_finish(st)  # exact filtered pairs over union
-            fulls.append(full)
+            ids, cnts, keep, short = frag.top_score_arrays(st)
+            fulls.append((ids[keep], cnts[keep]))
             if topt.src is None:
-                winners = cand[: topt.n] if topt.n else cand
+                sel = cand[: topt.n] if topt.n else cand
+                winner_ids.append(
+                    np.fromiter((p.id for p in sel), np.int64, len(sel))
+                )
+            elif short:
+                # Scoring short-circuited (e.g. no src segment here):
+                # the subset selection would short-circuit identically.
+                winner_ids.append(ids)
             else:
-                winners = frag.top_select(st, cand, topt.n)
-            merged_phase1 = cache_mod.add_pairs(merged_phase1, winners)
-        ids2 = {p.id for p in merged_phase1}
-        if not ids2:
+                cand_ids = np.fromiter((p.id for p in cand), np.int64, len(cand))
+                m = keep & np.isin(ids, cand_ids)
+                sel_ids, sel_cnts = ids[m], cnts[m]
+                order = np.lexsort((sel_ids, -sel_cnts))
+                if topt.n:
+                    order = order[: topt.n]
+                winner_ids.append(sel_ids[order])
+        ids2 = (
+            np.unique(np.concatenate(winner_ids))
+            if winner_ids
+            else np.empty(0, np.int64)
+        )
+        if not len(ids2):
             return []
 
         # Phase-2 equivalent: exact counts for the winner union, already
-        # in hand.
-        final: list[Pair] = []
-        for full in fulls:
-            final = cache_mod.add_pairs(final, [p for p in full if p.id in ids2])
-        final = cache_mod.sort_pairs(final)
-        return final[:n] if n and n < len(final) else final
+        # in hand; counts SUM across slices (reference reduce:
+        # Pairs.Add, cache.go:312-334).
+        kept_ids, kept_cnts = [], []
+        for i, cts in fulls:
+            m = np.isin(i, ids2)
+            kept_ids.append(i[m])
+            kept_cnts.append(cts[m])
+        cat_ids = np.concatenate(kept_ids) if kept_ids else np.empty(0, np.int64)
+        if not len(cat_ids):
+            return []
+        cat_cnts = np.concatenate(kept_cnts)
+        uids, inv = np.unique(cat_ids, return_inverse=True)
+        sums = np.zeros(len(uids), np.int64)
+        np.add.at(sums, inv, cat_cnts)
+        order = np.lexsort((uids, -sums))
+        if n and n < len(order):
+            order = order[:n]
+        return [Pair(int(uids[k]), int(sums[k])) for k in order]
 
     def _execute_topn_slices(
         self, index: str, c: Call, slices: list[int], opt: ExecOptions
     ) -> list[Pair]:
         def map_fn(local_slices: list[int]):
+            # Missing fragments contribute nothing — walk only slices
+            # that materialized one (O(fragments), not O(max_slice)).
+            local_slices = self._existing_topn_slices(index, c, local_slices)
             # The src bitmap (if any) evaluates HOST-side per slice: the
             # scorer needs host words anyway (sparse probing + transfer
             # to the gather kernel), so a device program here would add
@@ -1033,13 +1102,20 @@ class Executor:
         pairs = self._map_reduce(index, slices, c, opt, map_fn, reduce_fn) or []
         return cache_mod.sort_pairs(pairs)
 
+    @staticmethod
+    def _topn_frame_view(c: Call) -> tuple[str, str]:
+        """The (frame, view) a TopN call targets — the single resolution
+        point shared by option building and the existing-slice filter."""
+        frame = c.args.get("frame") or DEFAULT_FRAME
+        view = VIEW_INVERSE if bool(c.args.get("inverse", False)) else VIEW_STANDARD
+        return frame, view
+
     def _topn_options_for_slice(self, index: str, c: Call, slice_i: int, src_rows=None):
         """reference: executor.go:346-415.  ``src_rows`` carries the
         host-evaluated src rows from _execute_topn_slices.  Returns
         ``(fragment, TopOptions)``, or None when the fragment does not
         exist."""
-        frame = c.args.get("frame") or DEFAULT_FRAME
-        inverse = bool(c.args.get("inverse", False))
+        frame, view = self._topn_frame_view(c)
         n = _uint_arg(c, "n")[0]
         fld = c.args.get("field", "") or ""
         row_ids = _uint_slice_arg(c, "ids")
@@ -1054,7 +1130,6 @@ class Executor:
             if row is not None:
                 src.set_segment(slice_i, row)
 
-        view = VIEW_INVERSE if inverse else VIEW_STANDARD
         f = self.holder.fragment(index, frame, view, slice_i)
         if f is None:
             return None
