@@ -50,12 +50,6 @@ def build_server(cfg: config_mod.Config):
     from pilosa_tpu.net.server import Server
     from pilosa_tpu.obs.stats import new_stats_client
 
-    # Kernel toggle consumed by ops/bitplane._use_pallas (opt-in:
-    # plain XLA is the blessed default, see bitplane._use_pallas).
-    if cfg.tpu.use_pallas:
-        os.environ["PILOSA_TPU_USE_PALLAS"] = "1"
-    else:
-        os.environ.pop("PILOSA_TPU_USE_PALLAS", None)
     if cfg.tpu.mesh_shape:
         os.environ["PILOSA_TPU_MESH_SHAPE"] = cfg.tpu.mesh_shape
 

@@ -949,6 +949,26 @@ class Fragment:
             candidates.append(p)
         return candidates, tanimoto, src_count
 
+    @staticmethod
+    def select_winners(
+        ids: np.ndarray,
+        cnts: np.ndarray,
+        keep: np.ndarray,
+        cand_ids: np.ndarray,
+        n: int,
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Phase-1 winner selection over a scored union restricted to
+        ``cand_ids``: filter mask, (-count, id) sort (sort_pairs'
+        canonical order), trim to ``n``.  The ONE implementation of the
+        selection rule, shared by ``top_select`` and the executor's
+        folded TopN."""
+        m = keep & np.isin(ids, cand_ids)
+        sel_ids, sel_cnts = ids[m], cnts[m]
+        order = np.lexsort((sel_ids, -sel_cnts))
+        if n:
+            order = order[:n]
+        return sel_ids[order], sel_cnts[order]
+
     def top_select(self, st: "TopState", candidates: list[Pair], n: int) -> list[Pair]:
         """Winner selection for a candidate SUBSET of a union scoring
         pass (the executor's folded TopN): returns what phase-1 scoring
@@ -963,12 +983,8 @@ class Fragment:
         cand_ids = np.fromiter(
             (p.id for p in candidates), np.int64, len(candidates)
         )
-        m = keep & np.isin(ids, cand_ids)
-        ids, cnts = ids[m], cnts[m]
-        order = np.lexsort((ids, -cnts))
-        if n:
-            order = order[:n]
-        return [Pair(int(ids[k]), int(cnts[k])) for k in order]
+        sel_ids, sel_cnts = self.select_winners(ids, cnts, keep, cand_ids, n)
+        return [Pair(int(i), int(c)) for i, c in zip(sel_ids, sel_cnts)]
 
     def _top_score_prepare(self, pairs: list[Pair], opt: TopOptions) -> "TopState":
         n = 0 if (opt.row_ids) else opt.n

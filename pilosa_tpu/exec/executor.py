@@ -637,7 +637,7 @@ class Executor:
         if ent["mesh"] is not None:
             # plain-XLA formulation: partitions cleanly under SPMD
             res = jax.device_get(
-                plan.compiled_batched(ent["expr"], reduce, fused=False)(
+                plan.compiled_batched(ent["expr"], reduce)(
                     ent["batch"]
                 )
             )
@@ -692,7 +692,7 @@ class Executor:
                 )
                 return plan.recombine_count_limbs(jax.device_get(limbs))
             res = jax.device_get(
-                plan.compiled_batched(ent["expr"], "count", fused=False)(
+                plan.compiled_batched(ent["expr"], "count")(
                     ent["batch"]
                 )
             )
@@ -1016,12 +1016,8 @@ class Executor:
                 winner_ids.append(ids)
             else:
                 cand_ids = np.fromiter((p.id for p in cand), np.int64, len(cand))
-                m = keep & np.isin(ids, cand_ids)
-                sel_ids, sel_cnts = ids[m], cnts[m]
-                order = np.lexsort((sel_ids, -sel_cnts))
-                if topt.n:
-                    order = order[: topt.n]
-                winner_ids.append(sel_ids[order])
+                sel_ids, _ = frag.select_winners(ids, cnts, keep, cand_ids, topt.n)
+                winner_ids.append(sel_ids)
         ids2 = (
             np.unique(np.concatenate(winner_ids))
             if winner_ids

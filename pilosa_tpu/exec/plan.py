@@ -144,44 +144,18 @@ def _make_fn(expr: tuple, reduce: str):
     return fn
 
 
-# Pallas-fusable 2-leaf shapes: Count(Op(Bitmap, Bitmap)) — the headline
-# query — maps to one fused bitwise+popcount kernel per slice-row.
-_FUSED_OPS = {
-    "Intersect": "and",
-    "Union": "or",
-    "Xor": "xor",
-    "Difference": "andnot",
-}
-
-
-def _fusable(expr: tuple, reduce: str) -> bool:
-    return (
-        reduce == "count"
-        and len(expr) == 3
-        and expr[0] in _FUSED_OPS
-        and expr[1] == ("leaf", 0)
-        and expr[2] == ("leaf", 1)
-    )
-
-
-def compiled_batched(expr: tuple, reduce: str, fused: bool | None = None):
+def compiled_batched(expr: tuple, reduce: str):
     """One jitted program per (tree shape, reduce kind), vmapped over a
     leading slice axis — input uint32[n_slices, n_leaves, 32768].  All of
     a node's local slices evaluate in ONE device program (the TPU-shaped
     equivalent of the reference's goroutine-per-slice mapperLocal,
     reference: executor.go:1246-1282).
 
-    ``fused`` None resolves to the Pallas toggle (real TPU on, CPU off):
-    2-leaf count shapes route through the fused bitwise+popcount kernel.
-    The multi-device sharded path passes ``fused=False`` — the plain-XLA
-    formulation partitions cleanly under SPMD.  The key is normalized
-    before the compile cache, so non-fusable shapes share one program
-    regardless of the flag."""
-    if fused is None:
-        from pilosa_tpu.ops.bitplane import _use_pallas
-
-        fused = _use_pallas()
-    return _compiled_batched(expr, reduce, fused and _fusable(expr, reduce))
+    XLA emits the whole expression as one fused bitwise+popcount+reduce
+    pass (measured ~490 GB/s ≈ 60% of v5e HBM peak at 1B columns); a
+    handwritten Pallas variant was measured decisively slower twice and
+    deleted — see ops/bitplane.py."""
+    return _compiled_batched(expr, reduce)
 
 
 # On-device count reduce budget, in PARTIALS (one partial = one
@@ -250,14 +224,5 @@ def _compiled_total_count(expr: tuple, mesh):
 
 
 @functools.lru_cache(maxsize=512)
-def _compiled_batched(expr: tuple, reduce: str, use_fused: bool):
-    if use_fused:
-        from pilosa_tpu.ops import kernels
-
-        op = _FUSED_OPS[expr[0]]
-
-        def fused_fn(batch):
-            return kernels.fused_count_rows(batch[:, 0], batch[:, 1], op)
-
-        return fused_fn
+def _compiled_batched(expr: tuple, reduce: str):
     return jax.jit(jax.vmap(_make_fn(expr, reduce)))

@@ -1,4 +1,4 @@
-"""Bitmap kernel layer: dense bit-planes + Pallas/XLA popcount kernels.
+"""Bitmap kernel layer: dense bit-planes + fused XLA popcount kernels.
 
 This package replaces the reference's roaring container ops and amd64
 popcount assembly (reference: roaring/roaring.go:345-474,1259-1716 and

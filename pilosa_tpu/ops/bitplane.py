@@ -12,8 +12,7 @@ These functions replace the reference's per-container sorted-merge kernels
 and popcount assembly (reference: roaring/roaring.go:1259-1716,
 roaring/assembly_amd64.s) with whole-row vector ops: XLA fuses the bitwise
 op into the popcount reduce, so ``count_and`` etc. never materialize the
-intermediate row in HBM.  On TPU, the fused count family can also route
-through the Pallas kernels in :mod:`pilosa_tpu.ops.kernels`.
+intermediate row in HBM as one fused bitwise+popcount+reduce pass.
 
 All counts are returned as int32 device scalars (a slice-row holds at most
 2^20 bits, and a full plane reduce stays far below 2^31); callers accumulate
@@ -218,26 +217,16 @@ def home_device(slice_i: int):
     return devs[slice_i % len(devs)]
 
 
-def _use_pallas() -> bool:
-    """Pallas kernels are OPT-IN (``PILOSA_TPU_USE_PALLAS=1`` /
-    ``tpu.use-pallas`` config): the blessed production path is plain
-    XLA, whose fused popcount+reduce measured 4x FASTER than the round-2
-    Pallas kernels on a v5e chip (BENCH_r02).  The restructured kernels
-    (per-row VMEM partials) stay in-tree behind this flag so the
-    keep-or-kill comparison bench.py logs can promote them on
-    measurement, not speculation."""
-    if os.environ.get("PILOSA_TPU_DISABLE_PALLAS"):
-        return False
-    if not os.environ.get("PILOSA_TPU_USE_PALLAS"):
-        return False
-    return jax.default_backend() == "tpu"
-
-
-# The Pallas flag is read BEFORE jit dispatch (never inside a traced body):
-# a traced read would bake the env var into the first compilation and
-# silently ignore mid-process flips, since the jit cache key doesn't
-# include it.  Each public entry point picks the XLA or Pallas jitted
-# callee per call, so both stay independently cached.
+# There is NO handwritten-Pallas variant of these kernels: two rounds
+# of measurement on real v5e hardware killed it.  The r02 tile-naive
+# kernels measured 4x slower than XLA's fused popcount+reduce; the r03
+# restructured kernels (tile-aligned (8,128) lane partials) measured
+# 0.068x plain XLA (7.5 ms vs 0.51 ms per 1B-column fused
+# Intersect+Count, fetch-folded slope methodology, tools/cache_probe.py).
+# XLA already emits a single fused bitwise+popcount+reduce pass at
+# ~490 GB/s ≈ 60% of v5e HBM peak; a hand kernel has no headroom worth
+# its maintenance, so the experiment ended per the promote-or-delete
+# bar (BASELINE.md "Pallas keep-or-kill").
 
 
 @jax.jit
@@ -247,10 +236,6 @@ def _count_xla(words):
 
 def count(words):
     """Popcount of a row/plane (reference: popcntSliceAsm)."""
-    if _use_pallas():
-        from pilosa_tpu.ops import kernels
-
-        return kernels.count(words)
     return _count_xla(words)
 
 
@@ -268,10 +253,6 @@ def _fused_count_xla(a, b, op):
 
 
 def _fused_count(a, b, op):
-    if _use_pallas():
-        from pilosa_tpu.ops import kernels
-
-        return kernels.fused_count(a, b, op)
     return _fused_count_xla(a, b, op)
 
 
@@ -369,10 +350,6 @@ def top_counts(plane, src_row):
     every row in one fused batched kernel and select on the host — same
     results, hardware-shaped loop structure.
     """
-    if _use_pallas():
-        from pilosa_tpu.ops import kernels
-
-        return kernels.top_counts(plane, src_row)
     return _top_counts_xla(plane, src_row)
 
 

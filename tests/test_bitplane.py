@@ -118,33 +118,3 @@ def test_pad_rows():
     assert bp.pad_rows(9) == 16
 
 
-def test_pallas_flag_honored_per_call(rng, monkeypatch):
-    """Flipping the kernel toggle mid-process changes dispatch on the NEXT
-    call — the flag must never be baked into a jit trace (it isn't part of
-    the jit cache key, so a traced read would silently pin the first value).
-    """
-    a = rng.integers(0, 2**32, size=(8, bp.WORDS_PER_SLICE), dtype=np.uint32)
-    b = rng.integers(0, 2**32, size=(8, bp.WORDS_PER_SLICE), dtype=np.uint32)
-    want = np_popcount(a & b)
-
-    # Warm the XLA path first so a trace-time capture would be sticky.
-    monkeypatch.setattr(bp, "_use_pallas", lambda: False)
-    assert int(bp.count_and(a, b)) == want
-
-    calls = []
-    from pilosa_tpu.ops import kernels
-
-    real = kernels.fused_count
-
-    def spy(x, y, op):
-        calls.append(op)
-        return real(x, y, op)
-
-    monkeypatch.setattr(kernels, "fused_count", spy)
-    monkeypatch.setattr(bp, "_use_pallas", lambda: True)
-    assert int(bp.count_and(a, b)) == want
-    assert calls == ["and"], "pallas path not taken after mid-process flip"
-
-    monkeypatch.setattr(bp, "_use_pallas", lambda: False)
-    assert int(bp.count_and(a, b)) == want
-    assert calls == ["and"], "xla path not restored after flipping back"
