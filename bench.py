@@ -137,6 +137,31 @@ def with_retries(label: str, fn, attempts: int = 3, delay_s: float = 90.0):
             wait_for_backend(attempts=3, delay_s=60.0)
 
 
+def measure_slope(fold, lo_in, hi_in, bytes_per, sanity_peak, log_fn,
+                  epochs: int = 6, tries: int = 3) -> float | None:
+    """Interleaved lo/hi fetch-folded slope with HBM-peak plausibility
+    retry — THE slope methodology, shared by bench.py and
+    tools/cache_probe.py.  ``fold(inputs) -> wall seconds`` must force
+    execution (fold outputs into one fetched scalar); lo/hi epochs
+    interleave so both see the same pool conditions; a slope implying
+    more operand bandwidth than the chip's HBM peak is retried and
+    ultimately reported as None rather than published."""
+    n = len(hi_in) - len(lo_in)
+    for attempt in range(tries):
+        lo = hi = float("inf")
+        for _ in range(epochs):
+            lo = min(lo, fold(lo_in))
+            hi = min(hi, fold(hi_in))
+        s = (hi - lo) / n
+        if s > 0 and (sanity_peak is None or bytes_per / s <= sanity_peak):
+            return s
+        log_fn(
+            f"slope measurement implausible (slope {s*1e6:.1f} us/run);"
+            f" pool interference — retry {attempt + 1}/{tries}"
+        )
+    return None
+
+
 def hbm_peak_bytes_s(jax_mod) -> float | None:
     """Per-generation HBM peak for the %-of-peak roofline figure; None
     (omit the percentage) for unrecognized device kinds rather than
@@ -268,31 +293,17 @@ def main() -> None:
 
     sanity_peak = hbm_peak_bytes_s(jax) if jax.default_backend() == "tpu" else None
 
-    def slope_time(fn, epochs: int = 6, tries: int = 3) -> float | None:
-        """True per-execution device seconds: fold-fetched, best-of-
-        epochs, slope over run count.  lo/hi epochs INTERLEAVE so both
-        see the same pool conditions (a pool-state shift between
-        separate lo and hi windows once produced a ~zero slope and an
-        absurd artifact number).  A slope implying more operand
-        bandwidth than the chip's HBM peak is physically impossible —
-        retry, and return None rather than report it."""
-        lo_in = [devs[i % n_batches] for i in range(N_LO)]
-        hi_in = [devs[i % n_batches] for i in range(N_HI)]
-        for attempt in range(tries):
-            lo = hi = float("inf")
-            for _ in range(epochs):
-                lo = min(lo, folded_wall(fn, lo_in))
-                hi = min(hi, folded_wall(fn, hi_in))
-            s = (hi - lo) / (N_HI - N_LO)
-            if s > 0:
-                implied = devs[0].size * 4 / s
-                if sanity_peak is None or implied <= sanity_peak * 1.25:
-                    return s
-            log(
-                f"slope measurement implausible (slope {s*1e6:.1f} us/run);"
-                f" pool interference — retry {attempt + 1}/{tries}"
-            )
-        return None
+    def slope_time(fn) -> float | None:
+        """True per-execution device seconds for ``fn`` (see
+        measure_slope for the methodology)."""
+        return measure_slope(
+            lambda inputs: folded_wall(fn, inputs),
+            [devs[i % n_batches] for i in range(N_LO)],
+            [devs[i % n_batches] for i in range(N_HI)],
+            devs[0].size * 4,
+            sanity_peak * 1.25 if sanity_peak else None,
+            log,
+        )
 
     def time_variant(name: str, fn) -> float | None:
         for d, want in zip(devs, host_counts):  # warmup/compile + exactness

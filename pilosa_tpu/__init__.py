@@ -8,12 +8,12 @@ TopN/Range + SetBit/ClearBit/attr writes) over an HTTP+protobuf API.
 Where the reference executes bitmap algebra with Go roaring containers and
 amd64 POPCNT assembly (reference: roaring/roaring.go, roaring/assembly_amd64.s),
 this framework keeps fragments as dense HBM-resident bit-planes and compiles
-the container ops (AND/OR/XOR/ANDNOT + popcount) to XLA, with Pallas kernels
-for the fused popcount reductions, and reduces across a TPU mesh with XLA
-collectives (Count -> psum, Union -> OR-reduce) instead of HTTP fan-in.
+the container ops (AND/OR/XOR/ANDNOT + popcount) to fused XLA programs,
+and reduces across a TPU mesh with XLA collectives (Count -> psum,
+Union -> OR-reduce) instead of HTTP fan-in.
 
 Layer map (mirrors SURVEY.md §1):
-  ops/       bitmap kernel layer (bit-planes, Pallas kernels, roaring codec)
+  ops/       bitmap kernel layer (bit-planes, XLA kernels, roaring codec)
   core/      Bitmap row type, Fragment, caches, View/Frame/Index/Holder, attrs
   pql/       the PQL query language (lexer/parser/AST)
   exec/      the distributed query executor (map/reduce)

@@ -66,6 +66,7 @@ class Cache(Protocol):
     def ids(self) -> list[int]: ...
     def invalidate(self) -> None: ...
     def top(self) -> list[Pair]: ...
+    def top_arrays(self): ...
     def recalculate(self) -> None: ...
 
 
@@ -105,6 +106,19 @@ class LRUCache:
     def top(self) -> list[Pair]:
         return sort_pairs(Pair(i, c) for i, c in self._od.items())
 
+    def top_arrays(self):
+        """(ids, counts) int64 ndarrays in canonical (-count, id) order —
+        the array-native twin of top() (LRU caches are small; built on
+        demand)."""
+        import numpy as np
+
+        pairs = self.top()
+        n = len(pairs)
+        return (
+            np.fromiter((p.id for p in pairs), np.int64, n),
+            np.fromiter((p.count for p in pairs), np.int64, n),
+        )
+
 
 class RankCache:
     """Threshold-pruned ranked cache (reference: cache.go:136-286).
@@ -120,6 +134,7 @@ class RankCache:
         self.max_entries = max_entries or DEFAULT_CACHE_SIZE
         self.entries: dict[int, int] = {}
         self._rankings: list[Pair] = []
+        self._arrays = None  # (ids, counts) mirror of _rankings
         self._updated_at = 0.0
         self._stale = True
         self.threshold_value = 0
@@ -165,6 +180,22 @@ class RankCache:
         self._recompute()
         return list(self._rankings)
 
+    def top_arrays(self):
+        """(ids, counts) int64 ndarrays mirroring top()'s ranking order,
+        cached until the next re-sort — the folded TopN path consumes
+        candidates array-native, so the per-query cost is two array
+        reads instead of an O(cache) Pair walk."""
+        import numpy as np
+
+        self._recompute()
+        if self._arrays is None:
+            n = len(self._rankings)
+            self._arrays = (
+                np.fromiter((p.id for p in self._rankings), np.int64, n),
+                np.fromiter((p.count for p in self._rankings), np.int64, n),
+            )
+        return self._arrays
+
     def _recompute(self, force: bool = False) -> None:
         now = time.monotonic()
         if not self._stale:
@@ -176,6 +207,7 @@ class RankCache:
         self._rankings = sort_pairs(
             Pair(i, c) for i, c in self.entries.items()
         )[: self.max_entries]
+        self._arrays = None
         self._updated_at = now
         self._stale = False
 

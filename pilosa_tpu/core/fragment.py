@@ -12,7 +12,7 @@ design separates the planes:
   and backup tooling.
 * **Compute** runs on a lazily-refreshed device mirror of the plane
   (`device_plane()`), so query algebra and TopN scoring execute as
-  batched XLA/Pallas kernels over HBM; the mirror is invalidated by a
+  batched XLA kernels over HBM; the mirror is invalidated by a
   version counter bumped on every mutation.
 * **Writes** go to the host plane and append 13-byte ops to the file;
   after MAX_OP_N ops the fragment snapshots: full roaring serialization
@@ -38,7 +38,7 @@ import tarfile
 import threading
 import time
 from collections import OrderedDict
-from dataclasses import dataclass, field, replace
+from dataclasses import dataclass, field
 from typing import Any, Iterable, Sequence
 
 import numpy as np
@@ -149,16 +149,22 @@ class TopOptions:
 @dataclass
 class TopState:
     """In-flight TopN work on one fragment, between top_prepare (async
-    kernel dispatch) and top_finish (fetch + selection).  ``done`` short-
-    circuits the src-less / empty cases; otherwise ``dev_counts`` holds
-    the un-fetched device score vector (the executor may bulk-fetch many
-    fragments' vectors in one round trip and hand the result back via
-    ``counts``)."""
+    kernel dispatch) and top_finish (fetch + selection) — array-native:
+    candidate ids / cached counts are int64 ndarrays in candidate
+    (count-descending) order, and the dense/sparse score tiers are
+    POSITIONS into that order.  ``done_ids``/``done_cnts`` short-circuit
+    the src-less / empty cases with a final (filtered, sorted, trimmed)
+    result; otherwise ``dev_counts`` holds the un-fetched device score
+    vector (the executor may bulk-fetch many fragments' vectors in one
+    round trip and hand the result back via ``counts``)."""
 
-    done: list | None = None
-    candidates: list = None
-    dense_ids: list = None
-    by_id: dict = None
+    done_ids: np.ndarray | None = None
+    done_cnts: np.ndarray | None = None
+    cand_ids: np.ndarray | None = None
+    cand_cached: np.ndarray | None = None
+    dense_pos: np.ndarray | None = None
+    sparse_pos: np.ndarray | None = None
+    sparse_cnt: np.ndarray | None = None
     n: int = 0
     tanimoto: int = 0
     src_count: int = 0
@@ -215,6 +221,10 @@ class Fragment:
         # instead of re-gathering ~rows x 128 KiB from the plane each
         # time (2 entries = the two phases of one hot query).
         self._topn_sub: "OrderedDict[tuple, object]" = OrderedDict()
+        # Sorted tier-key arrays for vectorized dense/sparse candidate
+        # splits (see _tier_key_arrays_locked), cached per version.
+        self._tier_arrays = None
+        self._tier_arrays_version = -1
         self._max_row_id = 0
         self._op_n = 0
         self._version = 0
@@ -879,8 +889,8 @@ class Fragment:
         node per phase, not one per slice)."""
         opt = opt or TopOptions()
         with self._mu:
-            pairs = self._top_candidates(opt.row_ids)
-        return self._top_score_prepare(pairs, opt)
+            ids, cnts = self._top_candidates_arrays(opt.row_ids)
+        return self._top_score_prepare(ids, cnts, opt, bool(opt.row_ids))
 
     def top_finish(self, st: "TopState") -> list[Pair]:
         """Phase 2: resolve the dense score fetch (or accept one already
@@ -888,32 +898,48 @@ class Fragment:
         threshold/tanimoto selection.  Expressed over
         ``top_score_arrays`` so the scoring arithmetic has exactly one
         implementation."""
-        if st.done is not None:
-            return st.done
-        ids, cnts, keep, _ = self.top_score_arrays(st)
-        ids, cnts = ids[keep], cnts[keep]
-        order = np.lexsort((ids, -cnts))  # sort_pairs' (-count, id) key
-        if st.n:
-            order = order[: st.n]
-        return [Pair(int(ids[k]), int(cnts[k])) for k in order]
+        ids, cnts, keep, short = self.top_score_arrays(st)
+        if not short:
+            ids, cnts = ids[keep], cnts[keep]
+            order = np.lexsort((ids, -cnts))  # sort_pairs' (-count, id)
+            if st.n:
+                order = order[: st.n]
+            ids, cnts = ids[order], cnts[order]
+        return [Pair(int(i), int(c)) for i, c in zip(ids, cnts)]
 
-    def top_candidates(self, opt: TopOptions | None = None) -> list[Pair]:
-        """The filtered candidate list phase-1 scoring would use (cache
-        ranking + threshold/tanimoto-window/attr filters) — host-only, no
-        device work.  The executor's folded TopN uses this to form the
-        cross-slice candidate union before any scoring dispatch."""
+    def top_candidates_arrays(
+        self, opt: TopOptions | None = None
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """(ids, cached counts) of the filtered candidate listing phase-1
+        scoring would use (cache ranking + threshold/tanimoto-window/attr
+        filters) — host-only, no device work, array-native.  The
+        executor's folded TopN uses this to form the cross-slice
+        candidate union before any scoring dispatch."""
         opt = opt or TopOptions()
         with self._mu:
-            pairs = self._top_candidates(opt.row_ids)
-        candidates, _, _ = self._filter_candidates(pairs, opt)
-        return candidates
+            ids, cnts = self._top_candidates_arrays(opt.row_ids)
+        ids, cnts, _, _ = self._filter_arrays(ids, cnts, opt)
+        return ids, cnts
 
-    def _filter_candidates(
-        self, pairs: list[Pair], opt: TopOptions
-    ) -> tuple[list[Pair], int, int]:
-        """Candidate filtering on cached counts (cheap, host-side).
-        Returns (candidates, tanimoto, src_count)."""
-        filters = None
+    def _filter_arrays(
+        self, ids: np.ndarray, cnts: np.ndarray, opt: TopOptions
+    ) -> tuple[np.ndarray, np.ndarray, int, int]:
+        """Candidate filtering on cached counts, vectorized (reference:
+        fragment.go:535-594 candidate loop).  Returns
+        ``(ids, cnts, tanimoto, src_count)`` with the filters applied;
+        attr filters fall back to a per-survivor dict probe (they need
+        the attr store either way)."""
+        tanimoto = 0
+        src_count = 0
+        mask = cnts > 0
+        if opt.tanimoto_threshold > 0 and opt.src is not None:
+            tanimoto = opt.tanimoto_threshold
+            src_count = opt.src.count()
+            min_tan = float(src_count * tanimoto) / 100
+            max_tan = float(src_count * 100) / float(tanimoto)
+            mask &= (cnts > min_tan) & (cnts < max_tan)
+        elif opt.min_threshold:
+            mask &= cnts >= opt.min_threshold
         if opt.filter_field and opt.filter_values:
             filters = set()
             for v in opt.filter_values:
@@ -921,33 +947,15 @@ class Fragment:
                     filters.add(v)
                 except TypeError:
                     pass
-
-        tanimoto = 0
-        min_tan = max_tan = 0.0
-        src_count = 0
-        if opt.tanimoto_threshold > 0 and opt.src is not None:
-            tanimoto = opt.tanimoto_threshold
-            src_count = opt.src.count()
-            min_tan = float(src_count * tanimoto) / 100
-            max_tan = float(src_count * 100) / float(tanimoto)
-
-        candidates: list[Pair] = []
-        for p in pairs:
-            if p.count <= 0:
-                continue
-            if tanimoto > 0:
-                if float(p.count) <= min_tan or float(p.count) >= max_tan:
-                    continue
-            elif p.count < opt.min_threshold:
-                continue
-            if filters is not None:
-                if self.row_attr_store is None:
-                    continue
-                attrs = self.row_attr_store.attrs(p.id)
-                if not attrs or attrs.get(opt.filter_field) not in filters:
-                    continue
-            candidates.append(p)
-        return candidates, tanimoto, src_count
+            store = self.row_attr_store
+            if store is None:
+                mask[:] = False
+            else:
+                for k in np.flatnonzero(mask):
+                    attrs = store.attrs(int(ids[k]))
+                    if not attrs or attrs.get(opt.filter_field) not in filters:
+                        mask[k] = False
+        return ids[mask], cnts[mask], tanimoto, src_count
 
     @staticmethod
     def select_winners(
@@ -960,8 +968,8 @@ class Fragment:
         """Phase-1 winner selection over a scored union restricted to
         ``cand_ids``: filter mask, (-count, id) sort (sort_pairs'
         canonical order), trim to ``n``.  The ONE implementation of the
-        selection rule, shared by ``top_select`` and the executor's
-        folded TopN."""
+        phase-1 selection rule (consumed by the executor's folded
+        TopN)."""
         m = keep & np.isin(ids, cand_ids)
         sel_ids, sel_cnts = ids[m], cnts[m]
         order = np.lexsort((sel_ids, -sel_cnts))
@@ -969,59 +977,57 @@ class Fragment:
             order = order[:n]
         return sel_ids[order], sel_cnts[order]
 
-    def top_select(self, st: "TopState", candidates: list[Pair], n: int) -> list[Pair]:
-        """Winner selection for a candidate SUBSET of a union scoring
-        pass (the executor's folded TopN): returns what phase-1 scoring
-        of exactly ``candidates`` would have produced, reading scores
-        from ``st``."""
-        ids, cnts, keep, short = self.top_score_arrays(st)
-        if short:
-            # Union scoring short-circuited (no src segment here / no
-            # union candidate in this fragment's tiers): scoring the
-            # subset would short-circuit identically.
-            return st.done
-        cand_ids = np.fromiter(
-            (p.id for p in candidates), np.int64, len(candidates)
-        )
-        sel_ids, sel_cnts = self.select_winners(ids, cnts, keep, cand_ids, n)
-        return [Pair(int(i), int(c)) for i, c in zip(sel_ids, sel_cnts)]
+    _EMPTY_I64 = np.empty(0, np.int64)
 
-    def _top_score_prepare(self, pairs: list[Pair], opt: TopOptions) -> "TopState":
-        n = 0 if (opt.row_ids) else opt.n
-        candidates, tanimoto, src_count = self._filter_candidates(pairs, opt)
+    def _top_score_prepare(
+        self,
+        ids: np.ndarray,
+        cached: np.ndarray,
+        opt: TopOptions,
+        row_ids_mode: bool,
+    ) -> "TopState":
+        """``ids``/``cached`` are the (unfiltered) candidate arrays in
+        count-descending order; ``row_ids_mode`` mirrors the reference's
+        explicit-ids behavior of returning every scored row (n applies
+        only to ranked-cache candidates, reference: fragment.go:516)."""
+        n = 0 if row_ids_mode else opt.n
+        ids, cached, tanimoto, src_count = self._filter_arrays(ids, cached, opt)
 
         if opt.src is None:
             # No intersection: cached counts are final.  Candidates are
             # already count-descending; take the first n.
-            result = candidates[:n] if n else candidates
-            return TopState(done=list(result))
+            if n and n < len(ids):
+                ids, cached = ids[:n], cached[:n]
+            return TopState(done_ids=ids, done_cnts=cached)
 
         # Batched intersection scoring: one fused kernel over all
         # candidate rows at once (replaces the reference's sequential
         # threshold-pruned loop, fragment.go:601-627).
-        if not candidates:
-            return TopState(done=[])
+        if not len(ids):
+            return TopState(done_ids=self._EMPTY_I64, done_cnts=self._EMPTY_I64)
         src_seg = opt.src.segments.get(self.slice)
         if src_seg is None:
-            return TopState(done=[])
+            return TopState(done_ids=self._EMPTY_I64, done_cnts=self._EMPTY_I64)
         src_words = np.asarray(src_seg, dtype=np.uint32)
         with self._mu:
-            dense_ids = [p.id for p in candidates if p.id in self._slot_of]
-            sparse_ids = [p.id for p in candidates if p.id in self._sparse]
-            if not dense_ids and not sparse_ids:
-                return TopState(done=[])
-            by_id: dict[int, int] = {}
+            slot_ids, slot_vals, sparse_sorted = self._tier_key_arrays_locked()
+            dense_pos = np.flatnonzero(np.isin(ids, slot_ids))
+            sparse_pos = np.flatnonzero(np.isin(ids, sparse_sorted))
+            if not len(dense_pos) and not len(sparse_pos):
+                return TopState(
+                    done_ids=self._EMPTY_I64, done_cnts=self._EMPTY_I64
+                )
             sub = None
-            if dense_ids:
+            if len(dense_pos):
                 # Gather candidate rows from the HBM-resident plane —
                 # only the src row and slot indices travel host->device —
                 # and cache the gathered submatrix per candidate set.
-                sub_key = (self._version, tuple(dense_ids))
+                slots = slot_vals[
+                    np.searchsorted(slot_ids, ids[dense_pos])
+                ].astype(np.int32)
+                sub_key = (self._version, slots.tobytes())
                 sub = self._topn_sub.get(sub_key)
                 if sub is None:
-                    slots = np.asarray(
-                        [self._slot_of[i] for i in dense_ids], dtype=np.int32
-                    )
                     # Pad the gather to a full row block (repeating the
                     # last slot) so the scorer's row count stays on the
                     # tile-aligned kernel path; surplus scores are
@@ -1037,70 +1043,80 @@ class Fragment:
                     self._topn_sub.move_to_end(sub_key)
             # Sparse candidates (the low-count tail) score host-side in
             # O(set bits): probe src's words at each offset.
-            for rid in sparse_ids:
-                offs = self._sparse[rid]
-                by_id[rid] = int(
+            sparse_cnt = np.empty(len(sparse_pos), np.int64)
+            for j, k in enumerate(sparse_pos):
+                offs = self._sparse[int(ids[k])]
+                sparse_cnt[j] = int(
                     ((src_words[offs >> 5] >> (offs & np.uint32(31)))
                      & np.uint32(1)).sum()
                 )
         st = TopState(
-            candidates=candidates,
-            dense_ids=dense_ids,
-            by_id=by_id,
+            cand_ids=ids,
+            cand_cached=cached,
+            dense_pos=dense_pos,
+            sparse_pos=sparse_pos,
+            sparse_cnt=sparse_cnt,
             n=n,
             tanimoto=tanimoto,
             src_count=src_count,
             min_threshold=opt.min_threshold,
         )
-        if dense_ids:
+        if len(dense_pos):
             # ASYNC dispatch — the fetch happens in top_finish (or in
             # bulk by the executor across all slices).
             st.dev_counts = bp.top_counts(sub, src_words)
         return st
 
+    def _tier_key_arrays_locked(self):
+        """Sorted key arrays of the two row tiers, cached per fragment
+        version: ``(slot_ids_sorted, slot_vals_aligned, sparse_ids_
+        sorted)`` — turns the per-candidate dict membership walk into
+        three vector ops.  Callers hold ``_mu``."""
+        if self._tier_arrays is None or self._tier_arrays_version != self._version:
+            sids = np.fromiter(self._slot_of.keys(), np.int64, len(self._slot_of))
+            svals = np.fromiter(
+                self._slot_of.values(), np.int64, len(self._slot_of)
+            )
+            order = np.argsort(sids)
+            spids = np.sort(
+                np.fromiter(self._sparse.keys(), np.int64, len(self._sparse))
+            )
+            self._tier_arrays = (sids[order], svals[order], spids)
+            self._tier_arrays_version = self._version
+        return self._tier_arrays
+
     def top_score_arrays(
         self, st: "TopState"
     ) -> tuple[np.ndarray, np.ndarray, np.ndarray, bool]:
         """Vectorized view of a scoring pass: ``(ids, counts, keep,
-        done)`` over ``st.candidates`` in candidate order, where ``keep``
+        done)`` over the candidates in candidate order, where ``keep``
         is the threshold/tanimoto filter mask ``top_finish`` would apply
-        element-wise.  ``done=True`` means the pass short-circuited
-        (``st.done``) and ``ids/counts`` are that final, already-filtered
-        list with ``keep`` all-true.
+        element-wise.  ``done=True`` means the pass short-circuited and
+        ``ids/counts`` are that final, already-filtered list with
+        ``keep`` all-true.
 
         The folded executor TopN consumes this instead of ``top_finish``:
         at 2k candidates x several calls per query, building and merging
         Pair objects in Python dominated warm TopN host time; the numpy
         formulation does the identical arithmetic in a few vector ops.
         """
-        if st.done is not None:
-            ids = np.fromiter((p.id for p in st.done), np.int64, len(st.done))
-            cnts = np.fromiter(
-                (p.count for p in st.done), np.int64, len(st.done)
+        if st.done_ids is not None:
+            return (
+                st.done_ids,
+                st.done_cnts,
+                np.ones(len(st.done_ids), dtype=bool),
+                True,
             )
-            return ids, cnts, np.ones(len(ids), dtype=bool), True
-        cand = st.candidates
-        ids = np.fromiter((p.id for p in cand), np.int64, len(cand))
-        cached = np.fromiter((p.count for p in cand), np.int64, len(cand))
-        cnts = np.zeros(len(cand), np.int64)
-        if st.dense_ids:
+        ids, cached = st.cand_ids, st.cand_cached
+        cnts = np.zeros(len(ids), np.int64)
+        if st.dense_pos is not None and len(st.dense_pos):
             if st.counts is None:
                 st.counts = np.asarray(st.dev_counts)
-            # dense_ids/sparse_ids were built in candidate order, so the
-            # positional masks recover their candidate indices directly.
-            dense_pos = np.flatnonzero(
-                np.isin(ids, np.asarray(st.dense_ids, dtype=np.int64))
+            cnts[st.dense_pos] = np.asarray(
+                st.counts[: len(st.dense_pos)], dtype=np.int64
             )
-            cnts[dense_pos] = np.asarray(
-                st.counts[: len(st.dense_ids)], dtype=np.int64
-            )
-        if st.by_id:
-            sparse_ids = np.fromiter(st.by_id.keys(), np.int64, len(st.by_id))
-            sparse_cnt = np.fromiter(st.by_id.values(), np.int64, len(st.by_id))
-            order = np.argsort(sparse_ids)
-            pos = np.flatnonzero(np.isin(ids, sparse_ids))
-            at = np.searchsorted(sparse_ids[order], ids[pos])
-            cnts[pos] = sparse_cnt[order][at]
+        if st.sparse_pos is not None and len(st.sparse_pos):
+            cnts[st.sparse_pos] = st.sparse_cnt
         if st.tanimoto > 0:
             denom = cached + st.src_count - cnts
             with np.errstate(divide="ignore", invalid="ignore"):
@@ -1110,19 +1126,28 @@ class Fragment:
             keep = (cnts > 0) & (cnts >= st.min_threshold)
         return ids, cnts, keep, False
 
-    def _top_candidates(self, row_ids: list[int] | None) -> list[Pair]:
+    def _top_candidates_arrays(
+        self, row_ids: list[int] | None
+    ) -> tuple[np.ndarray, np.ndarray]:
         """reference: fragment.go:641-673 topBitmapPairs"""
         if not row_ids:
             # invalidate() is throttle-aware: the re-sort happens at most
             # every RECALCULATE_INTERVAL_S (reference: cache.go:236-241).
             self.cache.invalidate()
-            return self.cache.top()
-        pairs = []
-        for row_id in row_ids:
-            n = self._row_count_locked(row_id)
-            if n > 0:
-                pairs.append(Pair(row_id, n))
-        return cache_mod.sort_pairs(pairs)
+            return self.cache.top_arrays()
+        ids, cnts = [], []
+        # Dedupe explicit ids: a duplicated id would be scored twice and
+        # its counts SUMMED by the cross-slice merge (and break the
+        # assume_unique contract of top_prepare_union's setdiff).
+        for row_id in dict.fromkeys(row_ids):
+            c = self._row_count_locked(row_id)
+            if c > 0:
+                ids.append(row_id)
+                cnts.append(c)
+        ids = np.asarray(ids, np.int64)
+        cnts = np.asarray(cnts, np.int64)
+        order = np.lexsort((ids, -cnts))
+        return ids[order], cnts[order]
 
     def _row_count_locked(self, row_id: int) -> int:
         """Count resolution for candidate listing (callers hold _mu):
@@ -1137,24 +1162,32 @@ class Fragment:
         return n
 
     def top_prepare_union(
-        self, union: list[int], cand: list[Pair], opt: TopOptions
+        self,
+        union_ids: np.ndarray,
+        cand_ids: np.ndarray,
+        cand_cnts: np.ndarray,
+        opt: TopOptions,
     ) -> "TopState":
         """The folded executor TopN's union scoring pass: equivalent to
         ``top_prepare(replace(opt, row_ids=union))`` but reuses the
-        already-listed candidate Pairs, constructing new ones only for
+        already-listed candidate arrays, resolving counts only for
         union ids this slice's own cache walk didn't produce (foreign
-        winners) — O(missing) host work instead of O(union)."""
-        have = {p.id for p in cand}
-        pairs = list(cand)
+        winners) — O(missing) host work instead of O(union).
+        ``union_ids`` must be unique (np.unique output)."""
         with self._mu:
-            for rid in union:
-                if rid in have:
-                    continue
-                n = self._row_count_locked(rid)
-                if n > 0:
-                    pairs.append(Pair(rid, n))
-        pairs = cache_mod.sort_pairs(pairs)
-        return self._top_score_prepare(pairs, replace(opt, row_ids=union))
+            foreign = np.setdiff1d(union_ids, cand_ids, assume_unique=True)
+            f_cnts = np.fromiter(
+                (self._row_count_locked(int(r)) for r in foreign),
+                np.int64,
+                len(foreign),
+            )
+        fm = f_cnts > 0
+        all_ids = np.concatenate([cand_ids, foreign[fm]])
+        all_cnts = np.concatenate([cand_cnts, f_cnts[fm]])
+        order = np.lexsort((all_ids, -all_cnts))
+        return self._top_score_prepare(
+            all_ids[order], all_cnts[order], opt, row_ids_mode=True
+        )
 
     # ------------------------------------------------------------------
     # block checksums + sync (reference: fragment.go:694-934)

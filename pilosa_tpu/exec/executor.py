@@ -922,18 +922,18 @@ class Executor:
         # up front turns every per-slice walk below into O(fragments).
         slices = self._existing_topn_slices(index, c, slices)
 
-        # Pass 1 (host-only): per-slice candidate lists, WITHOUT
-        # evaluating the src tree yet — the union guard below must be
-        # able to fall back before any src work is spent.  A src only
-        # shrinks candidate lists (tanimoto count-window), so the
-        # src-free walk is a conservative union estimate.
+        # Pass 1 (host-only): per-slice candidate (ids, cached counts)
+        # arrays, WITHOUT evaluating the src tree yet — the union guard
+        # below must be able to fall back before any src work is spent.
+        # A src only shrinks candidate lists (tanimoto count-window), so
+        # the src-free walk is a conservative union estimate.
         per: list[tuple] = []
         for s in slices:
             prep = self._topn_options_for_slice(index, c, s, None)
             if prep is None:
                 continue
             frag, topt = prep
-            per.append((frag, topt, frag.top_candidates(topt)))
+            per.append((frag, topt) + frag.top_candidates_arrays(topt))
         if not per:
             return []
         # Guard against disjoint caches: every slice scores the WHOLE
@@ -942,14 +942,13 @@ class Executor:
         # the two saved round trips are worth — use the two-phase
         # protocol instead.  Overlapping hot rows (the common shape)
         # keep union ~= per-slice candidates and stay folded.
-        union_est = {p.id for _, _, cand in per for p in cand}
-        if not union_est:
+        union = np.unique(np.concatenate([ids for _, _, ids, _ in per]))
+        if not len(union):
             return []
-        max_cand = max(len(cand) for _, _, cand in per)
-        if len(union_est) > max(2 * max_cand, 512):
+        max_cand = max(len(ids) for _, _, ids, _ in per)
+        if len(union) > max(2 * max_cand, 512):
             return self._execute_topn_two_phase(index, c, slices, opt, n)
 
-        union = sorted(union_est)
         if has_src:
             src_rows = self._eval_tree_slices_host(index, c.children[0], slices)
             if _uint_arg(c, "tanimotoThreshold")[0] > 0:
@@ -961,34 +960,43 @@ class Executor:
                     if prep is None:
                         continue
                     frag, topt = prep
-                    per.append((frag, topt, frag.top_candidates(topt)))
-                union = sorted({p.id for _, _, cand in per for p in cand})
+                    per.append((frag, topt) + frag.top_candidates_arrays(topt))
+                if not per:
+                    return []
+                union = np.unique(
+                    np.concatenate([ids for _, _, ids, _ in per])
+                )
             else:
                 # Without tanimoto, candidate filtering never reads the
                 # src — only the scorer does.  Attach it to the pass-1
                 # options instead of re-walking every candidate list.
                 attached = []
-                for frag, topt, cand in per:
+                for frag, topt, ids, cnts in per:
                     src = RowBitmap()
                     row = src_rows.get(frag.slice)
                     if row is not None:
                         src.set_segment(frag.slice, row)
-                    attached.append((frag, replace(topt, src=src), cand))
+                    attached.append((frag, replace(topt, src=src), ids, cnts))
                 per = attached
-        if not union:
+        if not len(union):
             return []
 
         # Pass 2: score the union on every slice; ONE bulk fetch.  The
-        # union pass reuses each slice's candidate Pairs and constructs
-        # only the foreign winners' (top_prepare_union).
+        # union pass reuses each slice's candidate arrays and resolves
+        # counts only for the foreign winners (top_prepare_union).
         states: list[tuple] = []
-        for frag, topt, cand in per:
+        for frag, topt, cand_ids, cand_cnts in per:
             states.append(
-                (frag, topt, cand, frag.top_prepare_union(union, cand, topt))
+                (
+                    frag,
+                    topt,
+                    cand_ids,
+                    frag.top_prepare_union(union, cand_ids, cand_cnts, topt),
+                )
             )
         pending = [
             st for _, _, _, st in states
-            if st.done is None and st.dev_counts is not None
+            if st.done_ids is None and st.dev_counts is not None
         ]
         if pending:
             fetched = jax.device_get([st.dev_counts for st in pending])
@@ -997,25 +1005,23 @@ class Executor:
 
         # Phase-1 winner selection per slice, from the same scores the
         # two-phase protocol's first round would have produced for the
-        # slice's own candidates (cand is a subset of the union) — all
-        # in numpy: at union scale, Pair-object bookkeeping in Python
-        # dominated warm TopN host time.
+        # slice's own candidates (cand_ids is a subset of the union) —
+        # all in numpy: at union scale, Pair-object bookkeeping in
+        # Python dominated warm TopN host time.
         winner_ids: list[np.ndarray] = []
         fulls: list[tuple[np.ndarray, np.ndarray]] = []
-        for frag, topt, cand, st in states:
+        for frag, topt, cand_ids, st in states:
             ids, cnts, keep, short = frag.top_score_arrays(st)
             fulls.append((ids[keep], cnts[keep]))
             if topt.src is None:
-                sel = cand[: topt.n] if topt.n else cand
                 winner_ids.append(
-                    np.fromiter((p.id for p in sel), np.int64, len(sel))
+                    cand_ids[: topt.n] if topt.n else cand_ids
                 )
             elif short:
                 # Scoring short-circuited (e.g. no src segment here):
                 # the subset selection would short-circuit identically.
                 winner_ids.append(ids)
             else:
-                cand_ids = np.fromiter((p.id for p in cand), np.int64, len(cand))
                 sel_ids, _ = frag.select_winners(ids, cnts, keep, cand_ids, topt.n)
                 winner_ids.append(sel_ids)
         ids2 = (
@@ -1078,7 +1084,7 @@ class Executor:
             pending = [
                 st
                 for _, st in states
-                if st.done is None and st.dev_counts is not None
+                if st.done_ids is None and st.dev_counts is not None
             ]
             if pending:
                 # device_get starts async host copies for EVERY vector
@@ -1087,10 +1093,31 @@ class Executor:
                 fetched = jax.device_get([st.dev_counts for st in pending])
                 for st, arr in zip(pending, fetched):
                     st.counts = arr
-            acc: list[Pair] = []
+            # Merge all slices' results in one numpy pass (counts sum
+            # by id — Pairs.Add semantics, reference: cache.go:312-334);
+            # Pairs materialize once at the protocol boundary.
+            parts = []
             for frag, st in states:
-                acc = cache_mod.add_pairs(acc, frag.top_finish(st))
-            return acc
+                ids, cnts, keep, short = frag.top_score_arrays(st)
+                if short:
+                    parts.append((ids, cnts))
+                else:
+                    sel = keep
+                    ids, cnts = ids[sel], cnts[sel]
+                    if st.n and st.n < len(ids):
+                        order = np.lexsort((ids, -cnts))[: st.n]
+                        ids, cnts = ids[order], cnts[order]
+                    parts.append((ids, cnts))
+            if not parts:
+                return []
+            cat_ids = np.concatenate([i for i, _ in parts])
+            if not len(cat_ids):
+                return []
+            cat_cnts = np.concatenate([cn for _, cn in parts])
+            uids, inv = np.unique(cat_ids, return_inverse=True)
+            sums = np.zeros(len(uids), np.int64)
+            np.add.at(sums, inv, cat_cnts)
+            return [Pair(int(i), int(cnt)) for i, cnt in zip(uids, sums)]
 
         def reduce_fn(prev, v):
             return cache_mod.add_pairs(prev or [], v)

@@ -237,6 +237,15 @@ def test_topn_ids(ex, holder):
     assert [(p.id, p.count) for p in pairs] == [(0, 2), (12, 1)]
 
 
+def test_topn_duplicate_ids_not_double_counted(ex, holder):
+    """A duplicated explicit id must not be scored twice (the cross-
+    slice merge SUMS counts by id, so a duplicate would double the
+    reported count)."""
+    must_set_bits(holder, "i", "f", [(0, 0), (0, 1), (0, 2)])
+    (pairs,) = q(ex, "i", "TopN(frame=f, ids=[0, 0])")
+    assert [(p.id, p.count) for p in pairs] == [(0, 3)]
+
+
 def test_topn_tanimoto_bounds(ex, holder):
     must_set_bits(holder, "i", "f", [(0, 0)])
     with pytest.raises(ExecutorError, match="Tanimoto"):

@@ -76,35 +76,32 @@ def main():
     # ~819 GB/s) is retried rather than reported — the same hardening
     # bench.py's slope_time carries.
     N_LO, N_HI = 4, 28
-    SANITY_PEAK = 819e9 * 1.25
+    from bench import hbm_peak_bytes_s, measure_slope
 
-    def probe(name, fn, epochs=6, tries=3):
+    peak = hbm_peak_bytes_s(jax) if jax.default_backend() == "tpu" else None
+
+    def probe(name, fn):
         try:
             jax.block_until_ready(fn(batches[0]))  # compile
         except Exception as e:  # noqa: BLE001
             log(f"{name}: compile failed {e!r:.200}")
             return None
-        lo_in = [batches[i % K] for i in range(N_LO)]
-        hi_in = [batches[i % K] for i in range(N_HI)]
-        for attempt in range(tries):
-            lo = hi = float("inf")
-            for _ in range(epochs):
-                lo = min(lo, folded(fn, lo_in))
-                hi = min(hi, folded(fn, hi_in))
-            slope = (hi - lo) / (N_HI - N_LO)
-            if slope > 0 and bytes_per / slope <= SANITY_PEAK:
-                log(
-                    f"{name}: wall {lo*1e3:.1f} ms/{N_LO} runs,"
-                    f" {hi*1e3:.1f} ms/{N_HI} runs; slope {slope*1e3:.3f}"
-                    f" ms/run -> {bytes_per/slope/1e9:.0f} GB/s operand read"
-                )
-                return slope
-            log(
-                f"{name}: slope implausible ({slope*1e6:.1f} us/run);"
-                f" pool interference — retry {attempt + 1}/{tries}"
-            )
-        log(f"{name}: UNRELIABLE after {tries} tries")
-        return None
+        slope = measure_slope(
+            lambda inputs: folded(fn, inputs),
+            [batches[i % K] for i in range(N_LO)],
+            [batches[i % K] for i in range(N_HI)],
+            bytes_per,
+            (peak or 819e9) * 1.25,
+            lambda m: log(f"{name}: {m}"),
+        )
+        if slope is None:
+            log(f"{name}: UNRELIABLE (pool interference)")
+            return None
+        log(
+            f"{name}: slope {slope*1e3:.3f} ms/run"
+            f" -> {bytes_per/slope/1e9:.0f} GB/s operand read"
+        )
+        return slope
 
     probe("stream-sum", jax.jit(lambda d: jnp.sum(d, dtype=jnp.uint32)))
     probe(
