@@ -24,6 +24,7 @@ from pilosa_tpu.core import cache as cache_mod
 from pilosa_tpu.core import timequantum as tq
 from pilosa_tpu.core.attr import AttrStore
 from pilosa_tpu.core.names import ValidationError, validate_label, validate_name
+from pilosa_tpu.core import fragment as fragment_mod
 from pilosa_tpu.core.view import (
     VIEW_INVERSE,
     VIEW_STANDARD,
@@ -144,6 +145,10 @@ class Frame:
         with self._mu:
             self.time_quantum = tq.parse_time_quantum(q)
             self.save_meta()
+        # A quantum change alters which time views a Range() reads —
+        # invalidate epoch-validated read caches (executor leaf batches)
+        # exactly like a data write would.
+        fragment_mod._bump_write_epoch()
 
     # --- views (reference: frame.go:336-395) ---
 

@@ -237,6 +237,55 @@ def test_topn_ids(ex, holder):
     assert [(p.id, p.count) for p in pairs] == [(0, 2), (12, 1)]
 
 
+def test_topn_fill(ex, holder):
+    """reference: executor_test.go:328-349 TestExecutor_Execute_TopN_fill
+    — the global winner needs exact counts summed across slices even
+    when per-slice phase-1 lists disagree."""
+    must_set_bits(
+        holder, "i", "f",
+        [(0, 0), (0, 1), (0, 2),
+         (0, SLICE_WIDTH), (1, SLICE_WIDTH + 2), (1, SLICE_WIDTH)],
+    )
+    (pairs,) = q(ex, "i", "TopN(frame=f, n=1)")
+    assert [(p.id, p.count) for p in pairs] == [(0, 4)]
+
+
+def test_topn_fill_small(ex, holder):
+    """reference: executor_test.go:352-382 TestExecutor_Execute_TopN_
+    fill_small — a row that is never any single slice's per-slice
+    winner by margin still wins globally once counts are summed."""
+    bits = [(0, s * SLICE_WIDTH) for s in range(5)]
+    bits += [(1, 0), (1, 1)]
+    bits += [(2, SLICE_WIDTH), (2, SLICE_WIDTH + 1)]
+    bits += [(3, 2 * SLICE_WIDTH), (3, 2 * SLICE_WIDTH + 1)]
+    bits += [(4, 3 * SLICE_WIDTH), (4, 3 * SLICE_WIDTH + 1)]
+    must_set_bits(holder, "i", "f", bits)
+    (pairs,) = q(ex, "i", "TopN(frame=f, n=1)")
+    assert [(p.id, p.count) for p in pairs] == [(0, 5)]
+
+
+def test_read_calls_counted_with_index_tag(ex, holder):
+    """Read calls fire a per-call-name counter tagged index:<name>
+    (reference: executor.go:163-181, stats_test.go:75-131)."""
+    must_set_bits(holder, "i", "f", [(0, 0), (0, 1)])
+    calls = []
+
+    class Spy:
+        def count_with_custom_tags(self, name, value, tags):
+            calls.append((name, value, tuple(tags)))
+
+        def __getattr__(self, _):
+            return lambda *a, **k: None
+
+    holder.stats = Spy()
+    q(ex, "i", "TopN(frame=f, n=1)")
+    q(ex, "i", "Count(Bitmap(rowID=0, frame=f))")
+    q(ex, "i", "Bitmap(rowID=0, frame=f)")
+    assert ("TopN", 1, ("index:i",)) in calls
+    assert ("Count", 1, ("index:i",)) in calls
+    assert ("Bitmap", 1, ("index:i",)) in calls
+
+
 def test_topn_duplicate_ids_not_double_counted(ex, holder):
     """A duplicated explicit id must not be scored twice (the cross-
     slice merge SUMS counts by id, so a duplicate would double the
@@ -458,14 +507,40 @@ def test_batch_cache_unrelated_write_revalidates_without_rebuild(ex, holder):
             assert ent["batch"] is ent_before
 
 
-def test_batch_cache_range_leaves_uncached(ex, holder):
+def test_batch_cache_range_leaves_cached_and_write_invalidated(ex, holder):
+    """Range batches cache like Bitmap batches (their validity entries
+    carry the quantum + every time-view fragment's version); a write
+    into a time view must invalidate them."""
     idx = holder.create_index("i")
     idx.create_frame("f", time_quantum="YMDH")
     q(ex, "i", 'SetBit(frame=f, rowID=1, columnID=2, timestamp="2010-01-01T00:00")')
     pql = ('Count(Range(rowID=1, frame=f, start="2010-01-01T00:00",'
            ' end="2010-12-31T23:59"))')
     assert q(ex, "i", pql) == [1]
-    assert all(key[1].find("Range") == -1 for key in ex._batch_cache)
+    assert any(key[1].find("Range") != -1 for key in ex._batch_cache)
+    assert q(ex, "i", pql) == [1]  # warm: served from the cached batch
+    q(ex, "i", 'SetBit(frame=f, rowID=1, columnID=7, timestamp="2010-06-15T00:00")')
+    assert q(ex, "i", pql) == [2]
+
+
+def test_batch_cache_range_invalidated_by_quantum_change(ex, holder):
+    """set_time_quantum changes which views a Range reads — it bumps
+    the write epoch so cached Range batches revalidate."""
+    idx = holder.create_index("i")
+    f = idx.create_frame("f", time_quantum="YMDH")
+    q(ex, "i", 'SetBit(frame=f, rowID=1, columnID=2, timestamp="2010-01-01T00:00")')
+    pql = ('Count(Range(rowID=1, frame=f, start="2010-01-01T00:00",'
+           ' end="2010-12-31T23:59"))')
+    assert q(ex, "i", pql) == [1]
+    f.set_time_quantum("Y")
+    # The partial-year range can no longer be covered by whole-year
+    # views (reference: time.go:95-167 ViewsByTimeRange semantics), so
+    # a STALE cached batch returning [1] would be the bug here.
+    assert q(ex, "i", pql) == [0]
+    year_pql = ('Count(Range(rowID=1, frame=f, start="2010-01-01T00:00",'
+                ' end="2011-01-01T00:00"))')
+    # The year-aligned range reads the Y view the SetBit fan-out wrote.
+    assert q(ex, "i", year_pql) == [1]
 
 
 def test_batch_cache_invalidated_by_frame_delete(ex, holder):
