@@ -421,16 +421,24 @@ class Fragment:
         self._slot_of[row_id] = slot
         needed = bp.pad_rows(slot + 1)
         if needed > self._plane.shape[0]:
-            grow = max(
-                needed, min(2 * self._plane.shape[0], self.dense_row_budget)
+            self._reserve_dense(
+                max(needed, min(2 * self._plane.shape[0], self.dense_row_budget))
             )
+        return slot
+
+    def _reserve_dense(self, n_slots: int) -> None:
+        """Grow the dense plane to hold ``n_slots`` rows in ONE
+        allocation.  Bulk imports pre-size for all their new rows up
+        front — growing through the doubling path copies the whole
+        plane O(log n) times."""
+        needed = bp.pad_rows(max(n_slots, 1))
+        if needed > self._plane.shape[0]:
             extra = np.zeros(
-                (grow - self._plane.shape[0], bp.WORDS_PER_SLICE), np.uint32
+                (needed - self._plane.shape[0], bp.WORDS_PER_SLICE), np.uint32
             )
             self._plane = np.vstack([self._plane, extra])
             # the device mirror no longer matches the plane's shape
             self._invalidate_device()
-        return slot
 
     def _maybe_promote(self, row_id: int) -> None:
         """Sparse rows past PROMOTE_BITS move to the dense tier while
@@ -501,24 +509,67 @@ class Fragment:
         self._invalidate_device()
         _bump_write_epoch()
 
-    def _containers_tiered(
+    def _containers_packed(
         self,
-    ) -> tuple[dict[int, np.ndarray], dict[int, np.ndarray]]:
-        """Current storage as tiered containers for serialization —
-        sparse rows convert offsets->values directly, never
-        materializing a plane row."""
-        words: dict[int, np.ndarray] = {}
-        arrays: dict[int, np.ndarray] = {}
+    ) -> tuple[np.ndarray, np.ndarray, dict[int, np.ndarray]]:
+        """Current storage as (dense keys, dense payloads, sparse value
+        arrays) for serialization — the dense tier packs into two
+        contiguous buffers with no per-container Python, and sparse rows
+        convert offsets->values directly, never materializing a plane
+        row.  Returns ``(keys u64 ascending, words2d u64[n, 1024],
+        arrays)`` for roaring.encode_packed."""
         wpc = bp.WORDS_PER_CONTAINER
+        cps = bp.CONTAINERS_PER_SLICE
         cbits = roaring.CONTAINER_BITS
-        for r, slot in self._slot_of.items():
-            row = self._plane[slot]
-            for cidx in range(bp.CONTAINERS_PER_SLICE):
-                chunk = row[cidx * wpc : (cidx + 1) * wpc]
-                if chunk.any():
-                    words[r * bp.CONTAINERS_PER_SLICE + cidx] = (
-                        np.ascontiguousarray(chunk).view(np.uint64).copy()
-                    )
+        arrays: dict[int, np.ndarray] = {}
+        # Dense tier, fully vectorized (in row blocks to bound the
+        # transient gather copy): nonzero mask -> boolean-select both
+        # the keys and the payload rows; no per-container Python.
+        key_blocks: list[np.ndarray] = []
+        payload_blocks: list[np.ndarray] = []
+        if self._slot_of:
+            items = list(self._slot_of.items())
+            BLOCK = 256  # rows per sweep: 32 MiB transient
+            for b in range(0, len(items), BLOCK):
+                chunk_items = items[b : b + BLOCK]
+                rows_arr = np.asarray([r for r, _ in chunk_items], np.int64)
+                slots = np.asarray([s for _, s in chunk_items], dtype=np.intp)
+                # Bulk-import fragments allocate slots sequentially, so
+                # the common case is a contiguous ascending run — slice
+                # a VIEW instead of gather-copying 32 MiB per block.
+                if len(slots) and (
+                    slots[-1] - slots[0] == len(slots) - 1
+                    and (np.diff(slots) == 1).all()
+                ):
+                    sub = self._plane[slots[0] : slots[-1] + 1]
+                else:
+                    sub = np.ascontiguousarray(self._plane[slots])
+                sub = sub.reshape(len(chunk_items), cps, wpc)
+                # Nonzero test on the u64 view: half the elements.
+                nonzero = sub.view(np.uint64).any(axis=2)
+                if not nonzero.any():
+                    continue
+                key_blocks.append(
+                    (rows_arr[:, None] * cps + np.arange(cps)[None, :])[
+                        nonzero
+                    ].astype(np.uint64)
+                )
+                payload_blocks.append(sub[nonzero])
+        if key_blocks:
+            keys = np.concatenate(key_blocks)
+            payloads = np.concatenate(payload_blocks)  # (n, wpc) uint32
+            if len(keys) > 1 and not (np.diff(keys.view(np.int64)) > 0).all():
+                order = np.argsort(keys, kind="stable")
+                keys = keys[order]
+                payloads = payloads[order]
+            words2d = (
+                np.ascontiguousarray(payloads)
+                .view(np.uint64)
+                .reshape(len(keys), wpc // 2)
+            )
+        else:
+            keys = np.zeros(0, np.uint64)
+            words2d = np.zeros((0, wpc // 2), np.uint64)
         # Sparse tier, vectorized across ALL rows at once: rows visit in
         # ascending order and offsets ascend within a row, so the global
         # key stream is non-decreasing — one unique() groups it.
@@ -533,7 +584,7 @@ class Fragment:
             for j, k in enumerate(uniq_keys):
                 hi = starts[j + 1] if j + 1 < len(starts) else len(vals_all)
                 arrays[int(k)] = vals_all[starts[j] : hi]
-        return words, arrays
+        return keys, words2d, arrays
 
     def _row_words_host(self, row_id: int) -> np.ndarray | None:
         """One row's words on host (copy), whichever tier holds it.
@@ -784,6 +835,15 @@ class Fragment:
                 raise FragmentError("column out of bounds for slice")
             offs = cols % SLICE_WIDTH
             uniq = np.unique(rows)
+            # Pre-size the dense plane once for every row this import
+            # can add (one allocation, not O(log n) doubling copies).
+            n_new = sum(
+                1 for r in uniq
+                if int(r) not in self._slot_of and int(r) not in self._sparse
+            )
+            self._reserve_dense(
+                min(len(self._slot_of) + n_new, self.dense_row_budget)
+            )
             slot_of = {int(r): self._ensure_slot(int(r)) for r in uniq}
 
             # Per-row slot resolution through a per-UNIQUE-row table:
@@ -853,7 +913,7 @@ class Fragment:
             t0 = time.perf_counter()
             # Buffered ops are subsumed by the serialized state below.
             self._op_buf.clear()
-            data = roaring.encode_tiered(*self._containers_tiered())
+            data = roaring.encode_packed(*self._containers_packed())
             tmp = self.path + ".snapshotting"
             with open(tmp, "wb") as fh:
                 fh.write(data)
@@ -1355,7 +1415,7 @@ class Fragment:
         """Stream a tar with "data" (roaring file) and "cache" entries."""
         with self._mu:
             tw = tarfile.open(fileobj=w, mode="w|")
-            data = roaring.encode_tiered(*self._containers_tiered())
+            data = roaring.encode_packed(*self._containers_packed())
             info = tarfile.TarInfo("data")
             info.size = len(data)
             info.mtime = int(time.time())

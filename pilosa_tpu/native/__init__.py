@@ -1,7 +1,7 @@
 """Native runtime components — build + ctypes bindings.
 
 Compiles ``roaring_native.cpp`` into a shared library on first use
-(g++ -O3, rebuilt when the source is newer than the binary) and exposes
+(g++ -O3 -march=native, rebuilt when the source is newer than the binary) and exposes
 ctypes wrappers.  Everything here has a pure-Python fallback in
 ``pilosa_tpu/ops/roaring.py``; parity tests keep the two byte-identical.
 
@@ -26,15 +26,25 @@ _lib: ctypes.CDLL | None = None
 _failed = False
 
 
+# -mpopcnt (not -march=native): the hot loops are
+# __builtin_popcountll sweeps, and POPCNT has been universal on x86-64
+# since ~2008 — host-tuned codegen would SIGILL when a built .so moves
+# between machines (shared checkouts, copied images).
+_CFLAGS = ["-O3", "-mpopcnt", "-std=c++17", "-shared", "-fPIC"]
+_FLAGS_FILE = _SO + ".flags"
+
+
 def _build() -> bool:
     # Per-process temp name: concurrent builders (server + ctl import on
     # a fresh checkout) must not interleave writes before the atomic
     # rename.
     tmp = f"{_SO}.{os.getpid()}.tmp"
-    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", tmp, _SRC]
+    cmd = ["g++", *_CFLAGS, "-o", tmp, _SRC]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(tmp, _SO)
+        with open(_FLAGS_FILE, "w") as fh:
+            fh.write(" ".join(_CFLAGS))
         return True
     except (subprocess.SubprocessError, OSError):
         return False
@@ -44,6 +54,14 @@ def _build() -> bool:
                 os.unlink(tmp)
             except OSError:
                 pass
+
+
+def _built_flags() -> str | None:
+    try:
+        with open(_FLAGS_FILE) as fh:
+            return fh.read()
+    except OSError:
+        return None
 
 
 def lib() -> ctypes.CDLL | None:
@@ -61,6 +79,10 @@ def lib() -> ctypes.CDLL | None:
             stale = (
                 not os.path.exists(_SO)
                 or os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+                # A flags change must rebuild even when the source
+                # didn't move (mtime alone would silently keep a binary
+                # compiled with the old flags).
+                or _built_flags() != " ".join(_CFLAGS)
             )
             if stale and not _build():
                 _failed = True
@@ -251,11 +273,38 @@ def encode(containers: dict[int, np.ndarray]) -> bytes | None:
         return None
     keys = np.array(sorted(containers), dtype=np.uint64)
     nkeys = len(keys)
-    words = np.zeros(nkeys * 1024, dtype=np.uint64)
-    for i, k in enumerate(keys):
-        words[i * 1024 : (i + 1) * 1024] = np.asarray(
-            containers[int(k)], dtype=np.uint64
-        )
+    if nkeys:
+        # One C-level concatenate instead of a Python slice-assign per
+        # container (a dense fragment serializes tens of thousands).
+        payloads = [
+            np.asarray(containers[int(k)], dtype=np.uint64) for k in keys
+        ]
+        # Per-container length check: a total-length check alone would
+        # let one short container silently shift every later payload.
+        if any(p.shape != (1024,) for p in payloads):
+            raise ValueError("container payloads must be 1024 words each")
+        words = np.concatenate(payloads)
+    else:
+        words = np.zeros(0, dtype=np.uint64)
+    return _encode_raw(l, keys, words)
+
+
+def encode_packed(keys: np.ndarray, words2d: np.ndarray) -> bytes | None:
+    """Encode a pre-packed dense tier: ``keys`` ascending uint64 and
+    ``words2d[i]`` the 1024-word uint64 payload of ``keys[i]`` — zero
+    per-container Python (the packed twin of :func:`encode`)."""
+    l = lib()
+    if l is None:
+        return None
+    keys = np.ascontiguousarray(keys, dtype=np.uint64)
+    words2d = np.ascontiguousarray(words2d, dtype=np.uint64)
+    if words2d.ndim != 2 or words2d.shape != (len(keys), 1024):
+        raise ValueError("words2d must have shape (len(keys), 1024)")
+    return _encode_raw(l, keys, words2d.reshape(-1))
+
+
+def _encode_raw(l, keys: np.ndarray, words: np.ndarray) -> bytes | None:
+    nkeys = len(keys)
     kp = keys.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))
     wp = words.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))
     size = l.ptpu_encode_size(kp, wp, nkeys)

@@ -314,6 +314,26 @@ def encode(containers: dict[int, np.ndarray]) -> bytes:
     return encode_tiered(containers, {})
 
 
+def encode_packed(
+    keys: np.ndarray,
+    words2d: np.ndarray,
+    arrays: dict[int, np.ndarray] | None = None,
+) -> bytes:
+    """Serialize a PACKED dense tier — ``keys`` ascending container
+    keys, ``words2d[i]`` the 1024-u64 payload of ``keys[i]`` — plus an
+    optional sparse-arrays tier.  The all-dense case hands the buffers
+    straight to the C++ codec with no per-container Python; mixed or
+    native-less cases fall back to the general dict path."""
+    from pilosa_tpu import native
+
+    if not arrays:
+        res = native.encode_packed(keys, words2d)
+        if res is not None:
+            return res
+    words = {int(k): words2d[i] for i, k in enumerate(keys)}
+    return encode_tiered(words, arrays or {})
+
+
 def encode_tiered(
     words: dict[int, np.ndarray], arrays: dict[int, np.ndarray]
 ) -> bytes:

@@ -87,6 +87,43 @@ class TestNativeParity:
         for k in containers:
             np.testing.assert_array_equal(back[k], containers[k])
 
+    def test_encode_packed_byte_identical(self, rng):
+        """encode_packed (the snapshot/tar serializer) must produce the
+        same bytes as the general dict path, native or not."""
+        containers = {
+            k: w for k, w in _random_containers(rng).items() if w.any()
+        }
+        keys = np.array(sorted(containers), dtype=np.uint64)
+        words2d = np.stack([containers[int(k)] for k in keys])
+        want = _py_encode(containers)
+        assert roaring.encode_packed(keys, words2d) == want
+        assert native.encode_packed(keys, words2d) == want
+        # Python fallback of the packed entry point (no native lib)
+        with _python_codec():
+            assert roaring.encode_packed(keys, words2d) == want
+
+    def test_encode_packed_mixed_tiers(self, rng):
+        """Mixed dense+sparse tiers route through the general fallback
+        and must byte-match an all-dict encode of the same content."""
+        dense = {
+            k: w for k, w in _random_containers(rng).items() if w.any()
+        }
+        arrays = {1000: np.array([1, 5, 65535], dtype=np.uint32)}
+        keys = np.array(sorted(dense), dtype=np.uint64)
+        words2d = np.stack([dense[int(k)] for k in keys])
+        got = roaring.encode_packed(keys, words2d, arrays)
+        want = roaring.encode_tiered(dict(dense), dict(arrays))
+        assert got == want
+
+    def test_encode_packed_rejects_bad_shape(self):
+        import pytest
+
+        with pytest.raises(ValueError):
+            native.encode_packed(
+                np.array([1], dtype=np.uint64),
+                np.zeros((1, 1023), dtype=np.uint64),
+            )
+
     def test_encode_op_identical(self):
         for typ, value in ((0, 0), (1, 7), (0, 2**63 + 5)):
             want = (
