@@ -684,6 +684,11 @@ class Fragment:
                 self._device_version = self._version
             return self._device
 
+    def has_row(self, row_id: int) -> bool:
+        """Whether either tier holds the row (no device work)."""
+        with self._mu:
+            return row_id in self._slot_of or row_id in self._sparse
+
     def device_row(self, row_id: int):
         """One row as a device leaf for query plans (exec/plan.py).
 
@@ -952,6 +957,16 @@ class Fragment:
             ids, cnts = self._top_candidates_arrays(opt.row_ids)
         return self._top_score_prepare(ids, cnts, opt, bool(opt.row_ids))
 
+    def top_prepare_parts(self, opt: TopOptions | None = None):
+        """top_prepare WITHOUT the dense-kernel dispatch: returns
+        ``(TopState, sub, src_words)`` so the executor can batch many
+        fragments' score kernels into one program (see
+        bp.top_counts_batch)."""
+        opt = opt or TopOptions()
+        with self._mu:
+            ids, cnts = self._top_candidates_arrays(opt.row_ids)
+        return self._top_score_parts(ids, cnts, opt, bool(opt.row_ids))
+
     def top_finish(self, st: "TopState") -> list[Pair]:
         """Phase 2: resolve the dense score fetch (or accept one already
         fetched in bulk via ``st.counts``) and apply the final
@@ -1046,7 +1061,29 @@ class Fragment:
         opt: TopOptions,
         row_ids_mode: bool,
     ) -> "TopState":
-        """``ids``/``cached`` are the (unfiltered) candidate arrays in
+        st, sub, src_words = self._top_score_parts(
+            ids, cached, opt, row_ids_mode
+        )
+        if sub is not None:
+            # ASYNC dispatch — the fetch happens in top_finish (or in
+            # bulk by the executor across all slices).
+            st.dev_counts = bp.top_counts(sub, src_words)
+        return st
+
+    def _top_score_parts(
+        self,
+        ids: np.ndarray,
+        cached: np.ndarray,
+        opt: TopOptions,
+        row_ids_mode: bool,
+    ):
+        """Everything in a scoring pass EXCEPT the dense-kernel
+        dispatch: returns ``(TopState, sub, src_words)`` where ``sub``
+        (the gathered device submatrix, or None) and ``src_words`` let
+        the executor score MANY fragments in one batched program
+        (bp.top_counts_batch) instead of one dispatch per slice.
+
+        ``ids``/``cached`` are the (unfiltered) candidate arrays in
         count-descending order; ``row_ids_mode`` mirrors the reference's
         explicit-ids behavior of returning every scored row (n applies
         only to ranked-cache candidates, reference: fragment.go:516)."""
@@ -1058,24 +1095,36 @@ class Fragment:
             # already count-descending; take the first n.
             if n and n < len(ids):
                 ids, cached = ids[:n], cached[:n]
-            return TopState(done_ids=ids, done_cnts=cached)
+            return TopState(done_ids=ids, done_cnts=cached), None, None
 
         # Batched intersection scoring: one fused kernel over all
         # candidate rows at once (replaces the reference's sequential
         # threshold-pruned loop, fragment.go:601-627).
         if not len(ids):
-            return TopState(done_ids=self._EMPTY_I64, done_cnts=self._EMPTY_I64)
+            return (
+                TopState(done_ids=self._EMPTY_I64, done_cnts=self._EMPTY_I64),
+                None,
+                None,
+            )
         src_seg = opt.src.segments.get(self.slice)
         if src_seg is None:
-            return TopState(done_ids=self._EMPTY_I64, done_cnts=self._EMPTY_I64)
+            return (
+                TopState(done_ids=self._EMPTY_I64, done_cnts=self._EMPTY_I64),
+                None,
+                None,
+            )
         src_words = np.asarray(src_seg, dtype=np.uint32)
         with self._mu:
             slot_ids, slot_vals, sparse_sorted = self._tier_key_arrays_locked()
             dense_pos = np.flatnonzero(np.isin(ids, slot_ids))
             sparse_pos = np.flatnonzero(np.isin(ids, sparse_sorted))
             if not len(dense_pos) and not len(sparse_pos):
-                return TopState(
-                    done_ids=self._EMPTY_I64, done_cnts=self._EMPTY_I64
+                return (
+                    TopState(
+                        done_ids=self._EMPTY_I64, done_cnts=self._EMPTY_I64
+                    ),
+                    None,
+                    None,
                 )
             sub = None
             if len(dense_pos):
@@ -1121,11 +1170,7 @@ class Fragment:
             src_count=src_count,
             min_threshold=opt.min_threshold,
         )
-        if len(dense_pos):
-            # ASYNC dispatch — the fetch happens in top_finish (or in
-            # bulk by the executor across all slices).
-            st.dev_counts = bp.top_counts(sub, src_words)
-        return st
+        return st, sub, src_words
 
     def _tier_key_arrays_locked(self):
         """Sorted key arrays of the two row tiers, cached per fragment
@@ -1234,6 +1279,22 @@ class Fragment:
         union ids this slice's own cache walk didn't produce (foreign
         winners) — O(missing) host work instead of O(union).
         ``union_ids`` must be unique (np.unique output)."""
+        st, sub, src_words = self.top_prepare_union_parts(
+            union_ids, cand_ids, cand_cnts, opt
+        )
+        if sub is not None:
+            st.dev_counts = bp.top_counts(sub, src_words)
+        return st
+
+    def top_prepare_union_parts(
+        self,
+        union_ids: np.ndarray,
+        cand_ids: np.ndarray,
+        cand_cnts: np.ndarray,
+        opt: TopOptions,
+    ):
+        """top_prepare_union WITHOUT the dense-kernel dispatch (see
+        top_prepare_parts)."""
         with self._mu:
             foreign = np.setdiff1d(union_ids, cand_ids, assume_unique=True)
             f_cnts = np.fromiter(
@@ -1245,7 +1306,7 @@ class Fragment:
         all_ids = np.concatenate([cand_ids, foreign[fm]])
         all_cnts = np.concatenate([cand_cnts, f_cnts[fm]])
         order = np.lexsort((all_ids, -all_cnts))
-        return self._top_score_prepare(
+        return self._top_score_parts(
             all_ids[order], all_cnts[order], opt, row_ids_mode=True
         )
 

@@ -149,6 +149,12 @@ class Executor:
         self._batch_mu = threading.Lock()
         # slice->node grouping LRU (see _slices_by_node).
         self._slice_group_cache: "OrderedDict[tuple, dict]" = OrderedDict()
+        # Stacked TopN scorer batches + stacked device-src rows
+        # (see _score_topn_parts); lock-guarded — queries arrive on
+        # concurrent HTTP handler threads.
+        self._topn_stack_cache: "OrderedDict[tuple, dict]" = OrderedDict()
+        self._topn_src_cache: "OrderedDict[tuple, object]" = OrderedDict()
+        self._topn_cache_mu = threading.Lock()
 
     def close(self) -> None:
         self._pool.shutdown(wait=False, cancel_futures=True)
@@ -942,6 +948,144 @@ class Executor:
             trimmed = trimmed[:n]
         return trimmed
 
+    def _score_topn_parts(self, parts) -> None:
+        """Score many fragments' TopN parts with as FEW device
+        operations and host<->device transfers as possible and fill
+        each ``TopState.counts``.
+
+        ``parts``: list of (TopState, sub, src_words, src_dev, src_key)
+        — the first three from the ``*_parts`` fragment APIs, the last
+        two from ``_attach_dev_src`` (None when the src tree is not a
+        plain Bitmap leaf).  Entries with ``sub`` group by sub shape;
+        each group runs ONE vmapped program over a stacked [n, rows,
+        words] batch and is fetched as ONE array — where the
+        per-fragment path paid a dispatch + src transfer + fetch per
+        slice (444 ms/query at 100 slices through the tunnel).  When
+        every member has a device-resident src row, the src batch
+        stacks ON DEVICE (zero host->device bytes — through the tunnel
+        the per-query src upload dominated everything else); both
+        stacked batches cache across queries."""
+        groups: dict[tuple, list] = {}
+        for entry in parts:
+            if entry[1] is None:
+                continue
+            sub = entry[1]
+            # Group by (shape, home device): fragments shard their
+            # planes across the local mesh, and a stacked batch must be
+            # device-local — one program per device still beats one per
+            # slice, and the per-device programs overlap.
+            try:
+                dev = next(iter(sub.devices()))
+            except AttributeError:  # plain numpy (no device)
+                dev = None
+            groups.setdefault((tuple(sub.shape), dev), []).append(entry)
+        # Every group (singles included) takes the batched path so the
+        # stacked-sub and stacked-src caches apply uniformly.  Cache
+        # caps scale with the group count one query can produce (one
+        # per (shape, device)); entries hold device memory, so the caps
+        # stay tight.
+        cap = max(4, 2 * len(groups))
+        dev_outs = []  # (device array, [states]) fetched in one pass
+        for (shape, _dev), members in groups.items():
+            subs = [m[1] for m in members]
+            key = (shape, tuple(id(s) for s in subs))
+            with self._topn_cache_mu:
+                ent = self._topn_stack_cache.get(key)
+                # id() values can be reused after GC — verify object
+                # identity against the held references before trusting.
+                if ent is not None and all(
+                    a is b for a, b in zip(ent["subs"], subs)
+                ):
+                    self._topn_stack_cache.move_to_end(key)
+                else:
+                    ent = None
+            if ent is None:
+                ent = {"subs": subs, "stacked": jnp.stack(subs)}
+                with self._topn_cache_mu:
+                    self._topn_stack_cache[key] = ent
+                    while len(self._topn_stack_cache) > cap:
+                        self._topn_stack_cache.popitem(last=False)
+            srcs_dev = None
+            if all(m[3] is not None for m in members):
+                skey = tuple(m[4] for m in members)
+                with self._topn_cache_mu:
+                    srcs_dev = self._topn_src_cache.get(skey)
+                    if srcs_dev is not None:
+                        self._topn_src_cache.move_to_end(skey)
+                if srcs_dev is None:
+                    try:
+                        # Materialize the device rows ONLY on a cache
+                        # miss: each resolver call dispatches a device
+                        # gather, and jax dispatch is eager — resolving
+                        # eagerly cost ~100 wasted dispatches per warm
+                        # query at 100 slices.  A resolver may return
+                        # None (src fragment mutated since attach) —
+                        # fall back to the host-snapshot src batch.
+                        rows = [m[3]() for m in members]
+                        if any(r is None for r in rows):
+                            srcs_dev = None
+                        else:
+                            srcs_dev = jnp.stack(rows)
+                    except ValueError:  # mixed devices — fall back
+                        srcs_dev = None
+                    if srcs_dev is not None:
+                        with self._topn_cache_mu:
+                            self._topn_src_cache[skey] = srcs_dev
+                            while len(self._topn_src_cache) > cap:
+                                self._topn_src_cache.popitem(last=False)
+            if srcs_dev is None:
+                srcs = np.stack([m[2] for m in members])
+                srcs_dev = (
+                    jax.device_put(srcs, _dev)
+                    if _dev is not None
+                    else jnp.asarray(srcs)
+                )
+            out = bp.top_counts_batch(ent["stacked"], srcs_dev)
+            dev_outs.append((out, [m[0] for m in members]))
+        if not dev_outs:
+            return
+        fetched = jax.device_get([o for o, _ in dev_outs])
+        for arr, (_, sts) in zip(fetched, dev_outs):
+            arr = np.asarray(arr)
+            for i, st in enumerate(sts):
+                st.counts = arr[i]
+
+    def _attach_dev_src(self, index: str, c: Call, frag, part):
+        """Extend a fragment's (st, sub, src_words) TopN part with a
+        LAZY device-src resolver + identity cache key when the TopN src
+        is a plain Bitmap leaf — the row already lives in the slice's
+        HBM mirror, so the scorer needs zero host->device src bytes,
+        and laziness means a warm stacked-src cache hit dispatches no
+        gathers at all."""
+        st, sub, srcw = part
+        resolver = skey = None
+        if (
+            sub is not None
+            and len(c.children) == 1
+            and c.children[0].name == "Bitmap"
+            and not c.children[0].children
+        ):
+            sfrag, row_id = self._resolve_bitmap_leaf(
+                index, c.children[0], frag.slice
+            )
+            if sfrag is not None and sfrag.has_row(row_id):
+                skey = (sfrag._serial, sfrag._version, row_id)
+
+                def resolver(f=sfrag, r=row_id, v=sfrag._version):
+                    # The src fragment mutated since the host snapshot
+                    # was taken: using the live mirror would score the
+                    # dense tier against different src contents than
+                    # the sparse tier / tanimoto denominator.  Returning
+                    # None falls the group back to the host-snapshot
+                    # src batch.  (A write landing between the host
+                    # eval and this attach is still possible — the same
+                    # weak read-concurrency the reference has, where
+                    # candidate rows are read live under per-row locks
+                    # while a query runs, reference: fragment.go:507.)
+                    return f.device_row(r) if f._version == v else None
+
+        return st, sub, srcw, resolver, skey
+
     def _existing_topn_slices(
         self, index: str, c: Call, slices: list[int]
     ) -> list[int]:
@@ -1044,27 +1188,18 @@ class Executor:
         if not len(union):
             return []
 
-        # Pass 2: score the union on every slice; ONE bulk fetch.  The
-        # union pass reuses each slice's candidate arrays and resolves
-        # counts only for the foreign winners (top_prepare_union).
+        # Pass 2: score the union on every slice in ONE batched program
+        # with ONE fetch (all fragments score the same union, so the
+        # gathered submatrices share a shape).  The union pass reuses
+        # each slice's candidate arrays and resolves counts only for
+        # the foreign winners (top_prepare_union_parts).
         states: list[tuple] = []
+        parts: list[tuple] = []
         for frag, topt, cand_ids, cand_cnts in per:
-            states.append(
-                (
-                    frag,
-                    topt,
-                    cand_ids,
-                    frag.top_prepare_union(union, cand_ids, cand_cnts, topt),
-                )
-            )
-        pending = [
-            st for _, _, _, st in states
-            if st.done_ids is None and st.dev_counts is not None
-        ]
-        if pending:
-            fetched = jax.device_get([st.dev_counts for st in pending])
-            for st, arr in zip(pending, fetched):
-                st.counts = arr
+            part = frag.top_prepare_union_parts(union, cand_ids, cand_cnts, topt)
+            states.append((frag, topt, cand_ids, part[0]))
+            parts.append(self._attach_dev_src(index, c, frag, part))
+        self._score_topn_parts(parts)
 
         # Phase-1 winner selection per slice, from the same scores the
         # two-phase protocol's first round would have produced for the
@@ -1129,29 +1264,24 @@ class Executor:
                 )
             elif len(c.children) > 1:
                 raise ExecutorError("TopN() can only have one input bitmap")
-            # Two passes: prepare every slice (candidates + ASYNC score
-            # kernel dispatch), then resolve ALL dense score vectors in
-            # ONE device->host transfer — one round trip per node per
-            # phase however many slices it owns, the TPU shape of the
-            # reference's goroutine-per-slice mapperLocal fan-in
-            # (reference: executor.go:1246-1282).
+            # Two passes: prepare every slice (candidates + gathered
+            # scorer inputs), then score all slices in as few batched
+            # programs as their shapes allow, fetched in one transfer —
+            # one round trip per node per phase however many slices it
+            # owns, the TPU shape of the reference's goroutine-per-slice
+            # mapperLocal fan-in (reference: executor.go:1246-1282).
             prepped = [
                 self._prepare_topn_slice(index, c, s, src_rows=src_rows)
                 for s in local_slices
             ]
             states = [p for p in prepped if p is not None]
-            pending = [
-                st
-                for _, st in states
-                if st.done_ids is None and st.dev_counts is not None
-            ]
-            if pending:
-                # device_get starts async host copies for EVERY vector
-                # before blocking on any — one overlapped transfer even
-                # when planes live on different home devices.
-                fetched = jax.device_get([st.dev_counts for st in pending])
-                for st, arr in zip(pending, fetched):
-                    st.counts = arr
+            self._score_topn_parts(
+                [
+                    self._attach_dev_src(index, c, frag, part)
+                    for frag, part in states
+                ]
+            )
+            states = [(frag, part[0]) for frag, part in states]
             # Merge all slices' results in one numpy pass (counts sum
             # by id — Pairs.Add semantics, reference: cache.go:312-334);
             # Pairs materialize once at the protocol boundary.
@@ -1227,13 +1357,14 @@ class Executor:
     def _prepare_topn_slice(
         self, index: str, c: Call, slice_i: int, src_rows=None
     ):
-        """``(fragment, TopState)`` with the score kernel dispatched but
-        NOT fetched, or None when the fragment does not exist."""
+        """``(fragment, (TopState, sub, src_words))`` with the score
+        kernel NOT yet dispatched (see _score_topn_parts), or None when
+        the fragment does not exist."""
         prep = self._topn_options_for_slice(index, c, slice_i, src_rows)
         if prep is None:
             return None
         f, topt = prep
-        return f, f.top_prepare(topt)
+        return f, f.top_prepare_parts(topt)
 
     # ------------------------------------------------------------------
     # writes (reference: executor.go:642-840)

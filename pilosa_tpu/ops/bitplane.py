@@ -353,6 +353,27 @@ def top_counts(plane, src_row):
     return _top_counts_xla(plane, src_row)
 
 
+@jax.jit
+def _top_counts_batch_xla(planes, src_rows):
+    return jnp.sum(
+        jax.lax.population_count(planes & src_rows[:, None, :]).astype(
+            jnp.int32
+        ),
+        axis=-1,
+    )
+
+
+def top_counts_batch(planes, src_rows):
+    """Cross-fragment TopN scorer: ``planes`` uint32[n_frag, rows,
+    words] (each fragment's gathered candidate rows), ``src_rows``
+    uint32[n_frag, words] (each fragment's src row) -> int32[n_frag,
+    rows].  One program + one fetch for a whole multi-slice TopN where
+    the per-fragment path paid a dispatch, a src transfer, and a fetch
+    PER SLICE (measured 444 ms/query at 100 slices through the tunnel).
+    """
+    return _top_counts_batch_xla(planes, src_rows)
+
+
 @functools.partial(jax.jit, static_argnames=("k",))
 def top_k(counts, k: int):
     """Top-k (count, rowID) by count descending — ties broken by smaller row
