@@ -173,6 +173,27 @@ class TopState:
     counts: object = None
 
 
+@dataclass
+class SubRef:
+    """One fragment's TopN scoring inputs: the executor feeds ``plane``
+    (the HBM-resident mirror) and ``slots`` (padded candidate slot
+    indices) straight into one fused cross-fragment program
+    (bp.score_planes) — no gathered candidate copy ever exists on
+    device (an eager per-fragment/stacked copy once tripped OOM at 100
+    slices x 256 candidates).  ``plane`` is the mirror ARRAY captured
+    under the fragment lock at prepare time: jax arrays are immutable
+    and mirror refreshes create new objects, so the captured reference
+    is a free content snapshot — dense scoring stays consistent with
+    the sparse-tier probes even if a writer lands before the program
+    runs."""
+
+    plane: object  # device plane mirror (immutable array snapshot)
+    slots: np.ndarray  # int32[padded_rows] candidate slot indices
+    shape: tuple  # (padded_rows, words)
+    plane_rows: int  # mirror row count (program-shape grouping)
+    device: object
+
+
 class Fragment:
     """One frame-view x slice bit-plane with caches and sync hooks."""
 
@@ -216,11 +237,6 @@ class Fragment:
         # Sparse rows paged to the home device for query leaves (LRU).
         self._sparse_dev: "OrderedDict[int, object]" = OrderedDict()
         # TopN candidate-row gathers cached per (version, candidate set):
-        # phase 1 (full ranked cache) and phase 2 (winner refetch) of the
-        # same query reuse their submatrices across repeated queries
-        # instead of re-gathering ~rows x 128 KiB from the plane each
-        # time (2 entries = the two phases of one hot query).
-        self._topn_sub: "OrderedDict[tuple, object]" = OrderedDict()
         # Sorted tier-key arrays for vectorized dense/sparse candidate
         # splits (see _tier_key_arrays_locked), cached per version.
         self._tier_arrays = None
@@ -961,7 +977,7 @@ class Fragment:
         """top_prepare WITHOUT the dense-kernel dispatch: returns
         ``(TopState, sub, src_words)`` so the executor can batch many
         fragments' score kernels into one program (see
-        bp.top_counts_batch)."""
+        bp.score_planes)."""
         opt = opt or TopOptions()
         with self._mu:
             ids, cnts = self._top_candidates_arrays(opt.row_ids)
@@ -1061,13 +1077,18 @@ class Fragment:
         opt: TopOptions,
         row_ids_mode: bool,
     ) -> "TopState":
-        st, sub, src_words = self._top_score_parts(
+        st, sub_ref, src_words = self._top_score_parts(
             ids, cached, opt, row_ids_mode
         )
-        if sub is not None:
-            # ASYNC dispatch — the fetch happens in top_finish (or in
-            # bulk by the executor across all slices).
-            st.dev_counts = bp.top_counts(sub, src_words)
+        if sub_ref is not None:
+            # ASYNC dispatch — the fetch happens in top_finish.  The
+            # gather reads sub_ref.plane (the snapshot captured under
+            # the lock), never the live mirror: a concurrent write
+            # could reorder the slot layout out from under the
+            # prepared slot indices.
+            st.dev_counts = bp.top_counts(
+                sub_ref.plane[sub_ref.slots], src_words
+            )
         return st
 
     def _top_score_parts(
@@ -1081,7 +1102,7 @@ class Fragment:
         dispatch: returns ``(TopState, sub, src_words)`` where ``sub``
         (the gathered device submatrix, or None) and ``src_words`` let
         the executor score MANY fragments in one batched program
-        (bp.top_counts_batch) instead of one dispatch per slice.
+        (bp.score_planes) instead of one dispatch per slice.
 
         ``ids``/``cached`` are the (unfiltered) candidate arrays in
         count-descending order; ``row_ids_mode`` mirrors the reference's
@@ -1126,30 +1147,28 @@ class Fragment:
                     None,
                     None,
                 )
-            sub = None
+            sub_ref = None
             if len(dense_pos):
-                # Gather candidate rows from the HBM-resident plane —
-                # only the src row and slot indices travel host->device —
-                # and cache the gathered submatrix per candidate set.
+                # Candidate rows gather from the HBM-resident plane —
+                # only the src row and slot indices travel host->device.
+                # The gather itself is LAZY (SubRef): the executor's
+                # stacked-batch cache usually already holds the rows.
                 slots = slot_vals[
                     np.searchsorted(slot_ids, ids[dense_pos])
                 ].astype(np.int32)
-                sub_key = (self._version, slots.tobytes())
-                sub = self._topn_sub.get(sub_key)
-                if sub is None:
-                    # Pad the gather to a full row block (repeating the
-                    # last slot) so the scorer's row count stays on the
-                    # tile-aligned kernel path; surplus scores are
-                    # discarded below.  The gather copies anyway.
-                    padded = bp.pad_rows(len(slots))
-                    if padded != len(slots):
-                        slots = np.pad(slots, (0, padded - len(slots)), mode="edge")
-                    sub = self.device_plane()[slots]
-                    self._topn_sub[sub_key] = sub
-                    while len(self._topn_sub) > 2:
-                        self._topn_sub.popitem(last=False)
-                else:
-                    self._topn_sub.move_to_end(sub_key)
+                # Pad to a full row block (repeating the last slot) so
+                # the scorer's row count stays on the tile-aligned
+                # kernel path; surplus scores are discarded on read.
+                padded = bp.pad_rows(len(slots))
+                if padded != len(slots):
+                    slots = np.pad(slots, (0, padded - len(slots)), mode="edge")
+                sub_ref = SubRef(
+                    plane=self.device_plane(),
+                    slots=slots,
+                    shape=(padded, bp.WORDS_PER_SLICE),
+                    plane_rows=int(self._plane.shape[0]),
+                    device=bp.home_device(self.slice),
+                )
             # Sparse candidates (the low-count tail) score host-side in
             # O(set bits): probe src's words at each offset.
             sparse_cnt = np.empty(len(sparse_pos), np.int64)
@@ -1170,7 +1189,7 @@ class Fragment:
             src_count=src_count,
             min_threshold=opt.min_threshold,
         )
-        return st, sub, src_words
+        return st, sub_ref, src_words
 
     def _tier_key_arrays_locked(self):
         """Sorted key arrays of the two row tiers, cached per fragment
@@ -1266,26 +1285,6 @@ class Fragment:
                 n = self.row(row_id).count()
         return n
 
-    def top_prepare_union(
-        self,
-        union_ids: np.ndarray,
-        cand_ids: np.ndarray,
-        cand_cnts: np.ndarray,
-        opt: TopOptions,
-    ) -> "TopState":
-        """The folded executor TopN's union scoring pass: equivalent to
-        ``top_prepare(replace(opt, row_ids=union))`` but reuses the
-        already-listed candidate arrays, resolving counts only for
-        union ids this slice's own cache walk didn't produce (foreign
-        winners) — O(missing) host work instead of O(union).
-        ``union_ids`` must be unique (np.unique output)."""
-        st, sub, src_words = self.top_prepare_union_parts(
-            union_ids, cand_ids, cand_cnts, opt
-        )
-        if sub is not None:
-            st.dev_counts = bp.top_counts(sub, src_words)
-        return st
-
     def top_prepare_union_parts(
         self,
         union_ids: np.ndarray,
@@ -1293,8 +1292,13 @@ class Fragment:
         cand_cnts: np.ndarray,
         opt: TopOptions,
     ):
-        """top_prepare_union WITHOUT the dense-kernel dispatch (see
-        top_prepare_parts)."""
+        """The folded executor TopN's union scoring pass WITHOUT the
+        dense-kernel dispatch (see top_prepare_parts): equivalent to
+        ``top_prepare(replace(opt, row_ids=union))`` but reuses the
+        already-listed candidate arrays, resolving counts only for
+        union ids this slice's own cache walk didn't produce (foreign
+        winners) — O(missing) host work instead of O(union).
+        ``union_ids`` must be unique (np.unique output)."""
         with self._mu:
             foreign = np.setdiff1d(union_ids, cand_ids, assume_unique=True)
             f_cnts = np.fromiter(

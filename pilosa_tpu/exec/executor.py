@@ -149,12 +149,6 @@ class Executor:
         self._batch_mu = threading.Lock()
         # slice->node grouping LRU (see _slices_by_node).
         self._slice_group_cache: "OrderedDict[tuple, dict]" = OrderedDict()
-        # Stacked TopN scorer batches + stacked device-src rows
-        # (see _score_topn_parts); lock-guarded — queries arrive on
-        # concurrent HTTP handler threads.
-        self._topn_stack_cache: "OrderedDict[tuple, dict]" = OrderedDict()
-        self._topn_src_cache: "OrderedDict[tuple, object]" = OrderedDict()
-        self._topn_cache_mu = threading.Lock()
 
     def close(self) -> None:
         self._pool.shutdown(wait=False, cancel_futures=True)
@@ -953,94 +947,46 @@ class Executor:
         operations and host<->device transfers as possible and fill
         each ``TopState.counts``.
 
-        ``parts``: list of (TopState, sub, src_words, src_dev, src_key)
-        — the first three from the ``*_parts`` fragment APIs, the last
-        two from ``_attach_dev_src`` (None when the src tree is not a
-        plain Bitmap leaf).  Entries with ``sub`` group by sub shape;
-        each group runs ONE vmapped program over a stacked [n, rows,
-        words] batch and is fetched as ONE array — where the
-        per-fragment path paid a dispatch + src transfer + fetch per
-        slice (444 ms/query at 100 slices through the tunnel).  When
-        every member has a device-resident src row, the src batch
-        stacks ON DEVICE (zero host->device bytes — through the tunnel
-        the per-query src upload dominated everything else); both
-        stacked batches cache across queries."""
+        ``parts``: list of (TopState, SubRef, src_words, src_spec) —
+        the first three from the ``*_parts`` fragment APIs, ``src_spec``
+        from ``_attach_dev_src`` (None when the src tree is not a plain
+        Bitmap leaf).  Entries with a SubRef group by program shape
+        (sub shape, plane rows, home device); each group runs ONE fused
+        program (bp.score_planes) that reads candidate AND src rows
+        straight from the fragments' resident HBM mirrors — no stacked
+        copy, no src upload — and is fetched as ONE array.  The
+        per-fragment path paid a dispatch + a 128 KiB src upload + a
+        fetch PER SLICE: 444 ms/query at 100 slices through the
+        tunnel."""
         groups: dict[tuple, list] = {}
         for entry in parts:
-            if entry[1] is None:
+            ref = entry[1]
+            if ref is None:
                 continue
-            sub = entry[1]
-            # Group by (shape, home device): fragments shard their
-            # planes across the local mesh, and a stacked batch must be
-            # device-local — one program per device still beats one per
-            # slice, and the per-device programs overlap.
-            try:
-                dev = next(iter(sub.devices()))
-            except AttributeError:  # plain numpy (no device)
-                dev = None
-            groups.setdefault((tuple(sub.shape), dev), []).append(entry)
-        # Every group (singles included) takes the batched path so the
-        # stacked-sub and stacked-src caches apply uniformly.  Cache
-        # caps scale with the group count one query can produce (one
-        # per (shape, device)); entries hold device memory, so the caps
-        # stay tight.
-        cap = max(4, 2 * len(groups))
+            groups.setdefault(
+                (ref.shape, ref.plane_rows, ref.device), []
+            ).append(entry)
         dev_outs = []  # (device array, [states]) fetched in one pass
-        for (shape, _dev), members in groups.items():
-            subs = [m[1] for m in members]
-            key = (shape, tuple(id(s) for s in subs))
-            with self._topn_cache_mu:
-                ent = self._topn_stack_cache.get(key)
-                # id() values can be reused after GC — verify object
-                # identity against the held references before trusting.
-                if ent is not None and all(
-                    a is b for a, b in zip(ent["subs"], subs)
-                ):
-                    self._topn_stack_cache.move_to_end(key)
-                else:
-                    ent = None
-            if ent is None:
-                ent = {"subs": subs, "stacked": jnp.stack(subs)}
-                with self._topn_cache_mu:
-                    self._topn_stack_cache[key] = ent
-                    while len(self._topn_stack_cache) > cap:
-                        self._topn_stack_cache.popitem(last=False)
-            srcs_dev = None
-            if all(m[3] is not None for m in members):
-                skey = tuple(m[4] for m in members)
-                with self._topn_cache_mu:
-                    srcs_dev = self._topn_src_cache.get(skey)
-                    if srcs_dev is not None:
-                        self._topn_src_cache.move_to_end(skey)
-                if srcs_dev is None:
-                    try:
-                        # Materialize the device rows ONLY on a cache
-                        # miss: each resolver call dispatches a device
-                        # gather, and jax dispatch is eager — resolving
-                        # eagerly cost ~100 wasted dispatches per warm
-                        # query at 100 slices.  A resolver may return
-                        # None (src fragment mutated since attach) —
-                        # fall back to the host-snapshot src batch.
-                        rows = [m[3]() for m in members]
-                        if any(r is None for r in rows):
-                            srcs_dev = None
-                        else:
-                            srcs_dev = jnp.stack(rows)
-                    except ValueError:  # mixed devices — fall back
-                        srcs_dev = None
-                    if srcs_dev is not None:
-                        with self._topn_cache_mu:
-                            self._topn_src_cache[skey] = srcs_dev
-                            while len(self._topn_src_cache) > cap:
-                                self._topn_src_cache.popitem(last=False)
-            if srcs_dev is None:
-                srcs = np.stack([m[2] for m in members])
-                srcs_dev = (
-                    jax.device_put(srcs, _dev)
-                    if _dev is not None
-                    else jnp.asarray(srcs)
-                )
-            out = bp.top_counts_batch(ent["stacked"], srcs_dev)
+        for _gkey, members in groups.items():
+            # Pad the group to a power-of-two bucket by repeating the
+            # last member (the row dimension is already pad_rows-
+            # bucketed): an unpadded group size would compile a fresh
+            # XLA program per distinct slice count.  Surplus rows are
+            # simply not consumed when the fetched scores distribute.
+            n_pad = 1 << (len(members) - 1).bit_length()
+            padded = members + [members[-1]] * (n_pad - len(members))
+            planes = tuple(m[1].plane for m in padded)
+            slots = np.stack([m[1].slots for m in padded])
+            # Same-plane src slot for every member -> zero src bytes
+            # cross the host boundary (and no extra leaf shapes in the
+            # jit key); otherwise one stacked host-snapshot transfer
+            # for the group.
+            if all(m[3] is not None for m in padded):
+                src_slots = np.asarray([m[3] for m in padded], dtype=np.int32)
+                out = bp.score_planes(planes, slots, src_slots=src_slots)
+            else:
+                srcs = np.stack([m[2] for m in padded])
+                out = bp.score_planes(planes, slots, srcs=srcs)
             dev_outs.append((out, [m[0] for m in members]))
         if not dev_outs:
             return
@@ -1051,16 +997,20 @@ class Executor:
                 st.counts = arr[i]
 
     def _attach_dev_src(self, index: str, c: Call, frag, part):
-        """Extend a fragment's (st, sub, src_words) TopN part with a
-        LAZY device-src resolver + identity cache key when the TopN src
-        is a plain Bitmap leaf — the row already lives in the slice's
-        HBM mirror, so the scorer needs zero host->device src bytes,
-        and laziness means a warm stacked-src cache hit dispatches no
-        gathers at all."""
-        st, sub, srcw = part
-        resolver = skey = None
+        """Extend a fragment's (st, SubRef, src_words) TopN part with
+        the src row's SLOT in the member's own plane snapshot when the
+        TopN src is a plain Bitmap leaf on the SAME fragment (the
+        common ``TopN(Bitmap(frame=f), frame=f)`` shape): the fused
+        scorer then reads the src row from the already-grouped plane —
+        zero src bytes host->device and no extra leaf shapes in the jit
+        key.  Anything else (different src frame, sparse-tier src row,
+        a mirror refresh since the prepare snapshot, non-Bitmap tree)
+        returns None, falling the group back to the one host-snapshot
+        src transfer — always consistent, just not transfer-free."""
+        st, sub_ref, srcw = part
+        slot = None
         if (
-            sub is not None
+            sub_ref is not None
             and len(c.children) == 1
             and c.children[0].name == "Bitmap"
             and not c.children[0].children
@@ -1068,23 +1018,15 @@ class Executor:
             sfrag, row_id = self._resolve_bitmap_leaf(
                 index, c.children[0], frag.slice
             )
-            if sfrag is not None and sfrag.has_row(row_id):
-                skey = (sfrag._serial, sfrag._version, row_id)
-
-                def resolver(f=sfrag, r=row_id, v=sfrag._version):
-                    # The src fragment mutated since the host snapshot
-                    # was taken: using the live mirror would score the
-                    # dense tier against different src contents than
-                    # the sparse tier / tanimoto denominator.  Returning
-                    # None falls the group back to the host-snapshot
-                    # src batch.  (A write landing between the host
-                    # eval and this attach is still possible — the same
-                    # weak read-concurrency the reference has, where
-                    # candidate rows are read live under per-row locks
-                    # while a query runs, reference: fragment.go:507.)
-                    return f.device_row(r) if f._version == v else None
-
-        return st, sub, srcw, resolver, skey
+            if sfrag is frag:
+                with sfrag._mu:
+                    s = sfrag._slot_of.get(row_id)
+                    # The slot is only valid against the snapshot the
+                    # prepare captured; a refresh since then (writes)
+                    # may have reordered the slot layout.
+                    if s is not None and sfrag.device_plane() is sub_ref.plane:
+                        slot = int(s)
+        return st, sub_ref, srcw, slot
 
     def _existing_topn_slices(
         self, index: str, c: Call, slices: list[int]

@@ -354,24 +354,56 @@ def top_counts(plane, src_row):
 
 
 @jax.jit
-def _top_counts_batch_xla(planes, src_rows):
-    return jnp.sum(
-        jax.lax.population_count(planes & src_rows[:, None, :]).astype(
-            jnp.int32
-        ),
-        axis=-1,
-    )
+def _score_planes_self_src(planes, slots, src_slots):
+    outs = []
+    for f in range(len(planes)):
+        rows = planes[f][slots[f]]
+        src = planes[f][src_slots[f]]
+        outs.append(
+            jnp.sum(
+                jax.lax.population_count(rows & src[None, :]).astype(jnp.int32),
+                axis=-1,
+            )
+        )
+    return jnp.stack(outs)
 
 
-def top_counts_batch(planes, src_rows):
-    """Cross-fragment TopN scorer: ``planes`` uint32[n_frag, rows,
-    words] (each fragment's gathered candidate rows), ``src_rows``
-    uint32[n_frag, words] (each fragment's src row) -> int32[n_frag,
-    rows].  One program + one fetch for a whole multi-slice TopN where
-    the per-fragment path paid a dispatch, a src transfer, and a fetch
-    PER SLICE (measured 444 ms/query at 100 slices through the tunnel).
-    """
-    return _top_counts_batch_xla(planes, src_rows)
+@jax.jit
+def _score_planes_host_src(planes, slots, srcs):
+    outs = []
+    for f in range(len(planes)):
+        rows = planes[f][slots[f]]
+        outs.append(
+            jnp.sum(
+                jax.lax.population_count(rows & srcs[f][None, :]).astype(
+                    jnp.int32
+                ),
+                axis=-1,
+            )
+        )
+    return jnp.stack(outs)
+
+
+def score_planes(planes, slots, src_slots=None, srcs=None):
+    """Cross-fragment TopN scorer that reads STRAIGHT from the
+    fragments' HBM-resident plane mirrors — no stacked candidate copy
+    ever materializes (a stacked batch doubled the candidate rows'
+    device footprint and tripped OOM at 100 slices x 256 candidates).
+
+    ``planes``: tuple of uint32[plane_rows, words] device mirror
+    SNAPSHOTS; ``slots``: int32[n_frag, rows] candidate slot indices
+    (one small transfer); the src is either ``src_slots`` int32[n_frag]
+    — the src row's slot in the SAME plane (the common
+    TopN(Bitmap(frame=f), frame=f) shape; zero src bytes host->device,
+    and no extra leaf shapes enter the jit key) — or ``srcs``
+    uint32[n_frag, words] host-snapshot rows.  Gathers fuse into the
+    popcount reduce, so each candidate row is read once.  Returns
+    int32[n_frag, rows].  One dispatch + one fetch per query where the
+    per-fragment path paid a dispatch, a src transfer, and a fetch PER
+    SLICE (444 ms/query at 100 slices through the tunnel)."""
+    if srcs is None:
+        return _score_planes_self_src(planes, slots, src_slots)
+    return _score_planes_host_src(planes, slots, srcs)
 
 
 @functools.partial(jax.jit, static_argnames=("k",))

@@ -286,6 +286,57 @@ def test_read_calls_counted_with_index_tag(ex, holder):
     assert ("Bitmap", 1, ("index:i",)) in calls
 
 
+def test_topn_fused_scorer_group_padding(ex, holder):
+    """A 5-slice group pads to the 8-bucket in the fused scorer; the
+    surplus (repeated) members' scores must never leak into results,
+    and a repeat query returns identical pairs."""
+    bits = []
+    for s in range(5):
+        base = s * SLICE_WIDTH
+        for r in range(6):
+            bits += [(r, base + k) for k in range(r + 2)]
+    must_set_bits(holder, "i", "f", bits)
+    pql = "TopN(Bitmap(rowID=0, frame=f), frame=f, n=4)"
+    (want,) = q(ex, "i", pql)
+    assert want
+    # row r intersects row 0 on min(r+2, 2) = 2 columns per slice.
+    got = {p.id: p.count for p in want}
+    assert got[0] == 10  # |row0| = 2 bits x 5 slices
+    assert all(v == 10 for v in got.values())
+    (again,) = q(ex, "i", pql)
+    assert [(p.id, p.count) for p in again] == [(p.id, p.count) for p in want]
+
+
+def test_topn_src_mutated_falls_back_to_snapshot(ex, holder):
+    """When no same-plane src slot is available (different src frame,
+    sparse-tier src row, or a mirror refresh since the prepare
+    snapshot), the scorer falls back to the one host-snapshot src
+    transfer; forcing that path must produce exactly the same
+    results."""
+    bits = []
+    for s in range(3):
+        base = s * SLICE_WIDTH
+        bits += [(0, base), (0, base + 1), (1, base), (2, base + 1)]
+    must_set_bits(holder, "i", "f", bits)
+
+    # Drop every same-plane src slot so the host-snapshot path runs.
+    orig = ex._attach_dev_src
+
+    def attach_force_host_src(index, c, frag, part):
+        st, sub, srcw, _slot = orig(index, c, frag, part)
+        return st, sub, srcw, None
+
+    ex._attach_dev_src = attach_force_host_src
+    try:
+        (pairs,) = q(ex, "i", "TopN(Bitmap(rowID=0, frame=f), frame=f, n=3)")
+    finally:
+        ex._attach_dev_src = orig
+    got = {p.id: p.count for p in pairs}
+    # row0 ∩ row0 = 6 bits; row1 ∩ row0 = 3 (col 0 per slice);
+    # row2 ∩ row0 = 3 (col 1 per slice)
+    assert got == {0: 6, 1: 3, 2: 3}
+
+
 def test_topn_duplicate_ids_not_double_counted(ex, holder):
     """A duplicated explicit id must not be scored twice (the cross-
     slice merge SUMS counts by id, so a duplicate would double the
