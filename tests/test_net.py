@@ -258,6 +258,75 @@ class TestServerHTTP:
         nodes = client.fragment_nodes("i", 0)
         assert nodes[0]["host"] == server.host
 
+    def test_backup_restore_inverse_view(self, server, client):
+        """Backup/restore of a derived (inverse) view round-trips its
+        bits; the max-slice lookup must use the INVERSE slice space
+        (reference: client_test.go TestClient_BackupInverseView)."""
+        client.create_index("i")
+        client.create_frame("i", "f", {"inverseEnabled": True})
+        # rowID >= SLICE_WIDTH: the INVERSE view's slice space (sliced
+        # by rowID) reaches slice 1 while the standard space stays at
+        # slice 0 — a wrong (standard) max-slice lookup would silently
+        # drop this bit from the archive.
+        row = SLICE_WIDTH + 5
+        client.execute_query("i", f'SetBit(frame="f", rowID={row}, columnID=9)')
+        buf = io.BytesIO()
+        client.backup_to(buf, "i", "f", "inverse")
+        # clear and restore
+        frag = server.holder.fragment("i", "f", "inverse", 1)
+        assert frag.row(9).count() == 1
+        frag.clear_bit(9, row)
+        assert frag.row(9).count() == 0
+        buf.seek(0)
+        client.restore_from(buf, "i", "f", "inverse")
+        frag = server.holder.fragment("i", "f", "inverse", 1)
+        assert frag.row(9).bits() == [row]
+
+    def test_backup_invalid_view_errors(self, server, client):
+        """Backing up a nonexistent view must error, not return an
+        empty archive (reference: client_test.go
+        TestClient_BackupInvalidView)."""
+        client.create_index("i")
+        client.create_frame("i", "f")
+        client.execute_query("i", 'SetBit(frame="f", rowID=1, columnID=1)')
+        with pytest.raises(ClientError):
+            client.backup_to(io.BytesIO(), "i", "f", "no_such_view")
+
+    def test_import_not_owned_rejected_412(self, server, client):
+        """A node must refuse an /import for a slice it does not own
+        (reference: handler.go:1004 OwnsFragment guard -> 412) — the
+        cluster here claims a second host owning odd slices."""
+        import urllib.request
+
+        client.create_index("i")
+        client.create_frame("i", "f")
+        # Rewire the server's cluster so SOME slice maps elsewhere.
+        two = Cluster(nodes=[Node(host=server.host), Node(host="other:1")])
+        server.cluster.nodes = two.nodes
+        try:
+            bad = None
+            for s in range(64):
+                owners = [n.host for n in server.cluster.fragment_nodes("i", s)]
+                if server.host not in owners:
+                    bad = s
+                    break
+            assert bad is not None
+            pb = wire.ImportRequest(Index="i", Frame="f", Slice=bad)
+            pb.RowIDs.append(0)
+            pb.ColumnIDs.append(bad << 20)
+            body = pb.SerializeToString()
+            req = urllib.request.Request(
+                f"http://{server.host}/import",
+                data=body,
+                method="POST",
+                headers={"Content-Type": "application/x-protobuf"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req)
+            assert ei.value.code == 412
+        finally:
+            server.cluster.nodes = [Node(host=server.host)]
+
     def test_fragment_backup_restore(self, server, client, tmp_path):
         client.create_index("i")
         client.create_frame("i", "f")
