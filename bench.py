@@ -179,6 +179,24 @@ def hbm_peak_bytes_s(jax_mod) -> float | None:
     return None
 
 
+def prime_fragment(frag, rows: np.ndarray, pad_rows_fn) -> None:
+    """Plane-inject ``rows`` (uint32[n, words]) into a fragment and
+    prime its caches — shared by every bench tier (the import path is
+    not what the bench measures)."""
+    n = rows.shape[0]
+    plane = np.zeros((pad_rows_fn(n), rows.shape[1]), np.uint32)
+    plane[:n] = rows
+    counts = np.bitwise_count(rows).sum(axis=-1, dtype=np.int64)
+    frag._plane = plane
+    frag._slot_of = {r: r for r in range(n)}
+    frag._count_of = {r: int(counts[r]) for r in range(n)}
+    frag._max_row_id = n - 1
+    frag._version += 1
+    for r in range(n):
+        frag.cache.bulk_add(r, int(counts[r]))
+    frag.cache.invalidate()
+
+
 def build_holder(leaves: np.ndarray, data_dir: str):
     """A real Holder with one fragment per slice holding rows {1, 2}
     from ``leaves`` (uint32[n_slices, 2, words]) — plane-injected (the
@@ -191,6 +209,8 @@ def build_holder(leaves: np.ndarray, data_dir: str):
     idx = holder.create_index("i")
     f = idx.create_frame("f")
     view = f.create_view_if_not_exists("standard")
+    # Rows 1 and 2 occupy slots 0 and 1 (shifted ids, so prime_fragment
+    # does not fit); plane-inject directly.
     counts = np.bitwise_count(leaves).sum(axis=-1, dtype=np.int64)
     for s in range(leaves.shape[0]):
         frag = view.create_fragment_if_not_exists(s)
@@ -537,16 +557,9 @@ def run_executor_tiers(leaves, host_count, rng, dev_s) -> float:
         idx = holder.index("i")
         ft = idx.create_frame("t", cache_size=4096)
         view = ft.create_view_if_not_exists("standard")
-        frag = view.create_fragment_if_not_exists(0)
-        ccounts = np.bitwise_count(cand).sum(axis=-1, dtype=np.int64)
-        frag._plane = cand.copy()
-        frag._slot_of = {r: r for r in range(2048)}
-        frag._count_of = {r: int(ccounts[r]) for r in range(2048)}
-        frag._max_row_id = 2047
-        frag._version += 1
-        for r in range(2048):
-            frag.cache.bulk_add(r, int(ccounts[r]))
-        frag.cache.invalidate()
+        prime_fragment(
+            view.create_fragment_if_not_exists(0), cand, bpl.pad_rows
+        )
 
         tq = parse_string("TopN(Bitmap(rowID=0, frame=t), frame=t, n=100)")
         (warm,) = ex.execute("i", tq)  # compile + page
@@ -571,6 +584,49 @@ def run_executor_tiers(leaves, host_count, rng, dev_s) -> float:
         log(
             f"e2e executor TopN(n=100) CONCURRENT(64): {t_64*1e3:.2f}"
             f" ms/query throughput"
+        )
+
+        # --- tier 4: MULTI-SLICE TopN with a src bitmap -----------------
+        # 64 slices x 128 ranked candidates, scored against a src row:
+        # the fused scorer reads candidate and src rows straight from
+        # the resident plane mirrors — one program + one fetch per
+        # query where a per-slice protocol would pay 64 dispatches and
+        # 64 src uploads (reference workload: Tanimoto similarity
+        # search, docs/tutorials.md:333-342).
+        MS_SLICES, MS_ROWS = 64, 128
+        fm = idx.create_frame("m", cache_size=512)
+        vm = fm.create_view_if_not_exists("standard")
+        mrows = rng.integers(
+            0, 2**32, size=(MS_SLICES, MS_ROWS, bpl.WORDS_PER_SLICE),
+            dtype=np.uint32,
+        )
+        for s in range(MS_SLICES):
+            prime_fragment(
+                vm.create_fragment_if_not_exists(s), mrows[s], bpl.pad_rows
+            )
+        mq = parse_string("TopN(Bitmap(rowID=0, frame=m), frame=m, n=100)")
+        (mwarm,) = ex.execute("i", mq)
+        assert len(mwarm) == 100
+        # Bit-exactness anchor: row 0's total must equal the host sum.
+        want0 = int(
+            sum(
+                np.bitwise_count(mrows[s, 0] & mrows[s, 0]).sum()
+                for s in range(MS_SLICES)
+            )
+        )
+        got0 = {p.id: p.count for p in mwarm}[0]
+        assert got0 == want0, f"multi-slice TopN exactness: {got0} != {want0}"
+
+        def check_ms(res):
+            pairs = res[0]
+            assert len(pairs) == 100 and pairs[0].count >= pairs[-1].count
+
+        m_p50, m_per_q, _ = measure_query(ex, "i", mq, check_ms, n_conc=32)
+        log(
+            f"e2e executor TopN(src) over {MS_SLICES} slices x {MS_ROWS}"
+            f" candidates (fused plane scorer): sync p50 {m_p50*1e3:.2f} ms"
+            f" (incl. tunnel round trip); CONCURRENT(16)"
+            f" {m_per_q*1e3:.2f} ms/query throughput"
         )
         ex.close()
         holder.close()

@@ -288,17 +288,14 @@ class InternalClient:
         import tarfile
         import time as _time
 
-        from pilosa_tpu.core.view import VIEW_INVERSE, VIEW_STANDARD
+        from pilosa_tpu.core.view import VIEW_INVERSE, is_valid_view
 
         # Whole-frame backup addresses the two base views only, like
         # the reference (client.go:491-497 ErrInvalidView); derived
         # (time) views move via the per-view frame-restore protocol.
-        if view == VIEW_STANDARD:
-            inverse = False
-        elif view == VIEW_INVERSE:
-            inverse = True
-        else:
+        if not is_valid_view(view):
             raise ClientError(400, "invalid view")
+        inverse = view == VIEW_INVERSE
         tw = tarfile.open(fileobj=w, mode="w|")
         max_slices = self.max_slice_by_index(inverse=inverse)
         for slice_i in range(max_slices.get(index, 0) + 1):

@@ -608,6 +608,55 @@ def test_batch_cache_invalidated_by_frame_delete(ex, holder):
     assert q(ex, "i", pql) == [0]
 
 
+def test_concurrent_multislice_topn_and_writes(ex, holder):
+    """Parallel MULTI-SLICE src TopN racing writers: the fused scorer
+    reads plane SNAPSHOTS captured under each fragment's lock, so every
+    result must be internally consistent (sorted, exact after
+    quiesce) even while the mirrors refresh under it."""
+    import threading
+
+    for s in range(4):
+        base = s * SLICE_WIDTH
+        for r in range(6):
+            must_set_bits(
+                holder, "i", "f", [(r, base + c) for c in range(0, 10 + r)]
+            )
+    errors = []
+
+    def reader():
+        try:
+            for _ in range(15):
+                (pairs,) = q(
+                    ex, "i", "TopN(Bitmap(rowID=0, frame=f), frame=f, n=4)"
+                )
+                counts = [p.count for p in pairs]
+                assert counts == sorted(counts, reverse=True)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    def writer():
+        try:
+            for c in range(50, 90):
+                q(ex, "i", f"SetBit(frame=f, rowID=2, columnID={c})")
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=reader) for _ in range(3)] + [
+        threading.Thread(target=writer)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    # Quiesced: exact counts (row0 has 10 cols/slice, all within row0's
+    # own columns -> |rowX ∩ row0| = 10 per slice for rows whose column
+    # range covers row0's).
+    (pairs,) = q(ex, "i", "TopN(Bitmap(rowID=0, frame=f), frame=f, n=6)")
+    got = {p.id: p.count for p in pairs}
+    assert got[0] == 40  # 10 x 4 slices
+
+
 def test_concurrent_topn_and_writes(ex, holder):
     """Parallel TopN queries racing writes on the SAME fragment: the
     device score fetch runs outside the fragment lock (core/fragment.py
