@@ -1259,18 +1259,54 @@ class Executor:
         view = VIEW_INVERSE if bool(c.args.get("inverse", False)) else VIEW_STANDARD
         return frame, view
 
+    @staticmethod
+    def _topn_parsed_args(c: Call):
+        """Slice-invariant TopN argument parsing (reference:
+        executor.go:346-415), hoisted out of the per-slice loop — at
+        hundreds of slices the repeated arg walks dominated option
+        building.  Memoized ON the Call instance (clone() builds fresh
+        objects, so a mutated clone — e.g. the phase-2 refetch's ids=
+        — never sees a stale parse)."""
+        cached = getattr(c, "_topn_parsed", None)
+        if cached is not None:
+            return cached
+        frame, view = Executor._topn_frame_view(c)
+        n = _uint_arg(c, "n")[0]
+        fld = c.args.get("field", "") or ""
+        row_ids = _uint_slice_arg(c, "ids")
+        min_threshold = _uint_arg(c, "threshold")[0]
+        if min_threshold <= 0:
+            min_threshold = MIN_THRESHOLD
+        filters = c.args.get("filters")
+        tanimoto = _uint_arg(c, "tanimotoThreshold")[0]
+        cached = (
+            frame,
+            view,
+            n,
+            fld,
+            tuple(row_ids) if row_ids else None,
+            min_threshold,
+            tuple(filters) if filters else None,
+            tanimoto,
+        )
+        c._topn_parsed = cached
+        return cached
+
     def _topn_options_for_slice(self, index: str, c: Call, slice_i: int, src_rows=None):
         """reference: executor.go:346-415.  ``src_rows`` carries the
         host-evaluated src rows from _execute_topn_slices.  Returns
         ``(fragment, TopOptions)``, or None when the fragment does not
         exist."""
-        frame, view = self._topn_frame_view(c)
-        n = _uint_arg(c, "n")[0]
-        fld = c.args.get("field", "") or ""
-        row_ids = _uint_slice_arg(c, "ids")
-        min_threshold = _uint_arg(c, "threshold")[0]
-        filters = c.args.get("filters")
-        tanimoto = _uint_arg(c, "tanimotoThreshold")[0]
+        (
+            frame,
+            view,
+            n,
+            fld,
+            row_ids,
+            min_threshold,
+            filters,
+            tanimoto,
+        ) = self._topn_parsed_args(c)
 
         src = None
         if src_rows is not None:
@@ -1282,14 +1318,15 @@ class Executor:
         f = self.holder.fragment(index, frame, view, slice_i)
         if f is None:
             return None
-        if min_threshold <= 0:
-            min_threshold = MIN_THRESHOLD
+        # Validated AFTER the fragment-existence early return, matching
+        # the reference's ordering (executor.go:346-415): a bad tanimoto
+        # over absent fragments yields empty results, not an error.
         if tanimoto > 100:
             raise ExecutorError("Tanimoto Threshold is from 1 to 100 only")
         return f, TopOptions(
             n=n,
             src=src,
-            row_ids=row_ids,
+            row_ids=list(row_ids) if row_ids else None,
             filter_field=fld,
             filter_values=list(filters) if filters else None,
             min_threshold=min_threshold,
