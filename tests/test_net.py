@@ -177,6 +177,24 @@ class TestServerHTTP:
         assert status == 400
         assert "error" in json.loads(data)
 
+    def test_query_slices_url_arg(self, server, client):
+        """?slices=0,2 restricts execution to the named slices
+        (reference: handler_test.go TestHandler_Query_Args_URL)."""
+        client.create_index("i")
+        client.create_frame("i", "f")
+        for s in range(3):
+            client.execute_query(
+                "i", f'SetBit(frame="f", rowID=1, columnID={s * SLICE_WIDTH})'
+            )
+        status, data = client._request(
+            "POST",
+            "/index/i/query",
+            query={"slices": "0,2"},
+            body=b'Count(Bitmap(frame="f", rowID=1))',
+        )
+        assert status == 200
+        assert json.loads(data)["results"] == [2]
+
     def test_query_invalid_params(self, server, client):
         client.create_index("i")
         status, _ = client._request(
@@ -240,6 +258,19 @@ class TestServerHTTP:
             "i", f'SetBit(frame="f", rowID=0, columnID={SLICE_WIDTH * 2 + 1})'
         )
         assert client.max_slice_by_index() == {"i": 2}
+
+    def test_slice_max_inverse(self, server, client):
+        """/slices/max?inverse=true reports the INVERSE slice space
+        (sliced by rowID — reference: handler_test.go
+        TestHandler_MaxSlices_Inverse)."""
+        client.create_index("i")
+        client.create_frame("i", "f", {"inverseEnabled": True})
+        client.execute_query(
+            "i",
+            f'SetBit(frame="f", rowID={SLICE_WIDTH * 3 + 7}, columnID=1)',
+        )
+        assert client.max_slice_by_index() == {"i": 0}
+        assert client.max_slice_by_index(inverse=True) == {"i": 3}
 
     def test_import_and_export(self, server, client):
         client.create_index("i")
