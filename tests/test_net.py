@@ -623,6 +623,19 @@ class TestMultiNode:
             for s in (s0, s1):
                 frag = s.holder.fragment("i", "f", "standard", 0)
                 assert frag is not None and frag.contains(1, 5)
+            # Timestamped writes fan out too, landing in time views on
+            # EVERY replica (reference: executor_test.go
+            # TestExecutor_Execute_Remote_SetBit_With_Timestamp).
+            for s in (s0, s1):
+                s.holder.frame("i", "f").set_time_quantum("Y")
+            c0.execute_query(
+                "i",
+                'SetBit(frame="f", rowID=7, columnID=3,'
+                ' timestamp="2019-06-01T00:00")',
+            )
+            for s in (s0, s1):
+                tf = s.holder.fragment("i", "f", "standard_2019", 0)
+                assert tf is not None and tf.contains(7, 3), s.host
         finally:
             s0.close()
             s1.close()
