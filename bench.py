@@ -300,7 +300,12 @@ def main() -> None:
     # batches is sound: the pool does NOT memoize results (fetch-folded
     # repeat-vs-fresh ratio measured ~1.0x), and distinct batches still
     # defeat any (executable, args) result cache if one ever appears.
-    N_LO, N_HI = 4, 28
+    # CPU fallback must finish within the driver's patience: the same
+    # slope scheme at 1B columns takes >1h on the host backend, so the
+    # fallback trims iteration counts (its artifact is a labeled
+    # availability record, not a TPU-comparable number).
+    N_LO, N_HI = (2, 6) if cpu_fallback else (4, 28)
+    SLOPE_EPOCHS = 2 if cpu_fallback else 6
 
     def folded_wall(fn, inputs) -> float:
         acc = None
@@ -323,6 +328,7 @@ def main() -> None:
             devs[0].size * 4,
             sanity_peak * 1.25 if sanity_peak else None,
             log,
+            epochs=SLOPE_EPOCHS,
         )
 
     def time_variant(name: str, fn) -> float | None:
@@ -335,7 +341,7 @@ def main() -> None:
         else:
             log(
                 f"device {name} Intersect+Count: {s*1e3:.2f} ms/query"
-                f" (fold-fetched slope, best of 6 epochs)"
+                f" (fold-fetched slope, best of {SLOPE_EPOCHS} epochs)"
             )
         return s
 
@@ -348,6 +354,8 @@ def main() -> None:
     # kernel is VPU-popcount-bound, not HBM-bound, and %-of-HBM-peak is
     # the wrong roofline for it.
     def probe(name, fn):
+        if cpu_fallback:
+            return None  # TPU evidence only; hour-scale on the host
         try:
             f = jax.jit(fn)
             jax.block_until_ready(f(devs[0]))  # compile
@@ -404,7 +412,9 @@ def main() -> None:
     try:
         e2e_s = with_retries(
             "e2e executor tier",
-            lambda: run_executor_tiers(leaves, host_count, rng, dev_s),
+            lambda: run_executor_tiers(
+                leaves, host_count, rng, dev_s, cpu_fallback
+            ),
         )
         metric = "e2e_pql_intersect_count_1b_columns"
     except Exception as e:  # noqa: BLE001 — the artifact must survive
@@ -493,12 +503,18 @@ def measure_query(
     return p50, per_q, conc_p50
 
 
-def run_executor_tiers(leaves, host_count, rng, dev_s) -> float:
-    # dev_s may be None when the raw-kernel slope was unreliable; the
-    # "x raw kernel" annotations degrade gracefully.
-    """Tiers 2 and 3; returns the e2e per-query seconds under
-    concurrent load (the throughput the north-star metric names)."""
+def run_executor_tiers(leaves, host_count, rng, dev_s, cpu_fb=False) -> float:
+    """Executor tiers; returns the e2e per-query seconds under
+    concurrent load (the throughput the north-star metric names).
+
+    ``dev_s`` may be None when the raw-kernel slope was unreliable (the
+    "x raw kernel" annotations degrade gracefully).  ``cpu_fb`` is
+    main()'s validated fallback flag — passed down, NOT re-derived from
+    the env, so a leaked BENCH_CPU_FALLBACK can never trim (and
+    mislabel) a healthy TPU measurement."""
     import jax  # noqa: F401 — backend already up
+    # One trim policy for every fallback-shortened tier.
+    trim = dict(n_serial=2, trials=1) if cpu_fb else dict(n_serial=8, trials=3)
     from pilosa_tpu.exec.executor import Executor
     from pilosa_tpu.pql.parser import parse_string
 
@@ -515,7 +531,9 @@ def run_executor_tiers(leaves, host_count, rng, dev_s) -> float:
         def check_count(res):
             assert int(res[0]) == host_count, f"e2e bit-exactness: {res[0]}"
 
-        p50, e2e_16, conc_p50 = measure_query(ex, "i", pq, check_count)
+        p50, e2e_16, conc_p50 = measure_query(
+            ex, "i", pq, check_count, n_conc=16 if cpu_fb else 48, **trim
+        )
         log(
             f"e2e executor Intersect+Count: sync p50 {p50*1e3:.2f} ms/query"
             f" (incl. tunnel round trip); CONCURRENT(16) {e2e_16*1e3:.2f}"
@@ -529,7 +547,7 @@ def run_executor_tiers(leaves, host_count, rng, dev_s) -> float:
         # until the engine, not the RTT, is the limiter; the 16-thread
         # figure above stays for r03 comparability.
         tiers = {16: e2e_16}
-        for threads in (64, 128):
+        for threads in () if cpu_fb else (64, 128):
             _, per_q, _ = measure_query(
                 ex, "i", pq, check_count,
                 n_serial=0, n_conc=3 * threads, threads=threads,
@@ -570,7 +588,7 @@ def run_executor_tiers(leaves, host_count, rng, dev_s) -> float:
             assert len(pairs) == 100 and pairs[0].count >= pairs[-1].count
 
         t_p50, t_per_q, t_conc_p50 = measure_query(
-            ex, "i", tq, check_topn, n_conc=32
+            ex, "i", tq, check_topn, n_conc=8 if cpu_fb else 32, **trim
         )
         log(
             f"e2e executor TopN(n=100) folded single-fetch over 2048 rows:"
@@ -578,13 +596,14 @@ def run_executor_tiers(leaves, host_count, rng, dev_s) -> float:
             f" CONCURRENT(16) {t_per_q*1e3:.2f} ms/query throughput,"
             f" p50 latency under load {t_conc_p50*1e3:.2f} ms"
         )
-        _, t_64, _ = measure_query(
-            ex, "i", tq, check_topn, n_serial=0, n_conc=128, threads=64
-        )
-        log(
-            f"e2e executor TopN(n=100) CONCURRENT(64): {t_64*1e3:.2f}"
-            f" ms/query throughput"
-        )
+        if not cpu_fb:
+            _, t_64, _ = measure_query(
+                ex, "i", tq, check_topn, n_serial=0, n_conc=128, threads=64
+            )
+            log(
+                f"e2e executor TopN(n=100) CONCURRENT(64): {t_64*1e3:.2f}"
+                f" ms/query throughput"
+            )
 
         # --- tier 4: MULTI-SLICE TopN with a src bitmap -----------------
         # 64 slices x 128 ranked candidates, scored against a src row:
@@ -621,7 +640,9 @@ def run_executor_tiers(leaves, host_count, rng, dev_s) -> float:
             pairs = res[0]
             assert len(pairs) == 100 and pairs[0].count >= pairs[-1].count
 
-        m_p50, m_per_q, _ = measure_query(ex, "i", mq, check_ms, n_conc=32)
+        m_p50, m_per_q, _ = measure_query(
+            ex, "i", mq, check_ms, n_conc=8 if cpu_fb else 32, **trim
+        )
         log(
             f"e2e executor TopN(src) over {MS_SLICES} slices x {MS_ROWS}"
             f" candidates (fused plane scorer): sync p50 {m_p50*1e3:.2f} ms"
