@@ -34,6 +34,7 @@ import itertools
 import json
 import mmap
 import os
+import sys
 import tarfile
 import threading
 import time
@@ -221,6 +222,9 @@ class Fragment:
 
         self.row_attr_store = None  # wired by Frame
         self.stats = NopStatsClient()  # re-tagged by View._new_fragment
+        # Injectable like Handler's (net/handler.py): embedders route or
+        # silence repair notices; default matches the CLI server.
+        self.logger = lambda msg: print(msg, file=sys.stderr)
         # Process-unique identity for cache version vectors: unlike
         # id(), a serial is never reused by a recreated fragment.
         self._serial = next(_fragment_serials)
@@ -334,9 +338,57 @@ class Fragment:
             # re-raise cleanly.
             err = str(e)
         if err is not None:
+            # WAL recovery: a crash mid-append (group commit makes the
+            # torn window up to the flush buffer, not one record) leaves
+            # a tail that fails its FNV checks.  Truncate to the last
+            # valid record and serve the committed prefix; anything that
+            # is NOT pure-tail damage still refuses to load (reference
+            # replays ops on open, roaring/roaring.go:622-646 — its
+            # single-record appends make torn tails near-impossible, so
+            # it has no repair; ours must).
+            torn = None
+            try:
+                # The bound follows THIS fragment's group-commit flush
+                # threshold (a subclass/test may tune it): crash residue
+                # can never exceed one flush buffer + the record that
+                # tripped it.
+                torn = roaring.scan_torn_tail(
+                    mm, max_tail=self._OP_FLUSH_BYTES + 2 * roaring.OP_SIZE
+                )
+            except roaring.CorruptError:
+                torn = None
+            repaired = None
+            if torn is not None:
+                # Prove the committed prefix actually loads BEFORE
+                # mutating the file: damage outside the op tail (e.g. a
+                # corrupt container payload alongside tail garbage) must
+                # leave the file bytes untouched for forensics, not get
+                # half-"repaired" and still refuse to open.  The decoded
+                # arrays are fresh copies, so the view/mmap can close
+                # right after.
+                view = memoryview(mm)[: torn[0]]
+                try:
+                    repaired = roaring.decode_tiered(view)
+                except roaring.CorruptError:
+                    repaired = None
+                finally:
+                    del view
             mm.close()
-            raise roaring.CorruptError(err)
-        mm.close()
+            if repaired is None:
+                raise roaring.CorruptError(err)
+            valid_end, reason = torn
+            dropped = size - valid_end
+            self._file.truncate(valid_end)
+            self._file.flush()
+            os.fsync(self._file.fileno())
+            self.stats.count("oplogRepair")
+            self.logger(
+                f"fragment {self.path}: repaired torn op-log tail "
+                f"({reason}); dropped {dropped} uncommitted bytes"
+            )
+            words, arrays, op_n = repaired
+        else:
+            mm.close()
         self._load_tiered(words, arrays)
         # replayed-op count feeds snapshot bookkeeping
         self._op_n = op_n
@@ -821,7 +873,7 @@ class Fragment:
 
     # Flush the op buffer once it holds this many bytes (~5k ops) even
     # between boundaries, bounding worst-case loss and memory.
-    _OP_FLUSH_BYTES = 64 << 10
+    _OP_FLUSH_BYTES = roaring.OP_FLUSH_BYTES
 
     def _append_op(self, typ: int, pos: int) -> None:
         if self._file is not None:

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import threading
 from datetime import datetime
 
@@ -61,6 +62,7 @@ class Frame:
         self.row_attr_store = AttrStore(os.path.join(path, ".data"))
         self.on_create_slice = None  # wired by Index/Holder
         self.stats = NopStatsClient()  # re-tagged by Index._new_frame
+        self.logger = lambda msg: print(msg, file=sys.stderr)  # re-wired alongside stats
 
     # --- lifecycle (reference: frame.go:218-334) ---
 
@@ -164,6 +166,7 @@ class Frame:
             on_create_slice=self.on_create_slice,
         )
         view.stats = self.stats.with_tags(f"view:{name}")
+        view.logger = self.logger
         return view
 
     def view(self, name: str) -> View | None:

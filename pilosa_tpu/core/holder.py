@@ -9,6 +9,7 @@ flush loop (reference: holder.go:318-352; driven by the server here).
 from __future__ import annotations
 
 import os
+import sys
 import shutil
 import threading
 
@@ -35,6 +36,10 @@ class Holder:
         # view.go:257).  Server replaces this with its configured client
         # before open().
         self.stats = NopStatsClient()
+        # Logger chain mirrors the stats chain: Server injects its
+        # configured logger before open(); default is stderr so a
+        # bare Holder still surfaces repair notices.
+        self.logger = lambda msg: print(msg, file=sys.stderr)
 
     # --- lifecycle ---
 
@@ -66,6 +71,7 @@ class Holder:
         index = Index(os.path.join(self.path, name), name)
         index.on_create_slice = self.on_create_slice
         index.stats = self.stats.with_tags(f"index:{name}")
+        index.logger = self.logger
         return index
 
     def index(self, name: str) -> Index | None:

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import shutil
 import threading
 
@@ -45,6 +46,7 @@ class Index:
         self.remote_max_inverse_slice = 0
         self.on_create_slice = None  # wired by Holder/Server
         self.stats = NopStatsClient()  # re-tagged by Holder._new_index
+        self.logger = lambda msg: print(msg, file=sys.stderr)  # re-wired alongside stats
 
     # --- lifecycle (reference: index.go:134-228) ---
 
@@ -116,6 +118,7 @@ class Index:
         frame = Frame(os.path.join(self.path, name), self.name, name)
         frame.on_create_slice = self.on_create_slice
         frame.stats = self.stats.with_tags(f"frame:{name}")
+        frame.logger = self.logger
         return frame
 
     def frame(self, name: str) -> Frame | None:

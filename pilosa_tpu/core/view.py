@@ -12,6 +12,7 @@ an ``on_create_slice`` callback wired up by the server).
 from __future__ import annotations
 
 import os
+import sys
 import threading
 from typing import Callable
 
@@ -56,6 +57,7 @@ class View:
         self.row_attr_store = row_attr_store
         self.on_create_slice = on_create_slice
         self.stats = NopStatsClient()  # re-tagged by Frame._new_view
+        self.logger = lambda msg: print(msg, file=sys.stderr)  # re-wired alongside stats
         self._mu = threading.RLock()
         self._fragments: dict[int, Fragment] = {}
 
@@ -93,6 +95,7 @@ class View:
         )
         frag.row_attr_store = self.row_attr_store
         frag.stats = self.stats.with_tags(f"slice:{slice_i}")
+        frag.logger = self.logger
         return frag
 
     # --- accessors ---

@@ -82,6 +82,9 @@ class Server:
             # their frames/views/fragments) pick up tagged children
             # (reference: server.go wiring of holder.Stats).
             self.holder.stats = self.stats
+        # Route storage-layer notices (e.g. op-log tail repairs on
+        # fragment open) through the server's configured logger.
+        self.holder.logger = self.logger
         self.holder.open()
 
         # Start HTTP listener first so ":0" resolves to the real port

@@ -137,22 +137,23 @@ def decode_tiered(
     return words, arrays, op_n
 
 
-def _decode_containers_tiered(data: bytes):
-    """Parse into (words, arrays, ops_offset, infos): bitmap containers
-    as uint64[1024] words, array containers as sorted uint32 values."""
+def _parse_header_tables(data):
+    """Vectorized header parse shared by the tiered decoder and
+    :func:`ops_region_offset` — the ONE place the header layout and the
+    container payload-size rule (n <= 4096 -> 4n-byte array, else
+    8 KiB bitmap) live.  Returns ``(keys u64[], ns i64[], offs i64[],
+    plens i64[], ops_base)``; a tall-sparse file has one container per
+    row (hundreds of thousands of entries), so the key and offset
+    tables read as one structured view each."""
     if len(data) < HEADER_SIZE:
         raise CorruptError("data too small")
     cookie, key_n = struct.unpack_from("<II", data, 0)
     if cookie != COOKIE:
         raise CorruptError("invalid roaring file")
-
     if HEADER_SIZE + key_n * 16 > len(data):
         raise CorruptError(
             f"header claims {key_n} containers but file is {len(data)} bytes"
         )
-    # Vectorized header parse: the key table and offset table read as
-    # one structured view each (a tall-sparse file has one container
-    # per row — hundreds of thousands of entries).
     ktab = np.frombuffer(
         data,
         dtype=np.dtype([("key", "<u8"), ("n1", "<u4")]),
@@ -161,20 +162,28 @@ def _decode_containers_tiered(data: bytes):
     )
     keys = ktab["key"]
     ns = ktab["n1"].astype(np.int64) + 1
+    offs = np.frombuffer(
+        data, dtype="<u4", count=key_n, offset=HEADER_SIZE + key_n * 12
+    ).astype(np.int64)
+    plens = np.where(ns <= ARRAY_MAX_SIZE, ns * 4, CONTAINER_WORDS64 * 8)
+    return keys, ns, offs, plens, HEADER_SIZE + key_n * 16
 
-    offsets_at = HEADER_SIZE + key_n * 12
-    offs_tab = np.frombuffer(data, dtype="<u4", count=key_n, offset=offsets_at)
+
+def _decode_containers_tiered(data: bytes):
+    """Parse into (words, arrays, ops_offset, infos): bitmap containers
+    as uint64[1024] words, array containers as sorted uint32 values."""
+    keys, ns, offs, plens, ops_base = _parse_header_tables(data)
     words_out: dict[int, np.ndarray] = {}
     arrays_out: dict[int, np.ndarray] = {}
-    ops_offset = offsets_at + key_n * 4
+    ops_offset = ops_base
     infos: list[ContainerInfo] = []
-    for i in range(key_n):
-        offset = int(offs_tab[i])
+    for i in range(len(keys)):
+        offset = int(offs[i])
         if offset >= len(data):
             raise CorruptError(f"offset out of bounds: off={offset}, len={len(data)}")
         n = int(ns[i])
         key = int(keys[i])
-        payload_len = n * 4 if n <= ARRAY_MAX_SIZE else CONTAINER_WORDS64 * 8
+        payload_len = int(plens[i])
         if offset + payload_len > len(data):
             raise CorruptError(
                 f"container payload out of bounds: off={offset}, "
@@ -232,6 +241,107 @@ def _decode_containers(data: bytes):
     return containers, ops_offset, infos
 
 
+def ops_region_offset(data) -> int:
+    """Byte offset where the op-log begins (one past the last container
+    payload), computed from the header tables alone — no payload is
+    materialized, so this is cheap even on multi-hundred-MB files.
+    Used by torn-tail recovery, which must locate the op region of a
+    file whose op-log no longer parses."""
+    keys, ns, offs, plens, base = _parse_header_tables(data)
+    if len(keys) == 0:
+        return base
+    end = int((offs + plens).max())
+    if end > len(data):
+        raise CorruptError(
+            f"container payload out of bounds: end={end}, len={len(data)}"
+        )
+    return max(base, end)
+
+
+def _read_op(data, pos: int):
+    """THE parser of the 13-byte op wire record (reference:
+    roaring/roaring.go:1746-1762): returns ``(typ, value, problem)``
+    where ``problem`` is None for a valid record — shared by op replay
+    (:func:`_iter_ops`) and torn-tail scanning so record validity can
+    never diverge between them."""
+    typ = data[pos]
+    (value,) = struct.unpack_from("<Q", data, pos + 1)
+    (chk,) = struct.unpack_from("<I", data, pos + 9)
+    want = fnv1a32(bytes(data[pos : pos + 9]))
+    if chk != want:
+        return typ, value, f"checksum mismatch: exp={want:08x}, got={chk:08x}"
+    if typ not in (OP_ADD, OP_REMOVE):
+        return typ, value, f"invalid op type: {typ}"
+    return typ, value, None
+
+
+def _op_record_valid(data, pos: int) -> bool:
+    return _read_op(data, pos)[2] is None
+
+
+# Group-commit flush threshold for op-log appends — owned here, next to
+# the record format, so the torn-tail bound below can never drift from
+# the writer's actual flush size (fragment._OP_FLUSH_BYTES aliases it).
+OP_FLUSH_BYTES = 64 << 10
+
+# A process crash can tear at most one group-commit flush buffer off the
+# op-log tail (plus the record that tripped the threshold).  An invalid
+# tail LARGER than this cannot be crash residue — it is at-rest damage
+# to committed data and must refuse to load rather than silently
+# truncate.
+MAX_TORN_TAIL = OP_FLUSH_BYTES + 2 * OP_SIZE
+
+
+def scan_torn_tail(data, max_tail: int = MAX_TORN_TAIL) -> tuple[int, str] | None:
+    """Decide whether an unparseable op-log is a TORN TAIL — the residue
+    of a crash mid-append — and if so where the committed prefix ends.
+
+    Returns ``(valid_end, reason)`` when the file's op region consists of
+    a run of valid records followed ONLY by invalid bytes (a partial
+    record at EOF, or full-size records that all fail their FNV check —
+    what an interrupted group-commit ``write()`` leaves, since appends
+    are sequential).  Returns ``None`` when the op-log is healthy OR when
+    a VALID record exists beyond the first invalid one: that shape means
+    mid-log damage to committed data (e.g. a flipped bit at rest), which
+    must never be silently truncated away.
+
+    The reference's recovery window is one 13-byte record (it appends
+    per-op, fragment.go:379-418); group commit widens the torn window to
+    the flush buffer, so recovery must handle a multi-record tail — but
+    never one larger than ``max_tail`` (see :data:`MAX_TORN_TAIL`).
+    Analog: roaring/roaring.go:622-646 (op replay on open).
+    """
+    ops_offset = ops_region_offset(data)
+    pos = ops_offset
+    n = len(data)
+    # Only the final max_tail window can be torn, and records are a
+    # fixed 13 bytes from ops_offset, so the scan can fast-forward to
+    # the record boundary nearest (n - max_tail): identical accept /
+    # refuse outcomes — damage before the window makes the caller's
+    # committed-prefix decode refuse — at O(64 KiB) cost instead of
+    # O(op-log) per-byte Python FNV on a multi-hundred-MB log.
+    if n - pos > max_tail:
+        pos += ((n - max_tail - pos) // OP_SIZE) * OP_SIZE
+    while pos < n:
+        if n - pos < OP_SIZE:
+            return pos, f"partial {n - pos}-byte op record at EOF"
+        if not _op_record_valid(data, pos):
+            # First bad record.  Torn iff nothing after it validates —
+            # scan the remaining aligned windows (a random 13-byte blob
+            # passes the 32-bit FNV check with p ~= 2^-32) — and the
+            # invalid run fits inside one flush buffer.
+            if n - pos > max_tail:
+                return None
+            q = pos + OP_SIZE
+            while q + OP_SIZE <= n:
+                if _op_record_valid(data, q):
+                    return None
+                q += OP_SIZE
+            return pos, f"unchecksummed {n - pos}-byte op-log tail"
+        pos += OP_SIZE
+    return None
+
+
 def _iter_ops(data: bytes, ops_offset: int):
     """Validate and yield (typ, value) op-log records — the single
     parser of the 13-byte wire record, shared by both appliers."""
@@ -239,14 +349,9 @@ def _iter_ops(data: bytes, ops_offset: int):
     while pos < len(data):
         if len(data) - pos < OP_SIZE:
             raise CorruptError(f"op data out of bounds: len={len(data) - pos}")
-        typ = data[pos]
-        (value,) = struct.unpack_from("<Q", data, pos + 1)
-        (chk,) = struct.unpack_from("<I", data, pos + 9)
-        want = fnv1a32(data[pos : pos + 9])
-        if chk != want:
-            raise CorruptError(f"checksum mismatch: exp={want:08x}, got={chk:08x}")
-        if typ not in (OP_ADD, OP_REMOVE):
-            raise CorruptError(f"invalid op type: {typ}")
+        typ, value, problem = _read_op(data, pos)
+        if problem is not None:
+            raise CorruptError(problem)
         yield typ, value
         pos += OP_SIZE
 

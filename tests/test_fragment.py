@@ -749,3 +749,164 @@ def test_open_corrupt_file_raises_corrupt_error(tmp_path):
     with mock.patch.dict(_os.environ, {"PILOSA_TPU_DISABLE_NATIVE": "1"}):
         with pytest.raises(roaring_mod.CorruptError):
             f2.open()
+
+
+# ---------------------------------------------------------------------------
+# torn op-log tail recovery (WAL repair on open)
+# reference: roaring/roaring.go:622-646 (op replay), fragment.go:154-242
+# ---------------------------------------------------------------------------
+
+
+def _frag_with_oplog(tmp_path, n_ops=50):
+    """A closed fragment file whose op-log holds n_ops SetBit records."""
+    path = str(tmp_path / "wal")
+    f = Fragment(path, "i", "f", "standard", 0)
+    f.open()
+    for i in range(n_ops):
+        f.set_bit(1, i)
+    f.close()
+    return path
+
+
+def _reopen_and_bits(path):
+    f = Fragment(path, "i", "f", "standard", 0)
+    f.open()
+    bits = f.row(1).bits()
+    f.close()
+    return bits
+
+
+@pytest.mark.parametrize("native", [True, False])
+def test_torn_tail_partial_record_truncated_on_open(tmp_path, native, monkeypatch):
+    from pilosa_tpu.ops import roaring as rg
+
+    if not native:
+        monkeypatch.setenv("PILOSA_TPU_DISABLE_NATIVE", "1")
+    path = _frag_with_oplog(tmp_path)
+    healthy = os.path.getsize(path)
+    with open(path, "ab") as fh:
+        fh.write(b"\x01\x02\x03\x04\x05")  # 5-byte torn record
+    assert _reopen_and_bits(path) == list(range(50))
+    assert os.path.getsize(path) == healthy
+    assert rg.check(open(path, "rb").read()) == []
+
+
+@pytest.mark.parametrize("native", [True, False])
+def test_torn_tail_multi_record_garbage_truncated(tmp_path, native, monkeypatch):
+    """Group commit can tear MULTIPLE records: a crash mid-64KiB-flush
+    leaves full-size garbage records plus a partial one.  All of it must
+    go; the committed prefix must survive."""
+    from pilosa_tpu.ops import roaring as rg
+
+    if not native:
+        monkeypatch.setenv("PILOSA_TPU_DISABLE_NATIVE", "1")
+    path = _frag_with_oplog(tmp_path)
+    healthy = os.path.getsize(path)
+    with open(path, "ab") as fh:
+        fh.write(b"\x00" * (13 * 3 + 7))  # 3 bad checksums + torn tail
+    assert _reopen_and_bits(path) == list(range(50))
+    assert os.path.getsize(path) == healthy
+    assert rg.check(open(path, "rb").read()) == []
+
+
+def test_torn_tail_mid_record_truncation_keeps_prefix(tmp_path):
+    """File cut mid-record (crash during append): ops before the cut
+    survive, the partial record is dropped."""
+    from pilosa_tpu.ops import roaring as rg
+
+    path = _frag_with_oplog(tmp_path)
+    ops_at = rg.ops_region_offset(open(path, "rb").read())
+    keep = 20
+    with open(path, "r+b") as fh:
+        fh.truncate(ops_at + keep * 13 + 6)
+    assert _reopen_and_bits(path) == list(range(keep))
+    assert os.path.getsize(path) == ops_at + keep * 13
+
+
+def test_mid_log_corruption_with_later_valid_ops_refuses(tmp_path):
+    """Damage to COMMITTED data (valid records beyond the bad one) is
+    not a torn tail — open must refuse, not silently drop ops."""
+    from pilosa_tpu.ops import roaring as rg
+
+    path = _frag_with_oplog(tmp_path)
+    data = bytearray(open(path, "rb").read())
+    ops_at = rg.ops_region_offset(bytes(data))
+    data[ops_at + 5 * 13 + 3] ^= 0xFF  # flip a byte inside op #5's value
+    open(path, "wb").write(bytes(data))
+    f = Fragment(path, "i", "f", "standard", 0)
+    with pytest.raises(rg.CorruptError):
+        f.open()
+
+
+def test_torn_tail_on_empty_container_section(tmp_path):
+    """A fresh fragment (header only) with a torn first op recovers to
+    the bare header."""
+    path = str(tmp_path / "wal")
+    f = Fragment(path, "i", "f", "standard", 0)
+    f.open()
+    f.close()
+    with open(path, "ab") as fh:
+        fh.write(b"\x00\xaa\xbb")
+    assert _reopen_and_bits(path) == []
+
+
+def test_scan_torn_tail_healthy_returns_none(tmp_path):
+    from pilosa_tpu.ops import roaring as rg
+
+    path = _frag_with_oplog(tmp_path)
+    assert rg.scan_torn_tail(open(path, "rb").read()) is None
+
+
+def test_container_damage_plus_tail_garbage_leaves_file_untouched(tmp_path):
+    """Corruption OUTSIDE the op tail (here: an unsorted array container)
+    must refuse to open even when tail garbage makes the op region look
+    torn — and the file bytes must be left intact for forensics."""
+    from pilosa_tpu.ops import roaring as rg
+
+    path = str(tmp_path / "wal")
+    f = Fragment(path, "i", "f", "standard", 0)
+    f.open()
+    f.import_bulk(np.array([1, 1, 1]), np.array([5, 9, 30]))
+    f.close()
+    data = bytearray(open(path, "rb").read())
+    # swap the first two sorted u32 array values -> unsorted container
+    off = rg.ops_region_offset(bytes(data)) - 12
+    data[off : off + 8] = data[off + 4 : off + 8] + data[off : off + 4]
+    data += b"\x00" * 20  # tail garbage that alone would be "torn"
+    open(path, "wb").write(bytes(data))
+    before = open(path, "rb").read()
+    f2 = Fragment(path, "i", "f", "standard", 0)
+    with pytest.raises(rg.CorruptError):
+        f2.open()
+    assert open(path, "rb").read() == before
+
+
+def test_oversized_invalid_tail_refuses(tmp_path):
+    """An invalid tail bigger than one group-commit flush buffer cannot
+    be crash residue (writes flush at 64 KiB) — it is at-rest damage to
+    committed data and must refuse to load, file untouched."""
+    from pilosa_tpu.ops import roaring as rg
+
+    path = _frag_with_oplog(tmp_path, n_ops=10)
+    with open(path, "ab") as fh:
+        fh.write(b"\x00" * ((64 << 10) + 1024))
+    before = open(path, "rb").read()
+    f = Fragment(path, "i", "f", "standard", 0)
+    with pytest.raises(rg.CorruptError):
+        f.open()
+    assert open(path, "rb").read() == before
+
+
+def test_torn_tail_on_log_larger_than_scan_window(tmp_path):
+    """The scanner fast-forwards to the last flush-buffer window on big
+    logs; a torn tail on a >64 KiB op-log still repairs correctly."""
+    from pilosa_tpu.ops import roaring as rg
+
+    n_ops = 8000  # 104 KB of op records > MAX_TORN_TAIL
+    path = _frag_with_oplog(tmp_path, n_ops=n_ops)
+    healthy = os.path.getsize(path)
+    with open(path, "ab") as fh:
+        fh.write(b"\x00" * (13 * 2 + 5))
+    assert _reopen_and_bits(path) == list(range(n_ops))
+    assert os.path.getsize(path) == healthy
+    assert rg.check(open(path, "rb").read()) == []
