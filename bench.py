@@ -238,10 +238,53 @@ def main() -> None:
     import jax
     import jax.numpy as jnp
 
-    from pilosa_tpu.exec import plan
+    from pilosa_tpu.exec import plan, warmup
     from pilosa_tpu.exec.executor import Executor
     from pilosa_tpu.ops.bitplane import SLICE_WIDTH, WORDS_PER_SLICE
     from pilosa_tpu.pql.parser import parse_string
+
+    # Persistent XLA compile cache (exec/warmup.py): restarts
+    # deserialize the fused programs from disk instead of recompiling —
+    # the fix for the 5 s cold query.  The dir lives next to bench.py so
+    # it survives across driver rounds.
+    cache_dir = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".jax-compile-cache"
+    )
+    had_cache = os.path.isdir(cache_dir) and bool(os.listdir(cache_dir))
+    warmup.enable_compile_cache(cache_dir)
+    log(f"compile cache: {cache_dir} ({'warm' if had_cache else 'cold'})")
+    # Restart probes (fresh subprocesses, sequential — never concurrent
+    # with this process's device use): first run populates the disk
+    # cache (true cold compile), second measures a process restart
+    # loading it.  Run BEFORE this process touches the backend so the
+    # TPU tunnel only ever has one client.
+    if os.environ.get("BENCH_SKIP_RESTART_PROBE") != "1":
+        import subprocess
+
+        probe = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "tools",
+            "compile_probe_restart.py",
+        )
+        times = []
+        for label in ("cold" if not had_cache else "warm-disk", "restart"):
+            try:
+                out = subprocess.run(
+                    [sys.executable, probe, cache_dir],
+                    capture_output=True,
+                    timeout=600,
+                    text=True,
+                )
+                if out.returncode != 0 or not out.stdout.strip():
+                    log(f"restart probe failed ({label}): rc={out.returncode} "
+                        f"stderr={out.stderr.strip()[-300:]!r}")
+                    break
+                times.append(float(out.stdout.strip().splitlines()[-1]))
+                log(f"headline-program compile, fresh process ({label}): "
+                    f"{times[-1]*1e3:.0f} ms")
+            except Exception as e:
+                log(f"restart probe failed ({label}): {e}")
+                break
 
     total_columns = int(os.environ.get("BENCH_COLUMNS", 1_000_000_000))
     n_slices = (total_columns + SLICE_WIDTH - 1) // SLICE_WIDTH  # 954
@@ -526,7 +569,12 @@ def run_executor_tiers(leaves, host_count, rng, dev_s, cpu_fb=False) -> float:
         (got,) = ex.execute("i", pq)
         cold_s = time.perf_counter() - t0
         assert int(got) == host_count, f"e2e bit-exactness: {got} != {host_count}"
-        log(f"e2e executor COLD (assembly+compile): {cold_s*1e3:.1f} ms")
+        from pilosa_tpu.exec import warmup as _warmup
+
+        cache_note = (
+            ", persistent cache on" if _warmup.enabled_cache_dir() else ""
+        )
+        log(f"e2e executor COLD (assembly+compile{cache_note}): {cold_s*1e3:.1f} ms")
 
         def check_count(res):
             assert int(res[0]) == host_count, f"e2e bit-exactness: {res[0]}"

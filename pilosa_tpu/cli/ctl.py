@@ -102,7 +102,20 @@ def build_server(cfg: config_mod.Config):
         max_writes_per_request=cfg.max_writes_per_request,
         logger=logger,
         stats=new_stats_client(cfg.metrics.service, cfg.metrics.host),
+        compilation_cache_dir=_resolve_cache_dir(cfg),
+        prewarm=cfg.tpu.prewarm,
     )
+
+
+def _resolve_cache_dir(cfg) -> str | None:
+    """tpu.compilation-cache-dir: "" -> <data-dir>/.jax-compile-cache,
+    "off" -> disabled, else the given path."""
+    raw = cfg.tpu.compilation_cache_dir
+    if raw == "off":
+        return None
+    if raw:
+        return os.path.expanduser(raw)
+    return os.path.join(os.path.expanduser(cfg.data_dir), ".jax-compile-cache")
 
 
 def run_server(args) -> int:

