@@ -318,17 +318,17 @@ class Fragment:
             self._file.write(roaring.encode({}))
             self._file.flush()
             return
-        # Tiered decode straight out of an mmap of the file: the
-        # bytes are never duplicated on the heap, so peak RSS on
-        # open is the TIER size, not 2x the file (reference
-        # mmaps and zero-copies containers, fragment.go:154-242,
-        # roaring/roaring.go:567-620).  Array containers stay as
-        # value arrays, so a tall-sparse file loads in O(set
-        # bits).
+        # Streaming load straight out of an mmap of the file
+        # (_load_direct): containers fill the two tiers in place, no
+        # whole-file intermediate, so peak RSS on open is the TIER
+        # size, not 2x the file (reference mmaps and zero-copies
+        # containers, fragment.go:154-242, roaring/roaring.go:567-620).
+        # Array containers stay as value arrays, so a tall-sparse
+        # file loads in O(set bits).
         mm = mmap.mmap(self._file.fileno(), 0, access=mmap.ACCESS_READ)
         err = None
         try:
-            words, arrays, op_n = roaring.decode_tiered(mm)
+            op_n = self._load_direct(mm)
         except roaring.CorruptError as e:
             # A decode failure's traceback frames hold buffer
             # views of the mmap; closing it here would raise
@@ -357,24 +357,24 @@ class Fragment:
                 )
             except roaring.CorruptError:
                 torn = None
-            repaired = None
+            op_n = None
             if torn is not None:
                 # Prove the committed prefix actually loads BEFORE
-                # mutating the file: damage outside the op tail (e.g. a
+                # mutating the file — damage outside the op tail (e.g. a
                 # corrupt container payload alongside tail garbage) must
                 # leave the file bytes untouched for forensics, not get
-                # half-"repaired" and still refuse to open.  The decoded
-                # arrays are fresh copies, so the view/mmap can close
-                # right after.
+                # half-"repaired" and still refuse to open.  _load_direct
+                # only commits to self on success and copies everything
+                # it keeps, so the view/mmap can close right after.
                 view = memoryview(mm)[: torn[0]]
                 try:
-                    repaired = roaring.decode_tiered(view)
+                    op_n = self._load_direct(view)
                 except roaring.CorruptError:
-                    repaired = None
+                    op_n = None
                 finally:
                     del view
             mm.close()
-            if repaired is None:
+            if op_n is None:
                 raise roaring.CorruptError(err)
             valid_end, reason = torn
             dropped = size - valid_end
@@ -386,10 +386,8 @@ class Fragment:
                 f"fragment {self.path}: repaired torn op-log tail "
                 f"({reason}); dropped {dropped} uncommitted bytes"
             )
-            words, arrays, op_n = repaired
         else:
             mm.close()
-        self._load_tiered(words, arrays)
         # replayed-op count feeds snapshot bookkeeping
         self._op_n = op_n
 
@@ -524,6 +522,277 @@ class Fragment:
         slot = self._alloc_dense_slot(row_id)
         self._plane[slot] = bp.np_columns_to_row(offs)
         self._invalidate_device()
+
+    def _load_direct(self, mm) -> int:
+        """Stream containers from the mmap'd file STRAIGHT into the two
+        storage tiers and replay the op-log; returns the op count.
+
+        Unlike decode_tiered + _load_tiered (kept for restore payloads),
+        no whole-file container dict ever materializes, so open's peak
+        heap is the tier size itself (plane + sparse offsets ≈ file
+        bytes), not 2x — the closest Python analog of the reference's
+        zero-copy mmap container attach (roaring/roaring.go:567-620):
+        file bytes stay in the page cache, the heap holds exactly the
+        tiers.  Everything builds into locals and commits to ``self`` at
+        the end, so a CorruptError mid-parse leaves the fragment's state
+        untouched (the torn-tail repair path retries after truncating).
+        """
+        keys, ns, offs, plens, ops_base = roaring.parse_header_tables(mm)
+        size = len(mm)
+        cps = bp.CONTAINERS_PER_SLICE
+        cbits = roaring.CONTAINER_BITS
+        wpc = bp.WORDS_PER_CONTAINER
+        n_cont = len(keys)
+
+        if n_cont:
+            ends = offs + plens
+            if (offs >= size).any() or (ends > size).any():
+                raise roaring.CorruptError("container payload out of bounds")
+            if (offs % 4).any():
+                raise roaring.CorruptError("misaligned container payload")
+            ops_offset = int(max(ops_base, ends.max()))
+        else:
+            ops_offset = ops_base
+
+        rows_of = (keys // cps).astype(np.int64)
+        # Header n fields drive the density RANKING only; exact counts
+        # are recomputed from the actual payloads after the tiers are
+        # built (a corrupt n must never poison Count/TopN — the check
+        # CLI reports such files, but open stays payload-truthful).
+        uniq_rows, starts = np.unique(rows_of, return_index=True)
+        row_counts = (
+            np.add.reduceat(ns, starts) if n_cont else np.zeros(0, np.int64)
+        )
+        order = np.argsort(-row_counts, kind="stable")
+        dense_rows = sorted(
+            int(uniq_rows[i]) for i in order[: self.dense_row_budget]
+        )
+        slot_of = {r: i for i, r in enumerate(dense_rows)}
+        plane = bp.empty_plane(bp.pad_rows(len(dense_rows)))
+        sparse: dict[int, np.ndarray] = {}
+
+        # Per-container slot (-1 = sparse tier), via the uniq_rows table.
+        slot_table = np.asarray(
+            [slot_of.get(int(r), -1) for r in uniq_rows], dtype=np.int64
+        )
+        cont_slots = (
+            slot_table[np.searchsorted(uniq_rows, rows_of)]
+            if n_cont
+            else np.zeros(0, np.int64)
+        )
+
+        # One u32 view over the payload region (no copy; op-log records
+        # after ops_offset are 13-byte and break 4-alignment, so the
+        # view stops there).
+        u32 = np.frombuffer(mm, dtype="<u4", count=ops_offset // 4)
+
+        amask = ns <= roaring.ARRAY_MAX_SIZE if n_cont else np.zeros(0, bool)
+        bmask = ~amask if n_cont else amask
+
+        # Sparse rows holding any BITMAP container are rebuilt
+        # per-row below (two payload forms must interleave in key
+        # order); exclude them from the vectorized grouping.
+        special_rows = (
+            set(int(r) for r in rows_of[bmask & (cont_slots < 0)])
+            if n_cont
+            else set()
+        )
+
+        # ---- array containers: vectorized gather in bounded CHUNKS so
+        # the transient index/value arrays never rival the tier itself
+        # (an all-array 180 MB file would otherwise gather ~45M values
+        # with int64 scratch — hundreds of MB of peak for nothing).
+        _CHUNK_VALUES = self._LOAD_CHUNK_VALUES
+        if n_cont and amask.any():
+            a_idx = np.nonzero(amask)[0]
+            csum = np.cumsum(ns[a_idx])
+            special_arr = (
+                np.asarray(sorted(special_rows)) if special_rows else None
+            )
+            sp_rows_parts: list[np.ndarray] = []
+            sp_offs_parts: list[np.ndarray] = []
+            start = 0
+            while start < len(a_idx):
+                floor = int(csum[start - 1]) if start else 0
+                end = int(
+                    np.searchsorted(csum, floor + _CHUNK_VALUES, side="right")
+                )
+                end = max(end, start + 1)
+                blk = a_idx[start:end]
+                ns_blk = ns[blk]
+                offs32 = (offs[blk] // 4).astype(np.int64)
+                total = int(ns_blk.sum())
+                base_idx = np.repeat(
+                    offs32 - np.insert(np.cumsum(ns_blk), 0, 0)[:-1], ns_blk
+                )
+                vals = u32[base_idx + np.arange(total)]
+                del base_idx
+                if total and int(vals.max()) >= cbits:
+                    raise roaring.CorruptError("array value out of range")
+                if total > 1:
+                    d = np.diff(vals.astype(np.int64))
+                    ok = d > 0
+                    # container-boundary diffs are exempt (bnd-1 indexes
+                    # d, and bnd <= total-1 always since every n >= 1);
+                    # chunk edges are container boundaries too.
+                    bnd = np.cumsum(ns_blk)[:-1]
+                    ok[bnd - 1] = True
+                    if not ok.all():
+                        raise roaring.CorruptError(
+                            "array container is not sorted/unique"
+                        )
+                    del d, ok
+                # offsets within a slice fit int32 (< 2^20)
+                cidx_rep = np.repeat(
+                    (keys[blk] % cps).astype(np.int32), ns_blk
+                )
+                slots_rep = np.repeat(cont_slots[blk].astype(np.int32), ns_blk)
+                off_in_slice = cidx_rep * np.int32(cbits) + vals.astype(
+                    np.int32
+                )
+                del vals, cidx_rep
+
+                dm = slots_rep >= 0
+                if dm.any():
+                    sel = off_in_slice[dm]
+                    word = sel // np.int32(bp.WORD_BITS)
+                    bits = (
+                        np.uint32(1) << (sel % np.int32(bp.WORD_BITS)).astype(np.uint32)
+                    ).astype(np.uint32)
+                    np.bitwise_or.at(plane, (slots_rep[dm], word), bits)
+                    del sel, word, bits
+                sm = ~dm
+                if sm.any():
+                    rows_rep = np.repeat(rows_of[blk], ns_blk)
+                    if special_arr is not None:
+                        sm &= ~np.isin(rows_rep, special_arr)
+                    if sm.any():
+                        # boolean-mask indexing COPIES: compact buffers
+                        # holding exactly the sparse values.
+                        sp_rows_parts.append(rows_rep[sm])
+                        sp_offs_parts.append(
+                            off_in_slice[sm].astype(np.uint32)
+                        )
+                start = end
+            if sp_rows_parts:
+                # chunks ascend in container-key order, so the
+                # concatenation is globally sorted by (row, offset);
+                # per-row slices are views of ONE compact buffer.
+                s_rows = np.concatenate(sp_rows_parts)
+                s_offs = np.concatenate(sp_offs_parts)
+                del sp_rows_parts, sp_offs_parts
+                u_s, st = np.unique(s_rows, return_index=True)
+                bounds = np.append(st, len(s_rows))
+                for j, r in enumerate(u_s):
+                    sparse[int(r)] = s_offs[bounds[j] : bounds[j + 1]]
+
+        # ---- bitmap containers of dense rows: slice-assign payloads.
+        if n_cont and bmask.any():
+            for i in np.nonzero(bmask)[0]:
+                slot = int(cont_slots[i])
+                if slot < 0:
+                    continue
+                s32 = int(offs[i]) // 4
+                cidx = int(keys[i]) % cps
+                # wpc is u32 words per container (2048)
+                plane[slot, cidx * wpc : (cidx + 1) * wpc] = u32[
+                    s32 : s32 + wpc
+                ]
+
+        # ---- mixed-form sparse rows (rare): rebuild in key order.
+        for r in sorted(special_rows):
+            lo = int(np.searchsorted(rows_of, r, side="left"))
+            hi = int(np.searchsorted(rows_of, r, side="right"))
+            segs = []
+            for i in range(lo, hi):
+                cidx = int(keys[i]) % cps
+                s32 = int(offs[i]) // 4
+                if amask[i]:
+                    vals_i = u32[s32 : s32 + int(ns[i])]
+                else:
+                    w = np.ascontiguousarray(
+                        u32[s32 : s32 + wpc]
+                    ).view(np.uint64)
+                    vals_i = roaring.words_to_values(w)
+                segs.append(
+                    vals_i.astype(np.uint32) + np.uint32(cidx * cbits)
+                )
+            sparse[r] = (
+                np.concatenate(segs) if segs else np.empty(0, np.uint32)
+            )
+
+        # ---- exact counts from the built tiers (payload-truthful,
+        # like the replaced decode path's np_count sweep).  Row-block
+        # sweeps keep the popcount temp out of the open peak.
+        counts: dict[int, int] = {}
+        if dense_rows:
+            cnts = np.concatenate(
+                [
+                    bp.np_row_counts(plane[b : b + 256])
+                    for b in range(0, len(dense_rows), 256)
+                ]
+            )
+            counts.update(
+                (r, int(cnts[slot])) for r, slot in slot_of.items()
+            )
+        counts.update((r, len(offs_r)) for r, offs_r in sparse.items())
+
+        # ---- op-log replay over the freshly-built tiers.
+        op_n = 0
+        max_row = int(uniq_rows.max()) if n_cont else 0
+        for typ, value in roaring._iter_ops(mm, ops_offset):
+            op_n += 1
+            row, offset = divmod(value, SLICE_WIDTH)
+            slot = slot_of.get(row)
+            if slot is None and row not in sparse:
+                if len(slot_of) < self.dense_row_budget:
+                    slot = slot_of[row] = len(slot_of)
+                    if slot >= plane.shape[0]:
+                        extra = np.zeros(
+                            (bp.pad_rows(slot + 1) - plane.shape[0],
+                             bp.WORDS_PER_SLICE),
+                            np.uint32,
+                        )
+                        plane = np.vstack([plane, extra])
+                else:
+                    sparse[row] = np.empty(0, np.uint32)
+                counts.setdefault(row, 0)
+            if slot is not None:
+                if typ == roaring.OP_ADD:
+                    changed = bp.np_set_bit(plane, slot * SLICE_WIDTH + offset)
+                else:
+                    changed = bp.np_clear_bit(plane, slot * SLICE_WIDTH + offset)
+            else:
+                offs_row = sparse[row]
+                i = int(np.searchsorted(offs_row, offset))
+                present = i < len(offs_row) and int(offs_row[i]) == offset
+                if typ == roaring.OP_ADD and not present:
+                    sparse[row] = np.insert(offs_row, i, np.uint32(offset))
+                    changed = True
+                elif typ == roaring.OP_REMOVE and present:
+                    sparse[row] = np.delete(offs_row, i)
+                    changed = True
+                else:
+                    changed = False
+            if changed:
+                counts[row] = counts.get(row, 0) + (
+                    1 if typ == roaring.OP_ADD else -1
+                )
+                max_row = max(max_row, row)
+
+        # ---- commit (everything above was local).
+        self._slot_of = slot_of
+        self._plane = plane
+        self._sparse = sparse
+        self._sparse_dev.clear()
+        self._max_row_id = max_row
+        self._count_of = counts
+        self._block_sums.clear()
+        self._dirty_blocks.clear()
+        self._row_cache.clear()
+        self._invalidate_device()
+        _bump_write_epoch()
+        return op_n
 
     def _load_tiered(
         self, words: dict[int, np.ndarray], arrays: dict[int, np.ndarray]
@@ -718,6 +987,11 @@ class Fragment:
     # Above this many queued point writes, a full re-upload is cheaper
     # than the scatter program.
     _MAX_DEVICE_PENDING = 8192
+
+    # Array-container values gathered per sweep in _load_direct (~1M
+    # values -> ~25 MB scratch); tests shrink it to force multi-chunk
+    # loads on small fixtures.
+    _LOAD_CHUNK_VALUES = 1 << 20
 
     def _invalidate_device(self) -> None:
         """Bulk plane changes (import, restore, load) force a full

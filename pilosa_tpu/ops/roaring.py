@@ -162,11 +162,23 @@ def _parse_header_tables(data):
     )
     keys = ktab["key"]
     ns = ktab["n1"].astype(np.int64) + 1
+    # The format writes containers in strictly ascending key order
+    # (encoder sorts; reference roaring.go:507-531 iterates sorted) and
+    # every consumer here — the streaming fragment loader's grouping,
+    # the sparse tier's binary searches — depends on it, so fail fast
+    # instead of silently mis-answering on an out-of-order file.
+    if key_n > 1 and (np.diff(keys.astype(np.int64)) <= 0).any():
+        raise CorruptError("container keys are not sorted/unique")
     offs = np.frombuffer(
         data, dtype="<u4", count=key_n, offset=HEADER_SIZE + key_n * 12
     ).astype(np.int64)
     plens = np.where(ns <= ARRAY_MAX_SIZE, ns * 4, CONTAINER_WORDS64 * 8)
     return keys, ns, offs, plens, HEADER_SIZE + key_n * 16
+
+
+# Public alias: the fragment's streaming loader parses the header
+# tables itself to fill its storage tiers straight from the mmap.
+parse_header_tables = _parse_header_tables
 
 
 def _decode_containers_tiered(data: bytes):

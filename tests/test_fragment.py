@@ -910,3 +910,80 @@ def test_torn_tail_on_log_larger_than_scan_window(tmp_path):
     assert _reopen_and_bits(path) == list(range(n_ops))
     assert os.path.getsize(path) == healthy
     assert rg.check(open(path, "rb").read()) == []
+
+
+# ---------------------------------------------------------------------------
+# streaming loader (_load_direct) edge cases
+# ---------------------------------------------------------------------------
+
+
+def test_load_direct_multi_chunk_array_gather(tmp_path, monkeypatch):
+    """Array-container values gather in bounded chunks; shrinking the
+    chunk size forces many sweeps (with cross-chunk container
+    boundaries) and the result must be identical."""
+    monkeypatch.setattr(Fragment, "_LOAD_CHUNK_VALUES", 1 << 10)
+    path = str(tmp_path / "chunky")
+    f = Fragment(path, "i", "f", "standard", 0, dense_row_budget=8)
+    f.open()
+    rng = np.random.default_rng(5)
+    rows_l, cols_l = [], []
+    for r in range(40):  # ~120k values >> 1024-value chunks
+        cols = np.unique(rng.integers(0, 1 << 20, 3200, dtype=np.int64))
+        rows_l.append(np.full(len(cols), r, dtype=np.int64))
+        cols_l.append(cols)
+    f.import_bulk(np.concatenate(rows_l), np.concatenate(cols_l))
+    expect = {r: f.row(r).bits() for r in (0, 7, 8, 23, 39)}
+    expect_counts = f.row_counts()
+    f.close()
+
+    f2 = Fragment(path, "i", "f", "standard", 0, dense_row_budget=8)
+    f2.open()
+    assert len(f2._sparse) == 32  # 8 dense + 32 sparse
+    for r, bits in expect.items():
+        assert f2.row(r).bits() == bits, f"row {r}"
+    assert f2.row_counts() == expect_counts
+    f2.close()
+
+
+def test_load_rejects_unsorted_container_keys(tmp_path):
+    """Out-of-order container keys would silently break the sparse
+    tier's binary searches — open must refuse (fail-fast standard)."""
+    from pilosa_tpu.ops import roaring as rg
+
+    # containers at keys [1, 0]: swap the two key-table entries
+    data = rg.encode_tiered(
+        {}, {0: np.array([7], np.uint32), 1: np.array([5], np.uint32)}
+    )
+    raw = bytearray(data)
+    k0 = raw[8 : 8 + 12]
+    raw[8 : 8 + 12] = raw[20 : 20 + 12]
+    raw[20 : 20 + 12] = k0
+    path = str(tmp_path / "unsorted")
+    open(path, "wb").write(bytes(raw))
+    f = Fragment(path, "i", "f", "standard", 0)
+    with pytest.raises(rg.CorruptError, match="not sorted"):
+        f.open()
+
+
+def test_load_counts_come_from_payload_not_header_n(tmp_path):
+    """A corrupt bitmap-container n must not poison Count/TopN: counts
+    recompute from the payload on open (header n only drives tier
+    ranking)."""
+    from pilosa_tpu.ops import roaring as rg
+
+    path = str(tmp_path / "badn")
+    f = Fragment(path, "i", "f", "standard", 0)
+    f.open()
+    f.import_bulk(np.zeros(5000, np.int64), np.arange(5000, dtype=np.int64))
+    f.close()
+    raw = bytearray(open(path, "rb").read())
+    # single bitmap container (n=5000 > 4096): inflate header n
+    (n1,) = np.frombuffer(bytes(raw[16:20]), "<u4")
+    assert n1 + 1 == 5000
+    raw[16:20] = np.uint32(59999).tobytes()  # claims n=60000
+    open(path, "wb").write(bytes(raw))
+    f2 = Fragment(path, "i", "f", "standard", 0)
+    f2.open()
+    assert f2.count() == 5000
+    assert f2.row_counts()[0] == 5000
+    f2.close()
