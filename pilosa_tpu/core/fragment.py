@@ -1381,13 +1381,18 @@ class Fragment:
         keep: np.ndarray,
         cand_ids: np.ndarray,
         n: int,
+        cand_mask: np.ndarray | None = None,
     ) -> tuple[np.ndarray, np.ndarray]:
         """Phase-1 winner selection over a scored union restricted to
         ``cand_ids``: filter mask, (-count, id) sort (sort_pairs'
         canonical order), trim to ``n``.  The ONE implementation of the
         phase-1 selection rule (consumed by the executor's folded
-        TopN)."""
-        m = keep & np.isin(ids, cand_ids)
+        TopN).  ``cand_mask`` optionally pre-resolves the
+        ``isin(ids, cand_ids)`` membership (the executor's prep cache
+        computes it once per query shape)."""
+        m = keep & (
+            cand_mask if cand_mask is not None else np.isin(ids, cand_ids)
+        )
         sel_ids, sel_cnts = ids[m], cnts[m]
         order = np.lexsort((sel_ids, -sel_cnts))
         if n:

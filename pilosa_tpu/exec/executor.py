@@ -25,6 +25,7 @@ TPU-native execution differs in structure, not results:
 from __future__ import annotations
 
 import threading
+import time
 from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field, replace
@@ -104,6 +105,18 @@ def needs_slices(calls: list[Call]) -> bool:
     return any(c.name not in WRITE_CALLS for c in calls)
 
 
+def isin_sorted(values: np.ndarray, sorted_ref: np.ndarray) -> np.ndarray:
+    """Membership of ``values`` in SORTED-unique ``sorted_ref`` via one
+    binary search — np.isin's sort-based path costs ~80 us/call even on
+    tiny arrays, and the folded TopN's phase-2 pays it once per slice
+    per query."""
+    if not len(sorted_ref):
+        return np.zeros(len(values), dtype=bool)
+    idx = np.searchsorted(sorted_ref, values)
+    idx[idx == len(sorted_ref)] = len(sorted_ref) - 1
+    return sorted_ref[idx] == values
+
+
 def merge_counts_by_id(parts):
     """Sum (ids, counts) array pairs by id — Pairs.Add semantics
     (reference: cache.go:312-334), the ONE array implementation of the
@@ -147,6 +160,10 @@ class Executor:
         # concurrent HTTP request threads, so access is lock-guarded.
         self._batch_cache: "OrderedDict[tuple, dict]" = OrderedDict()
         self._batch_mu = threading.Lock()
+        # Folded-TopN prep LRU (see _topn_folded_entry) — candidate
+        # walks, union assembly, and gather prep cached per (query,
+        # slice set), validated like _batch_cache entries.
+        self._topn_cache: "OrderedDict[tuple, dict]" = OrderedDict()
         # slice->node grouping LRU (see _slices_by_node).
         self._slice_group_cache: "OrderedDict[tuple, dict]" = OrderedDict()
 
@@ -1053,19 +1070,102 @@ class Executor:
             return False
         return set(m.keys()) == {self.host}
 
-    def _execute_topn_folded(
-        self, index: str, c: Call, slices: list[int], opt: ExecOptions
-    ) -> list[Pair]:
-        """Both TopN phases from one scoring pass (reference protocol:
-        executor.go:281-321 — two map/reduce rounds; here the cross-slice
-        candidate union is known after a host-only cache walk, so every
-        slice scores the WHOLE union once and the phase-1 winner
-        selection plus the phase-2 exact counts both read those scores.
-        One device round trip instead of two.)"""
-        n = _uint_arg(c, "n")[0]
+    # Folded-TopN prep entries kept per (index, query, slice set): the
+    # working set of a hot dashboard is a handful of repeated queries.
+    _TOPN_CACHE_CAP = 8
+
+    def _topn_versions(self, index: str, c: Call, slices: list[int]):
+        """Validity vector for a folded-TopN prep entry: the TopN
+        frame's fragment versions over the ORIGINAL slice list (a
+        fragment springing into existence must invalidate) plus, when a
+        src tree exists, the versions of every fragment its leaves
+        resolve to (the src rows were host-evaluated at prep time)."""
+        frame, view = self._topn_frame_view(c)
+        out: list = []
+        for s in slices:
+            frag = self.holder.fragment(index, frame, view, s)
+            out.append(
+                None if frag is None else (frag._serial, frag._version)
+            )
+        if len(c.children) == 1:
+            try:
+                _, leaves = plan.decompose(c.children[0])
+            except plan.PlanError:
+                leaves = []
+            out.append(tuple(self._leaf_versions(index, leaves, slices)))
+        return tuple(out)
+
+    def _topn_folded_entry(self, index: str, c: Call, slices: list[int]) -> dict:
+        """The folded path's prep — candidate walks, union assembly,
+        foreign-count resolution, src evaluation, and gather prep —
+        CACHED per (index, query, slice set) and validated exactly like
+        _cached_batch entries (O(1) against the global write epoch, then
+        against the version vector).  At 64 slices the prep is ~50 ms of
+        host-side numpy per query; repeated queries skip all of it and
+        pay only dispatch + fetch + winner selection.
+
+        Attr-filtered queries (filterField) are NOT cached: the attr
+        store has no version vector, so a SetRowAttrs would serve stale
+        candidates."""
+        key = (index, str(c), tuple(slices))
+        cacheable = not self._topn_parsed_args(c)[3]  # "" = no filterField
+        if cacheable:
+            now = time.monotonic()
+            with self._batch_mu:
+                # Purge entries past their lifetime: they can never be
+                # served again (the expiry below), and each pins an HBM
+                # plane snapshot via its SubRefs — dead entries must not
+                # hold device memory until LRU displacement.
+                for k in [
+                    k
+                    for k, e in self._topn_cache.items()
+                    if now - e["built_at"] >= cache_mod.RECALCULATE_INTERVAL_S
+                ]:
+                    del self._topn_cache[k]
+                ent = self._topn_cache.get(key)
+            # Entries also EXPIRE on the rank caches' re-sort throttle:
+            # candidate counts come from the ranked caches, whose
+            # throttled re-sort (RECALCULATE_INTERVAL_S) happens inside
+            # the candidate walk this cache skips — without the expiry a
+            # hot read-only query would freeze its candidate counts
+            # forever instead of the old path's <= 10 s of staleness.
+            if ent is not None and (
+                time.monotonic() - ent["built_at"]
+                < cache_mod.RECALCULATE_INTERVAL_S
+            ):
+                epoch = fragment_mod.write_epoch()
+                if ent["epoch"] == epoch or ent[
+                    "versions"
+                ] == self._topn_versions(index, c, slices):
+                    ent["epoch"] = epoch
+                    with self._batch_mu:
+                        if key in self._topn_cache:
+                            self._topn_cache.move_to_end(key)
+                    return ent
+        # Capture validity BEFORE building: a concurrent write during
+        # the build leaves the entry conservatively stale.
+        epoch = fragment_mod.write_epoch()
+        versions = self._topn_versions(index, c, slices) if cacheable else None
+        ent = self._topn_folded_build(index, c, slices)
+        ent["epoch"] = epoch
+        ent["versions"] = versions
+        ent["built_at"] = time.monotonic()
+        if cacheable:
+            with self._batch_mu:
+                self._topn_cache[key] = ent
+                while len(self._topn_cache) > self._TOPN_CACHE_CAP:
+                    self._topn_cache.popitem(last=False)
+        return ent
+
+    def _topn_folded_build(self, index: str, c: Call, slices: list[int]) -> dict:
+        """Build a folded-TopN prep entry (see _topn_folded_entry for
+        the caching contract).  Entry shapes: ``{"empty": True}``,
+        ``{"two_phase": True}``, or ``{"parts": [(frag, topt, cand_ids,
+        cand_mask, st_proto, sub_ref, src_words, src_slot), ...]}``
+        where st_proto is the UNSCORED TopState (cloned per query) and
+        cand_mask pre-resolves ``np.isin(union_order_ids, cand_ids)``
+        for phase-1 winner selection."""
         has_src = len(c.children) == 1
-        if len(c.children) > 1:
-            raise ExecutorError("TopN() can only have one input bitmap")
 
         # Only slices whose fragment exists can contribute; restricting
         # up front turns every per-slice walk below into O(fragments).
@@ -1084,7 +1184,7 @@ class Executor:
             frag, topt = prep
             per.append((frag, topt) + frag.top_candidates_arrays(topt))
         if not per:
-            return []
+            return {"empty": True}
         # Guard against disjoint caches: every slice scores the WHOLE
         # union, so when the union dwarfs the largest per-slice candidate
         # list the folded pass does more device gather+score work than
@@ -1093,10 +1193,10 @@ class Executor:
         # keep union ~= per-slice candidates and stay folded.
         union = np.unique(np.concatenate([ids for _, _, ids, _ in per]))
         if not len(union):
-            return []
+            return {"empty": True}
         max_cand = max(len(ids) for _, _, ids, _ in per)
         if len(union) > max(2 * max_cand, 512):
-            return self._execute_topn_two_phase(index, c, slices, opt, n)
+            return {"two_phase": True}
 
         if has_src:
             src_rows = self._eval_tree_slices_host(index, c.children[0], slices)
@@ -1111,7 +1211,7 @@ class Executor:
                     frag, topt = prep
                     per.append((frag, topt) + frag.top_candidates_arrays(topt))
                 if not per:
-                    return []
+                    return {"empty": True}
                 union = np.unique(
                     np.concatenate([ids for _, _, ids, _ in per])
                 )
@@ -1128,20 +1228,63 @@ class Executor:
                     attached.append((frag, replace(topt, src=src), ids, cnts))
                 per = attached
         if not len(union):
-            return []
+            return {"empty": True}
 
-        # Pass 2: score the union on every slice in ONE batched program
-        # with ONE fetch (all fragments score the same union, so the
-        # gathered submatrices share a shape).  The union pass reuses
-        # each slice's candidate arrays and resolves counts only for
-        # the foreign winners (top_prepare_union_parts).
-        states: list[tuple] = []
+        # Gather prep: the union scoring pass per fragment, WITHOUT the
+        # kernel dispatch (all fragments score the same union, so the
+        # gathered submatrices share a shape).  Reuses each slice's
+        # candidate arrays, resolving counts only for the foreign
+        # winners (top_prepare_union_parts).
         parts: list[tuple] = []
         for frag, topt, cand_ids, cand_cnts in per:
-            part = frag.top_prepare_union_parts(union, cand_ids, cand_cnts, topt)
-            states.append((frag, topt, cand_ids, part[0]))
-            parts.append(self._attach_dev_src(index, c, frag, part))
-        self._score_topn_parts(parts)
+            st, sub_ref, srcw = frag.top_prepare_union_parts(
+                union, cand_ids, cand_cnts, topt
+            )
+            _, _, _, src_slot = self._attach_dev_src(
+                index, c, frag, (st, sub_ref, srcw)
+            )
+            cand_mask = (
+                np.isin(st.cand_ids, cand_ids, assume_unique=True)
+                if st.cand_ids is not None
+                else None
+            )
+            parts.append(
+                (frag, topt, cand_ids, cand_mask, st, sub_ref, srcw, src_slot)
+            )
+        return {"parts": parts}
+
+    def _execute_topn_folded(
+        self, index: str, c: Call, slices: list[int], opt: ExecOptions
+    ) -> list[Pair]:
+        """Both TopN phases from one scoring pass (reference protocol:
+        executor.go:281-321 — two map/reduce rounds; here the cross-slice
+        candidate union is known after a host-only cache walk, so every
+        slice scores the WHOLE union once and the phase-1 winner
+        selection plus the phase-2 exact counts both read those scores.
+        One device round trip instead of two.)  The prep (candidates,
+        union, gather layout) comes from the validated per-query cache
+        (_topn_folded_entry); per query only the dispatch, the ONE
+        fetch, and the winner selection run."""
+        n = _uint_arg(c, "n")[0]
+        if len(c.children) > 1:
+            raise ExecutorError("TopN() can only have one input bitmap")
+        ent = self._topn_folded_entry(index, c, slices)
+        if ent.get("empty"):
+            return []
+        if ent.get("two_phase"):
+            return self._execute_topn_two_phase(index, c, slices, opt, n)
+
+        # Clone the unscored states (the prep is shared across
+        # concurrent queries; scores are per-query), dispatch, fetch.
+        states: list[tuple] = []
+        score_parts: list[tuple] = []
+        for frag, topt, cand_ids, cand_mask, st_proto, sub_ref, srcw, src_slot in ent[
+            "parts"
+        ]:
+            st = replace(st_proto, counts=None, dev_counts=None)
+            states.append((frag, topt, cand_ids, cand_mask, st))
+            score_parts.append((st, sub_ref, srcw, src_slot))
+        self._score_topn_parts(score_parts)
 
         # Phase-1 winner selection per slice, from the same scores the
         # two-phase protocol's first round would have produced for the
@@ -1150,7 +1293,7 @@ class Executor:
         # Python dominated warm TopN host time.
         winner_ids: list[np.ndarray] = []
         fulls: list[tuple[np.ndarray, np.ndarray]] = []
-        for frag, topt, cand_ids, st in states:
+        for frag, topt, cand_ids, cand_mask, st in states:
             ids, cnts, keep, short = frag.top_score_arrays(st)
             fulls.append((ids[keep], cnts[keep]))
             if topt.src is None:
@@ -1162,7 +1305,9 @@ class Executor:
                 # the subset selection would short-circuit identically.
                 winner_ids.append(ids)
             else:
-                sel_ids, _ = frag.select_winners(ids, cnts, keep, cand_ids, topt.n)
+                sel_ids, _ = frag.select_winners(
+                    ids, cnts, keep, cand_ids, topt.n, cand_mask=cand_mask
+                )
                 winner_ids.append(sel_ids)
         ids2 = (
             np.unique(np.concatenate(winner_ids))
@@ -1177,7 +1322,7 @@ class Executor:
         # Pairs.Add, cache.go:312-334).
         kept = []
         for i, cts in fulls:
-            m = np.isin(i, ids2)
+            m = isin_sorted(i, ids2)
             kept.append((i[m], cts[m]))
         merged = merge_counts_by_id(kept)
         if merged is None:

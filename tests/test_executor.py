@@ -977,3 +977,87 @@ def test_enable_compile_cache_idempotent():
     assert ok1 and ok2
     # First caller in the PROCESS wins (an earlier test may have won).
     assert warmup.enabled_cache_dir() is not None
+
+
+# ---------------------------------------------------------------------------
+# folded-TopN prep cache (per-query validated, like _cached_batch)
+# ---------------------------------------------------------------------------
+
+
+def _topn_fixture(holder, n_slices=3):
+    bits = []
+    for s in range(n_slices):
+        base = s * SLICE_WIDTH
+        bits += [(0, base + i) for i in range(6)]
+        bits += [(1, base + i) for i in range(4)]
+        bits += [(2, base + i) for i in range(2)]
+    must_set_bits(holder, "i", "f", bits)
+
+
+def test_topn_folded_prep_cache_hits_and_stays_exact(ex, holder, monkeypatch):
+    _topn_fixture(holder)
+    q_text = "TopN(frame=f, n=2)"
+    (p1,) = q(ex, "i", q_text)
+    builds = []
+    real = type(ex)._topn_folded_build
+
+    def spy(self, index, c, slices):
+        builds.append(1)
+        return real(self, index, c, slices)
+
+    monkeypatch.setattr(type(ex), "_topn_folded_build", spy)
+    (p2,) = q(ex, "i", q_text)
+    (p3,) = q(ex, "i", q_text)
+    assert builds == []  # warm entry: no rebuild
+    assert [(p.id, p.count) for p in p2] == [(p.id, p.count) for p in p1]
+    assert [(p.id, p.count) for p in p3] == [(p.id, p.count) for p in p1]
+
+
+def test_topn_folded_cache_adds_no_staleness_beyond_rank_cache(
+    ex, holder, monkeypatch
+):
+    """After writes, the prep-cached executor must answer identically
+    to a BRAND-NEW executor over the same holder (the rank cache's
+    throttled re-sort is shared state — the prep cache must add no
+    staleness of its own)."""
+    from pilosa_tpu.cluster.topology import new_cluster
+    from pilosa_tpu.exec import Executor as Ex
+
+    _topn_fixture(holder)
+    (before,) = q(ex, "i", "TopN(frame=f, n=3)")
+    assert [p.id for p in before] == [0, 1, 2]
+    for i in range(10, 20):
+        q(ex, "i", f"SetBit(frame=f, rowID=2, columnID={SLICE_WIDTH + i})")
+    (cached_after,) = q(ex, "i", "TopN(frame=f, n=3)")
+    c = new_cluster(1)
+    fresh = Ex(holder, host=c.nodes[0].host, cluster=c)
+    (fresh_after,) = q(fresh, "i", "TopN(frame=f, n=3)")
+    assert [(p.id, p.count) for p in cached_after] == [
+        (p.id, p.count) for p in fresh_after
+    ]
+    # force the throttled re-sort AND expire the prep entry (its
+    # lifetime is bounded by the same interval): fresh counts follow
+    holder.fragment("i", "f", "standard", 1).cache.recalculate()
+    import pilosa_tpu.core.cache as cache_mod
+
+    monkeypatch.setattr(cache_mod, "RECALCULATE_INTERVAL_S", 0.0)
+    (forced,) = q(ex, "i", "TopN(frame=f, n=3)")
+    counts = {p.id: p.count for p in forced}
+    assert counts[2] == 16 and (forced[0].id, forced[0].count) == (0, 18)
+
+
+def test_topn_folded_cache_invalidates_on_src_frame_write(ex, holder):
+    """The src tree's fragments are part of the validity vector: a write
+    to the SRC row (same frame here) must re-derive the prep — the
+    device-scored counts are exact, so staleness would show directly."""
+    _topn_fixture(holder)
+    (before,) = q(ex, "i", "TopN(Bitmap(rowID=0, frame=f), frame=f, n=3)")
+    c0 = {p.id: p.count for p in before}
+    assert c0[1] == 4 * 3  # rows 0/1 overlap on cols 0-3, summed per slice
+    assert c0[2] == 2 * 3
+    # extend src row 0 AND row 2 with one overlapping new bit in slice 2
+    q(ex, "i", f"SetBit(frame=f, rowID=2, columnID={2 * SLICE_WIDTH + 300})")
+    q(ex, "i", f"SetBit(frame=f, rowID=0, columnID={2 * SLICE_WIDTH + 300})")
+    (after,) = q(ex, "i", "TopN(Bitmap(rowID=0, frame=f), frame=f, n=3)")
+    c1 = {p.id: p.count for p in after}
+    assert c1[2] == c0[2] + 1
