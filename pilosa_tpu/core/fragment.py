@@ -416,13 +416,14 @@ class Fragment:
         """Load persisted TopN candidate ids and re-count their rows
         (reference: fragment.go:244-282)."""
         try:
-            with open(self.cache_path) as fh:
-                ids = json.load(fh)
+            with open(self.cache_path, "rb") as fh:
+                payload = fh.read()
         except FileNotFoundError:
             return
-        except (json.JSONDecodeError, OSError):
+        except OSError:
             return  # corrupt cache is rebuilt lazily, like the reference
-        if not isinstance(ids, list):
+        ids = self._decode_cache_ids(payload)
+        if ids is None:
             return
         for row_id in ids:
             if isinstance(row_id, int) and (
@@ -431,12 +432,43 @@ class Fragment:
                 self.cache.bulk_add(row_id, self._count_of.get(row_id, 0))
         self.cache.invalidate()
 
+    @staticmethod
+    def _encode_cache_ids(ids: list[int]) -> bytes:
+        """The reference's protobuf ``Cache`` message (same name + field
+        number as internal/private.proto, reference: fragment.go:
+        1083-1110) — .cache files and backup-tar "cache" entries are
+        interchangeable with a real Pilosa's."""
+        from pilosa_tpu.net import wire_pb2 as wire
+
+        return wire.Cache(IDs=ids).SerializeToString()
+
+    @staticmethod
+    def _decode_cache_ids(payload: bytes) -> list[int] | None:
+        """Cache-file payload -> row ids.  Protobuf ``Cache`` is the
+        format; a leading '[' means a JSON list from r01-r04 files
+        (kept readable for upgrades).  None = unreadable (the cache
+        rebuilds lazily, like the reference)."""
+        if payload[:1] == b"[":
+            try:
+                ids = json.loads(payload)
+            except json.JSONDecodeError:
+                return None
+            return ids if isinstance(ids, list) else None
+        from pilosa_tpu.net import wire_pb2 as wire
+
+        msg = wire.Cache()
+        try:
+            msg.ParseFromString(payload)
+        except Exception:
+            return None
+        return list(msg.IDs)
+
     def flush_cache(self) -> None:
         """Persist TopN candidate row ids (reference: fragment.go:1083-1110)."""
         with self._mu:
             tmp = self.cache_path + ".tmp"
-            with open(tmp, "w") as fh:
-                json.dump(self.cache.ids(), fh)
+            with open(tmp, "wb") as fh:
+                fh.write(self._encode_cache_ids(self.cache.ids()))
             os.replace(tmp, self.cache_path)
 
     # ------------------------------------------------------------------
@@ -1816,7 +1848,7 @@ class Fragment:
             info.size = len(data)
             info.mtime = int(time.time())
             tw.addfile(info, io.BytesIO(data))
-            cache_data = json.dumps(self.cache.ids()).encode()
+            cache_data = self._encode_cache_ids(self.cache.ids())
             info = tarfile.TarInfo("cache")
             info.size = len(cache_data)
             info.mtime = int(time.time())
@@ -1846,9 +1878,8 @@ class Fragment:
                     self._file = open(self.path, "a+b")
                     fcntl.flock(self._file.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
                 elif member.name == "cache":
-                    try:
-                        ids = json.loads(payload)
-                    except json.JSONDecodeError:
+                    ids = self._decode_cache_ids(payload)
+                    if ids is None:
                         continue
                     self.cache = cache_mod.new_cache(self.cache_type, self.cache_size)
                     for row_id in ids:
@@ -1859,6 +1890,11 @@ class Fragment:
                                 row_id, self._count_of.get(row_id, 0)
                             )
                     self.cache.invalidate()
+                    # A replaced cache changes TopN candidates without
+                    # any fragment write: epoch-validated prep caches
+                    # must notice even for a cache-only tar (the data
+                    # branch bumps via _load_tiered).
+                    _bump_write_epoch()
             tr.close()
 
     # ------------------------------------------------------------------

@@ -1129,23 +1129,32 @@ class Executor:
             # the candidate walk this cache skips — without the expiry a
             # hot read-only query would freeze its candidate counts
             # forever instead of the old path's <= 10 s of staleness.
+            cur_versions = None
             if ent is not None and (
                 time.monotonic() - ent["built_at"]
                 < cache_mod.RECALCULATE_INTERVAL_S
             ):
                 epoch = fragment_mod.write_epoch()
-                if ent["epoch"] == epoch or ent[
-                    "versions"
-                ] == self._topn_versions(index, c, slices):
+                if ent["epoch"] != epoch:
+                    cur_versions = self._topn_versions(index, c, slices)
+                if ent["epoch"] == epoch or ent["versions"] == cur_versions:
                     ent["epoch"] = epoch
                     with self._batch_mu:
                         if key in self._topn_cache:
                             self._topn_cache.move_to_end(key)
                     return ent
         # Capture validity BEFORE building: a concurrent write during
-        # the build leaves the entry conservatively stale.
+        # the build leaves the entry conservatively stale.  The vector
+        # computed for the failed validation (if any) is reused — it
+        # predates the build, which is exactly the conservative bar.
         epoch = fragment_mod.write_epoch()
-        versions = self._topn_versions(index, c, slices) if cacheable else None
+        versions = None
+        if cacheable:
+            versions = (
+                cur_versions
+                if cur_versions is not None
+                else self._topn_versions(index, c, slices)
+            )
         ent = self._topn_folded_build(index, c, slices)
         ent["epoch"] = epoch
         ent["versions"] = versions

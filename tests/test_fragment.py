@@ -987,3 +987,106 @@ def test_load_counts_come_from_payload_not_header_n(tmp_path):
     assert f2.count() == 5000
     assert f2.row_counts()[0] == 5000
     f2.close()
+
+
+# ---------------------------------------------------------------------------
+# protobuf .cache format parity (reference: internal/private.proto Cache,
+# fragment.go:1076-1110)
+# ---------------------------------------------------------------------------
+
+
+def test_cache_file_is_reference_protobuf(tmp_path):
+    """flush_cache writes the reference's protobuf Cache message (same
+    field numbers), so a real Pilosa can parse our .cache files."""
+    from pilosa_tpu.net import wire_pb2 as wire
+
+    f = Fragment(str(tmp_path / "0"), "i", "f", "standard", 0)
+    f.open()
+    for r in (3, 1, 7):
+        for c in range(r + 1):
+            f.set_bit(r, c)
+    f.flush_cache()
+    payload = open(f.cache_path, "rb").read()
+    msg = wire.Cache()
+    msg.ParseFromString(payload)
+    assert sorted(msg.IDs) == [1, 3, 7]
+    f.close()
+
+
+def test_cache_json_backcompat_still_loads(tmp_path):
+    """r01-r04 wrote the cache as a JSON list; those files must keep
+    loading after an upgrade."""
+    f = Fragment(str(tmp_path / "0"), "i", "f", "standard", 0)
+    f.open()
+    for c in range(5):
+        f.set_bit(9, c)
+    f.close()
+    open(f.cache_path, "w").write("[9]")  # overwrite with the old format
+    f2 = Fragment(str(tmp_path / "0"), "i", "f", "standard", 0)
+    f2.open()
+    assert f2.cache.get(9) == 5
+    f2.close()
+
+
+def test_reference_made_tar_cache_entry_restores(tmp_path):
+    """A backup tar whose "cache" entry is the reference's protobuf
+    Cache message restores the cache here (cross-implementation
+    restore)."""
+    import io as _io
+    import tarfile as _tarfile
+
+    from pilosa_tpu.net import wire_pb2 as wire
+    from pilosa_tpu.ops import roaring as rg
+    from tests.conftest import positions_to_words
+
+    # build the tar the way the reference would: roaring data + pb cache
+    words = {0: positions_to_words([1, 2, 3]), 16: positions_to_words([4])}
+    data = rg.encode(words)  # rows 0 and 1 (key 16 = row 1)
+    cache_pb = wire.Cache(IDs=[0, 1]).SerializeToString()
+    buf = _io.BytesIO()
+    tw = _tarfile.open(fileobj=buf, mode="w|")
+    for name, payload in (("data", data), ("cache", cache_pb)):
+        ti = _tarfile.TarInfo(name)
+        ti.size = len(payload)
+        tw.addfile(ti, _io.BytesIO(payload))
+    tw.close()
+    buf.seek(0)
+
+    f = Fragment(str(tmp_path / "0"), "i", "f", "standard", 0)
+    f.open()
+    f.read_from(buf)
+    assert f.cache.get(0) == 3 and f.cache.get(1) == 1
+    assert f.row(0).bits() == [1, 2, 3]
+    f.close()
+
+
+def test_tar_roundtrip_cache_is_protobuf(tmp_path):
+    """Our own backup tars carry the protobuf cache entry and restore
+    it."""
+    import io as _io
+    import tarfile as _tarfile
+
+    from pilosa_tpu.net import wire_pb2 as wire
+
+    f = Fragment(str(tmp_path / "0"), "i", "f", "standard", 0)
+    f.open()
+    for c in range(4):
+        f.set_bit(2, c)
+    buf = _io.BytesIO()
+    f.write_to(buf)
+    f.close()
+    buf.seek(0)
+    tr = _tarfile.open(fileobj=_io.BytesIO(buf.getvalue()), mode="r|")
+    names = {}
+    for m in tr:
+        names[m.name] = tr.extractfile(m).read()
+    msg = wire.Cache()
+    msg.ParseFromString(names["cache"])
+    assert list(msg.IDs) == [2]
+
+    f2 = Fragment(str(tmp_path / "1"), "i", "f", "standard", 0)
+    f2.open()
+    buf.seek(0)
+    f2.read_from(buf)
+    assert f2.cache.get(2) == 4
+    f2.close()
