@@ -286,6 +286,32 @@ def main() -> None:
                 log(f"restart probe failed ({label}): {e}")
                 break
 
+    # Cluster-reduce tier (BASELINE configs[4] shape): runs in a CPU
+    # subprocess BEFORE this process touches the device — coordinator
+    # fan-out/reduce overhead is host-side and must not ride the shared
+    # TPU pool's variance.  ~1 min.
+    if os.environ.get("BENCH_SKIP_CLUSTER_TIER") != "1":
+        import subprocess
+
+        cb = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools", "cluster_bench.py"
+        )
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+        try:
+            out = subprocess.run(
+                [sys.executable, cb], env=env, capture_output=True,
+                timeout=900, text=True,
+            )
+            if out.returncode == 0 and out.stdout.strip():
+                for line in out.stderr.strip().splitlines():
+                    log(line)
+                log(f"cluster_reduce tier: {out.stdout.strip().splitlines()[-1]}")
+            else:
+                log(f"cluster tier failed: rc={out.returncode} "
+                    f"stderr={out.stderr.strip()[-300:]!r}")
+        except Exception as e:
+            log(f"cluster tier failed: {e}")
+
     total_columns = int(os.environ.get("BENCH_COLUMNS", 1_000_000_000))
     n_slices = (total_columns + SLICE_WIDTH - 1) // SLICE_WIDTH  # 954
     log(f"backend={jax.default_backend()} devices={jax.devices()}")

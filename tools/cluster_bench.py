@@ -1,0 +1,145 @@
+"""Cluster-reduce bench: coordinator fan-out/reduce cost vs node count.
+
+BASELINE configs[4] is the reference's 4-node cluster Intersect+Count
+(reference: executor.go:1149-1243 mapReduce over nodes).  This tier
+boots 1/2/4 REAL in-process servers (each with its own HTTP listener,
+holder, and executor; cluster.type=static with hash-identical
+placement), primes every node's owned slices with the same 2-row
+workload, and measures the same PQL Intersect+Count through the
+coordinator — so the curve isolates the coordinator's remote fan-out +
+reduce overhead from the kernel itself.
+
+Runs on the CPU backend in a fresh process (bench.py spawns it with
+JAX_PLATFORMS=cpu before any device work): coordinator overhead is
+host-side, and the numbers must not depend on a shared TPU pool's mood.
+
+Prints ONE JSON line:
+    {"tier": "cluster_reduce", "slices": S, "per_node": {"1": {...}, ...}}
+with sync p50 and concurrent ms/query per node count.  Everything else
+goes to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def boot_cluster(n_nodes: int, data_root: str, slices: int, rows):
+    """``n_nodes`` servers sharing one static cluster map; every node's
+    owned fragments primed from ``rows[slice]`` (uint32[2, words])."""
+    from pilosa_tpu.cluster.topology import Cluster
+    from pilosa_tpu.net.server import Server
+    from pilosa_tpu.ops import bitplane as bp
+
+    servers = []
+    clusters = []
+    for i in range(n_nodes):
+        cluster = Cluster(replica_n=1)
+        s = Server(
+            data_dir=os.path.join(data_root, f"n{i}"),
+            cluster=cluster,
+            anti_entropy_interval=3600,
+            polling_interval=3600,
+            cache_flush_interval=3600,
+        )
+        s.open()
+        servers.append(s)
+        clusters.append(cluster)
+    hosts = sorted(s.host for s in servers)
+    for c in clusters:
+        for h in hosts:
+            if c.node_by_host(h) is None:
+                c.add_node(h)
+        c.nodes.sort(key=lambda n: n.host)
+
+    from bench import prime_fragment  # repo root is on sys.path
+
+    for s in servers:
+        holder = s.holder
+        holder.create_index_if_not_exists("i")
+        holder.index("i").create_frame_if_not_exists("f")
+        view = holder.frame("i", "f").create_view_if_not_exists("standard")
+        for sl in s.cluster.owns_slices("i", slices - 1, s.host):
+            prime_fragment(
+                view.create_fragment_if_not_exists(sl), rows[sl], bp.pad_rows
+            )
+        # every node must know the cluster max slice or the coordinator
+        # under-fans (the polling loop is off in this fixture)
+        holder.index("i").set_remote_max_slice(slices - 1)
+    return servers
+
+
+def measure(host: str, want: int, n_sync: int = 9, n_conc: int = 48,
+            threads: int = 16):
+    from pilosa_tpu.net.client import InternalClient
+
+    client = InternalClient(host, timeout=60.0)
+    q = 'Count(Intersect(Bitmap(rowID=0, frame="f"), Bitmap(rowID=1, frame="f")))'
+    got = client.execute_query("i", q)[0]
+    assert int(got) == want, f"cluster bit-exactness: {got} != {want}"
+    times = []
+    for _ in range(n_sync):
+        t0 = time.perf_counter()
+        client.execute_query("i", q)
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    p50 = times[len(times) // 2]
+    clients = [InternalClient(host, timeout=60.0) for _ in range(threads)]
+    pool = ThreadPoolExecutor(max_workers=threads)
+    t0 = time.perf_counter()
+    futs = [
+        pool.submit(clients[k % threads].execute_query, "i", q)
+        for k in range(n_conc)
+    ]
+    for f in futs:
+        assert int(f.result()[0]) == want
+    conc = (time.perf_counter() - t0) / n_conc
+    pool.shutdown()
+    return p50, conc
+
+
+def main() -> None:
+    slices = int(os.environ.get("CLUSTER_BENCH_SLICES", "64"))
+    rng = np.random.default_rng(11)
+    rows = rng.integers(
+        0, 2**32, size=(slices, 2, 32768), dtype=np.uint32
+    )
+    want = int(np.bitwise_count(rows[:, 0] & rows[:, 1]).sum())
+
+    out = {}
+    for n_nodes in (1, 2, 4):
+        with tempfile.TemporaryDirectory() as d:
+            t0 = time.time()
+            servers = boot_cluster(n_nodes, d, slices, rows)
+            try:
+                p50, conc = measure(servers[0].host, want)
+                out[str(n_nodes)] = {
+                    "sync_p50_ms": round(p50 * 1e3, 2),
+                    "concurrent_ms_per_query": round(conc * 1e3, 2),
+                }
+                log(
+                    f"cluster_reduce nodes={n_nodes} slices={slices}: "
+                    f"sync p50 {p50*1e3:.1f} ms, concurrent "
+                    f"{conc*1e3:.2f} ms/query (setup {time.time()-t0:.0f}s)"
+                )
+            finally:
+                for s in servers:
+                    s.close()
+    print(json.dumps({"tier": "cluster_reduce", "slices": slices, "per_node": out}))
+
+
+if __name__ == "__main__":
+    main()
