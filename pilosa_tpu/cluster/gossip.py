@@ -63,6 +63,20 @@ INLINE_STATE_MAX = 16 * 1024
 STATE_CHUNK_SIZE = 32 * 1024
 # Partial chunk assemblies are dropped after this long.
 _ASSEMBLY_TTL = 30.0
+# Blobs larger than this many chunks skip UDP and stream over the
+# peer's HTTP listener (the analog of memberlist's TCP push/pull,
+# reference: gossip/gossip.go:191-222): a large schema under sustained
+# datagram loss would otherwise re-spray the whole chunk set per ping.
+STREAM_STATE_CHUNKS = 8
+_STREAM_TIMEOUT_S = 10.0
+# UDP transfer attempts (REQs sent / assemblies expired) per digest
+# before the stream fallback takes over.
+_UDP_STATE_MAX_ATTEMPTS = 3
+# Failed HTTP streams per digest before falling back to UDP chunking
+# even for large blobs (a peer reachable over UDP but not HTTP must
+# still converge).  When BOTH paths exhaust a round, the counters reset
+# and the alternation starts over.
+_STREAM_MAX_FAILURES = 2
 
 
 def gossip_port_for(host: str, offset: int = 1000) -> int:
@@ -85,6 +99,7 @@ class GossipNodeSet:
         gossip_fanout: int = 3,
         state_provider=None,
         state_merger=None,
+        state_fetcher=None,
         logger=None,
     ):
         self.host = host  # the node's HTTP host:port (cluster identity)
@@ -106,6 +121,10 @@ class GossipNodeSet:
         self.gossip_fanout = gossip_fanout
         self.state_provider = state_provider
         self.state_merger = state_merger
+        # Stream fallback: fetch a peer's whole state blob over its
+        # HTTP listener (GET /state) when UDP chunking is the wrong
+        # tool — injectable for tests.
+        self.state_fetcher = state_fetcher or self._http_state_fetch
         self.logger = logger or (lambda m: None)
 
         self._handler = None  # BroadcastHandler (the server)
@@ -145,6 +164,15 @@ class GossipNodeSet:
         # in-progress chunk assemblies keyed by (sender, digest).
         self._merged_digests: OrderedDict[str, float] = OrderedDict()
         self._assemblies: dict[tuple[str, str], dict] = {}
+        # digest -> UDP transfer attempts (STATE-REQs sent + timed-out
+        # assemblies); past _UDP_STATE_MAX_ATTEMPTS the digest flips to
+        # the HTTP stream fallback.  Counting REQs (not just expired
+        # assemblies) catches TOTAL chunk loss, where no assembly ever
+        # forms.  A failed stream resets the count so UDP gets another
+        # round — neither path can permanently wedge the other.
+        self._udp_state_attempts: OrderedDict[str, int] = OrderedDict()
+        self._stream_failures: OrderedDict[str, int] = OrderedDict()
+        self._streams_in_flight: set[str] = set()
 
     # ------------------------------------------------------------------
     # NodeSet
@@ -508,9 +536,13 @@ class GossipNodeSet:
             return {}
         if len(blob) <= INLINE_STATE_MAX:
             return {"state_blob": base64.b64encode(blob).decode()}
-        # Too big for a datagram: advertise the digest; interested peers
-        # pull the blob via STATE-REQ/STATE-CHUNK.
-        return {"state_digest": hashlib.sha1(blob).hexdigest()}
+        # Too big for a datagram: advertise the digest (and size — the
+        # receiver picks UDP chunks vs the HTTP stream from it);
+        # interested peers pull the blob.
+        return {
+            "state_digest": hashlib.sha1(blob).hexdigest(),
+            "state_size": len(blob),
+        }
 
     def _merge_state(self, obj: dict) -> None:
         blob = obj.get("state_blob")
@@ -534,12 +566,111 @@ class GossipNodeSet:
             for (_, d), asm in self._assemblies.items():
                 if d == digest and now - asm["t0"] <= _ASSEMBLY_TTL:
                     return
+        # Stream fallback: a blob bigger than STREAM_STATE_CHUNKS
+        # datagrams, or one whose UDP transfer already stalled once,
+        # fetches over the peer's HTTP listener in one request instead
+        # of re-spraying the chunk set (memberlist's TCP push/pull
+        # analog, reference: gossip/gossip.go:191-222).
+        size = obj.get("state_size")
+        big = (
+            isinstance(size, int)
+            and size > STREAM_STATE_CHUNKS * STATE_CHUNK_SIZE
+        )
+        with self._mu:
+            attempts = self._udp_state_attempts.get(digest, 0)
+            sfails = self._stream_failures.get(digest, 0)
+            stalled = attempts >= _UDP_STATE_MAX_ATTEMPTS
+            if stalled and sfails >= _STREAM_MAX_FAILURES:
+                # Both paths exhausted a round — reset and alternate
+                # again rather than wedging on either.
+                self._udp_state_attempts.pop(digest, None)
+                self._stream_failures.pop(digest, None)
+                attempts = sfails = 0
+                stalled = False
+        if (big or stalled) and sfails < _STREAM_MAX_FAILURES:
+            self._start_stream(obj.get("from", ""), digest)
+            return
         sender = self._snapshot().get(obj.get("from", ""))
         if sender is not None:
+            with self._mu:
+                self._bump_state_attempts_locked(digest)
             self._send_logged(
                 sender["addr"],
                 {"t": "state-req", "from": self.host, "digest": digest},
             )
+
+    def _bump_state_attempts_locked(self, digest: str) -> None:
+        self._udp_state_attempts[digest] = (
+            self._udp_state_attempts.get(digest, 0) + 1
+        )
+        while len(self._udp_state_attempts) > 64:
+            self._udp_state_attempts.popitem(last=False)
+
+    def _start_stream(self, peer_host: str, digest: str) -> None:
+        """Fetch a peer's state blob over HTTP on a worker thread (the
+        receive loop must never block on a network round trip); one
+        in-flight stream per digest."""
+        if not peer_host or self.state_merger is None:
+            return
+        with self._mu:
+            if digest in self._streams_in_flight:
+                return
+            self._streams_in_flight.add(digest)
+        threading.Thread(
+            target=self._stream_state,
+            args=(peer_host, digest),
+            daemon=True,
+            name=f"state-stream:{peer_host}",
+        ).start()
+
+    def _stream_state(self, peer_host: str, digest: str) -> None:
+        try:
+            blob = self.state_fetcher(peer_host)
+            if not blob:
+                return
+            # The peer's state may have moved past the advertised
+            # digest; validate and record what actually arrived (same
+            # rule as the chunked path's _serve_state_req).
+            got = hashlib.sha1(blob).hexdigest()
+            try:
+                self.state_merger(blob)
+            except Exception as e:  # noqa: BLE001
+                self.logger(f"state merge error: {e}")
+                return
+            now = time.monotonic()
+            with self._mu:
+                for d in {digest, got}:
+                    self._merged_digests[d] = now
+                    self._udp_state_attempts.pop(d, None)
+                    self._stream_failures.pop(d, None)
+                while len(self._merged_digests) > 64:
+                    self._merged_digests.popitem(last=False)
+        except Exception as e:  # noqa: BLE001
+            self.logger(f"state stream from {peer_host} failed: {e}")
+            # Past _STREAM_MAX_FAILURES the offer handler falls back to
+            # UDP chunking even for large blobs: a peer reachable over
+            # UDP but not HTTP must not be permanently unmergeable.
+            with self._mu:
+                self._stream_failures[digest] = (
+                    self._stream_failures.get(digest, 0) + 1
+                )
+                while len(self._stream_failures) > 64:
+                    self._stream_failures.popitem(last=False)
+        finally:
+            with self._mu:
+                self._streams_in_flight.discard(digest)
+
+    @staticmethod
+    def _http_state_fetch(peer_host: str) -> bytes:
+        """GET the peer's full state blob from its HTTP listener
+        (net/handler.py serves /state from the same provider that
+        feeds gossip)."""
+        import urllib.request
+
+        with urllib.request.urlopen(
+            f"http://{peer_host}/state", timeout=_STREAM_TIMEOUT_S
+        ) as resp:
+            return resp.read()
 
     def _serve_state_req(self, addr) -> None:
         """Stream the CURRENT state blob in numbered chunks.  The blob's
@@ -585,12 +716,14 @@ class GossipNodeSet:
         with self._mu:
             if digest in self._merged_digests:
                 return
-            # GC stale partial assemblies.
+            # GC stale partial assemblies; each timed-out transfer
+            # counts toward the stream-fallback threshold.
             for k in [
                 k
                 for k, a in self._assemblies.items()
                 if now - a["t0"] > _ASSEMBLY_TTL
             ]:
+                self._bump_state_attempts_locked(k[1])
                 del self._assemblies[k]
             asm = self._assemblies.setdefault(key, {"t0": now, "n": n, "parts": {}})
             if asm["n"] != n:
@@ -620,6 +753,8 @@ class GossipNodeSet:
                 return
         with self._mu:
             self._merged_digests[digest] = now
+            self._udp_state_attempts.pop(digest, None)
+            self._stream_failures.pop(digest, None)
             while len(self._merged_digests) > 64:
                 self._merged_digests.popitem(last=False)
 

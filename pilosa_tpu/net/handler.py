@@ -116,6 +116,10 @@ class Handler:
         self.version = version
         self.logger = logger or (lambda msg: print(msg, file=sys.stderr))
         self.stats = stats
+        # Serialized NodeStatus provider (wired by Server): serves the
+        # gossip stream fallback's GET /state (the TCP push/pull analog,
+        # reference: gossip/gossip.go:191-222).
+        self.state_provider = None
         # (method, compiled-regex, fn) — order matters, first match wins
         # (reference: handler.go:93-133).
         self._routes: list[tuple[str, re.Pattern, Callable]] = [
@@ -123,6 +127,7 @@ class Handler:
             ("GET", r"/assets/(?P<file>[^/]+)", self.handle_webui_asset),
             ("GET", r"/schema", self.handle_get_schema),
             ("GET", r"/status", self.handle_get_status),
+            ("GET", r"/state", self.handle_get_state),
             ("GET", r"/hosts", self.handle_get_hosts),
             ("GET", r"/version", self.handle_get_version),
             ("GET", r"/slices/max", self.handle_get_slice_max),
@@ -254,6 +259,15 @@ class Handler:
             ]
         }
         return Response.json({"status": status})
+
+    def handle_get_state(self, req: Request) -> Response:
+        """The node's serialized state blob (NodeStatus protobuf) — the
+        gossip stream fallback pulls it here when UDP chunking stalls
+        or the blob is large."""
+        if self.state_provider is None:
+            return Response.error("state provider not configured", 404)
+        body = self.state_provider()
+        return Response(body=body, content_type=PROTOBUF)
 
     def handle_get_hosts(self, req: Request) -> Response:
         return Response.json([n.to_dict() for n in self.cluster.nodes])

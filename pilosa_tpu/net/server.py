@@ -119,6 +119,12 @@ class Server:
             logger=self.logger,
             stats=self.stats,
         )
+        # ONE provider feeds both /state (the stream fallback's pull
+        # endpoint, any cluster type) and gossip's piggybacked state —
+        # the digest gossip advertises must be of the exact blob /state
+        # serves.
+        state_provider = lambda: self.local_status().SerializeToString()  # noqa: E731
+        self.handler.state_provider = state_provider
         self._http = make_http_server(self.handler, bind_host or "127.0.0.1", port)
         addr = self._http.server_address
         # Keep the *configured* host string as the node identity — it must
@@ -138,9 +144,7 @@ class Server:
             # membership changes (reference: gossip.go:191-222 LocalState/
             # MergeRemoteState, cluster.go:161-173 node states).
             if hasattr(ns, "state_provider") and ns.state_provider is None:
-                ns.state_provider = (
-                    lambda: self.local_status().SerializeToString()
-                )
+                ns.state_provider = state_provider
             if hasattr(ns, "state_merger") and ns.state_merger is None:
 
                 def _merge(blob: bytes) -> None:

@@ -45,3 +45,15 @@ def positions_to_words(positions, n_words=1024):
     for p in positions:
         w[p // 64] |= np.uint64(1) << np.uint64(p % 64)
     return w
+
+
+def free_udp_port() -> int:
+    """Reserve-and-release a local UDP port — shared by the gossip unit
+    tests and the multi-node cluster tests."""
+    import socket
+
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port

@@ -1094,3 +1094,134 @@ class TestConcurrentLoad:
         assert (
             c.execute_pql("cc", 'Count(Bitmap(frame="f", rowID=1))') == total
         )
+
+
+# ---------------------------------------------------------------------------
+# end-to-end gossip-backed cluster (reference: server/server_test.go:376-497)
+# ---------------------------------------------------------------------------
+
+
+class TestGossipCluster:
+    """Three real servers discover each other through the actual
+    GossipNodeSet (no manual broadcaster wiring): schema created on one
+    node replicates through gossip state sync, membership drives node
+    states, and every node — including one that joins late — answers
+    queries."""
+
+    @staticmethod
+    def _gossip_server(tmp_path, name, hosts, seed=""):
+        from pilosa_tpu.cluster.gossip import GossipNodeSet
+        from tests.conftest import free_udp_port as free_udp
+
+        cluster = Cluster(replica_n=1)
+        ns = GossipNodeSet(
+            host="placeholder",  # re-set once the HTTP port is known
+            seed=seed,
+            gossip_interval=0.05,
+            suspect_after=5.0,
+        )
+        ns.bind = ("127.0.0.1", free_udp())
+        cluster.node_set = ns
+        s = Server(
+            data_dir=str(tmp_path / name),
+            cluster=cluster,
+            broadcaster=ns,
+            broadcast_receiver=ns,
+            anti_entropy_interval=3600,
+            polling_interval=3600,
+            cache_flush_interval=3600,
+        )
+        # Static placement list (reference config cluster.hosts); the
+        # ports are pre-reserved by the caller.
+        for h in hosts:
+            cluster.add_node(h)
+        return s, ns
+
+    def test_three_nodes_discover_replicate_and_answer(self, tmp_path):
+        import socket as _socket
+
+        # Reserve three HTTP ports up front: the placement list must be
+        # identical (and complete) on every node from the start.
+        ports = []
+        socks = []
+        for _ in range(3):
+            sk = _socket.socket()
+            sk.bind(("127.0.0.1", 0))
+            ports.append(sk.getsockname()[1])
+            socks.append(sk)
+        for sk in socks:
+            sk.close()
+        hosts = sorted(f"127.0.0.1:{p}" for p in ports)
+
+        servers = []
+        nodesets = []
+        try:
+            # Boot the first two; the third joins LATE.
+            for i in range(2):
+                s, ns = self._gossip_server(tmp_path, f"n{i}", hosts)
+                s.host = hosts[i]
+                ns.host = hosts[i]
+                ns.advertise = ("127.0.0.1", ns.bind[1])
+                if i > 0:
+                    ns.seed = f"{nodesets[0].bind[0]}:{nodesets[0].bind[1]}"
+                s.open()
+                servers.append(s)
+                nodesets.append(ns)
+
+            c0 = InternalClient(servers[0].host, timeout=10.0)
+            c0.create_index("i")
+            c0.create_frame("i", "f")
+
+            # Schema reaches node 1 via gossip state sync alone.
+            deadline = time.time() + 10.0
+            while time.time() < deadline:
+                if servers[1].holder.frame("i", "f") is not None:
+                    break
+                time.sleep(0.05)
+            assert servers[1].holder.frame("i", "f") is not None
+
+            # Late joiner: node 2 boots now, seeds off node 0's gossip.
+            s2, ns2 = self._gossip_server(
+                tmp_path, "n2", hosts,
+                seed=f"{nodesets[0].bind[0]}:{nodesets[0].bind[1]}",
+            )
+            s2.host = hosts[2]
+            ns2.host = hosts[2]
+            ns2.advertise = ("127.0.0.1", ns2.bind[1])
+            s2.open()
+            servers.append(s2)
+            nodesets.append(ns2)
+
+            deadline = time.time() + 10.0
+            while time.time() < deadline:
+                if s2.holder.frame("i", "f") is not None and len(
+                    nodesets[0].nodes()
+                ) == 3:
+                    break
+                time.sleep(0.05)
+            assert s2.holder.frame("i", "f") is not None, "late joiner never synced schema"
+            assert sorted(nodesets[0].nodes()) == hosts
+
+            # Writes via the coordinator route to owners across all 3.
+            cols = [s * SLICE_WIDTH + s for s in range(6)]
+            for col in cols:
+                c0.execute_query("i", f'SetBit(frame="f", rowID=1, columnID={col})')
+
+            # Every node must know the cluster max slice before counting.
+            deadline = time.time() + 10.0
+            while time.time() < deadline:
+                if all(
+                    s.holder.index("i").max_slice() >= 5 for s in servers
+                ):
+                    break
+                time.sleep(0.05)
+
+            for s in servers:
+                client = InternalClient(s.host, timeout=10.0)
+                (n,) = client.execute_query(
+                    "i", 'Count(Bitmap(rowID=1, frame="f"))'
+                )
+                assert int(n) == len(cols), f"count from {s.host}"
+        finally:
+            for s in servers:
+                s.close()
