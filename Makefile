@@ -1,0 +1,39 @@
+# Operator entry points (analog of the reference's Makefile:33-34
+# test/build targets).  The framework is Python+C++: "build" compiles
+# the native codec and generated protobuf in place; "install" does a
+# pip install of the package with the pilosa-tpu console script.
+
+PYTHON ?= python
+
+.PHONY: default test bench install build docker clean generate
+
+default: build test
+
+# Full test suite on the virtual 8-device CPU mesh (tests/conftest.py
+# forces the backend; never touches a real TPU).
+test:
+	$(PYTHON) -m pytest tests/ -q
+
+# Compile the C++ codec and verify the wire module imports.
+build:
+	$(PYTHON) -c "from pilosa_tpu import native; assert native.available(), 'native build failed'; print('native codec ok')"
+	$(PYTHON) -c "from pilosa_tpu.net import wire_pb2; print('wire protobuf ok')"
+
+install:
+	$(PYTHON) -m pip install .
+
+# One JSON line on stdout; tiers and progress on stderr.  Uses the
+# accelerator when one is reachable, else re-execs onto the CPU backend.
+bench:
+	$(PYTHON) bench.py
+
+docker:
+	docker build -t pilosa-tpu .
+
+# Regenerate wire_pb2.py from the wire contract (needs protoc).
+generate:
+	protoc --python_out=. pilosa_tpu/net/wire.proto
+
+clean:
+	rm -f pilosa_tpu/native/libpilosa_native.so pilosa_tpu/native/libpilosa_native.so.flags
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null || true

@@ -168,8 +168,9 @@ class GossipNodeSet:
         # assemblies); past _UDP_STATE_MAX_ATTEMPTS the digest flips to
         # the HTTP stream fallback.  Counting REQs (not just expired
         # assemblies) catches TOTAL chunk loss, where no assembly ever
-        # forms.  A failed stream resets the count so UDP gets another
-        # round — neither path can permanently wedge the other.
+        # forms.  When a digest exhausts BOTH paths' budgets, the offer
+        # handler resets both counters and the alternation starts over
+        # — neither path can permanently wedge the other.
         self._udp_state_attempts: OrderedDict[str, int] = OrderedDict()
         self._stream_failures: OrderedDict[str, int] = OrderedDict()
         self._streams_in_flight: set[str] = set()
@@ -599,12 +600,15 @@ class GossipNodeSet:
                 {"t": "state-req", "from": self.host, "digest": digest},
             )
 
+    @staticmethod
+    def _bump_locked(counter: OrderedDict, key: str) -> None:
+        """Increment a bounded per-digest counter (caller holds _mu)."""
+        counter[key] = counter.get(key, 0) + 1
+        while len(counter) > 64:
+            counter.popitem(last=False)
+
     def _bump_state_attempts_locked(self, digest: str) -> None:
-        self._udp_state_attempts[digest] = (
-            self._udp_state_attempts.get(digest, 0) + 1
-        )
-        while len(self._udp_state_attempts) > 64:
-            self._udp_state_attempts.popitem(last=False)
+        self._bump_locked(self._udp_state_attempts, digest)
 
     def _start_stream(self, peer_host: str, digest: str) -> None:
         """Fetch a peer's state blob over HTTP on a worker thread (the
@@ -624,39 +628,39 @@ class GossipNodeSet:
         ).start()
 
     def _stream_state(self, peer_host: str, digest: str) -> None:
+        ok = False
         try:
             blob = self.state_fetcher(peer_host)
-            if not blob:
-                return
-            # The peer's state may have moved past the advertised
-            # digest; validate and record what actually arrived (same
-            # rule as the chunked path's _serve_state_req).
-            got = hashlib.sha1(blob).hexdigest()
-            try:
+            if blob:
+                # The peer's state may have moved past the advertised
+                # digest, so no sha1-vs-offer comparison here: the
+                # TRANSPORT is trusted (TCP) and the MERGE is the
+                # integrity check — state_merger parses the blob and
+                # raises on garbage, which counts as a stream failure
+                # below.  What actually arrived is recorded by its own
+                # digest (same rule as the chunked _serve_state_req).
+                got = hashlib.sha1(blob).hexdigest()
                 self.state_merger(blob)
-            except Exception as e:  # noqa: BLE001
-                self.logger(f"state merge error: {e}")
-                return
-            now = time.monotonic()
-            with self._mu:
-                for d in {digest, got}:
-                    self._merged_digests[d] = now
-                    self._udp_state_attempts.pop(d, None)
-                    self._stream_failures.pop(d, None)
-                while len(self._merged_digests) > 64:
-                    self._merged_digests.popitem(last=False)
+                ok = True
+                now = time.monotonic()
+                with self._mu:
+                    for d in {digest, got}:
+                        self._merged_digests[d] = now
+                        self._udp_state_attempts.pop(d, None)
+                        self._stream_failures.pop(d, None)
+                    while len(self._merged_digests) > 64:
+                        self._merged_digests.popitem(last=False)
         except Exception as e:  # noqa: BLE001
             self.logger(f"state stream from {peer_host} failed: {e}")
-            # Past _STREAM_MAX_FAILURES the offer handler falls back to
-            # UDP chunking even for large blobs: a peer reachable over
-            # UDP but not HTTP must not be permanently unmergeable.
-            with self._mu:
-                self._stream_failures[digest] = (
-                    self._stream_failures.get(digest, 0) + 1
-                )
-                while len(self._stream_failures) > 64:
-                    self._stream_failures.popitem(last=False)
         finally:
+            if not ok:
+                # EVERY unsuccessful stream (fetch error, empty body,
+                # unparseable blob) counts toward the fallback budget:
+                # past _STREAM_MAX_FAILURES the offer handler retries
+                # UDP chunking even for large blobs, so a broken HTTP
+                # path never pins the digest to doomed re-downloads.
+                with self._mu:
+                    self._bump_locked(self._stream_failures, digest)
             with self._mu:
                 self._streams_in_flight.discard(digest)
 
