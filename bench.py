@@ -734,6 +734,17 @@ def run_executor_tiers(leaves, host_count, rng, dev_s, cpu_fb=False) -> float:
             f" (incl. tunnel round trip); CONCURRENT(16)"
             f" {m_per_q*1e3:.2f} ms/query throughput"
         )
+        if not cpu_fb:
+            # The prep cache leaves dispatch+fetch+selection per query;
+            # more threads overlap the fetch RTTs further (same ladder
+            # logic as the Count tier).
+            _, m_32, _ = measure_query(
+                ex, "i", mq, check_ms, n_serial=0, n_conc=96, threads=32
+            )
+            log(
+                f"e2e executor TopN(src) CONCURRENT(32): {m_32*1e3:.2f}"
+                f" ms/query throughput"
+            )
         ex.close()
         holder.close()
     return e2e_s
