@@ -104,9 +104,24 @@ class Server:
                     else f" (configured {self.compilation_cache_dir})"
                 )
                 self.logger(f"compilation cache: {active}{note}")
+        self.holder.open()
         if self.prewarm:
             warmup.prewarm_async(logger=self.logger)
-        self.holder.open()
+            # After the programs, the DATA: stage fragment planes into
+            # HBM in the background so first queries skip the
+            # host->device transfer too (the dominant cold cost once
+            # compiles come from the persistent cache).
+            def _warm_mirrors():
+                try:
+                    n = self.holder.warm_device_mirrors()
+                    if n:
+                        self.logger(f"warmed {n} fragment device mirrors")
+                except Exception as e:  # noqa: BLE001
+                    self.logger(f"mirror warming failed: {e}")
+
+            threading.Thread(
+                target=_warm_mirrors, daemon=True, name="mirror-warm"
+            ).start()
 
         # Start HTTP listener first so ":0" resolves to the real port
         # before the node self-registers (reference: server.go:109-125).

@@ -209,3 +209,19 @@ def test_open_skips_stray_dirs(tmp_path):
     assert sorted(h2.indexes()) == ["good"]
     assert sorted(h2.index("good").frames()) == ["f"]
     h2.close()
+
+
+def test_warm_device_mirrors_uploads_planes(holder):
+    idx = holder.create_index("i")
+    f = idx.create_frame("f")
+    f.set_bit("standard", 1, 5)
+    f.set_bit("standard", 2, 9)
+    frag = holder.fragment("i", "f", "standard", 0)
+    assert frag._device is None
+    assert holder.warm_device_mirrors() == 1
+    assert frag._device is not None
+    # budget of zero warms nothing
+    idx2 = holder.create_index("j")
+    f2 = idx2.create_frame("f")
+    f2.set_bit("standard", 1, 5)
+    assert holder.warm_device_mirrors(budget_bytes=0) == 0
