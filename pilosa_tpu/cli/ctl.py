@@ -104,6 +104,7 @@ def build_server(cfg: config_mod.Config):
         stats=new_stats_client(cfg.metrics.service, cfg.metrics.host),
         compilation_cache_dir=_resolve_cache_dir(cfg),
         prewarm=cfg.tpu.prewarm,
+        stream_chunk_bytes=cfg.net.stream_chunk_bytes,
     )
 
 
@@ -358,8 +359,10 @@ def run_export(args) -> int:
     try:
         max_slices = client.max_slice_by_index()
         for slice_i in range(max_slices.get(args.index, 0) + 1):
-            csv_text = client.export_csv(args.index, args.frame, args.view, slice_i)
-            w.write(csv_text.encode())
+            # Chunked end to end: the server streams csv_chunks and
+            # export_to copies constant-size chunks straight into the
+            # output file — no slice is ever held whole.
+            client.export_to(w, args.index, args.frame, args.view, slice_i)
     finally:
         if w is not sys.stdout.buffer:
             w.close()

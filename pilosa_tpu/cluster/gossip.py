@@ -589,7 +589,17 @@ class GossipNodeSet:
                 attempts = sfails = 0
                 stalled = False
         if (big or stalled) and sfails < _STREAM_MAX_FAILURES:
-            self._start_stream(obj.get("from", ""), digest)
+            # Dial only hosts the membership snapshot already knows: the
+            # UDP "from" field is unauthenticated, and following it
+            # blindly would let one spoofed datagram point the fetch at
+            # an arbitrary host.
+            claimed = obj.get("from", "")
+            if claimed in self._snapshot():
+                self._start_stream(claimed, digest)
+                return
+            self.logger(
+                f"state stream: ignoring offer from unknown member {claimed!r}"
+            )
             return
         sender = self._snapshot().get(obj.get("from", ""))
         if sender is not None:
@@ -632,22 +642,21 @@ class GossipNodeSet:
         try:
             blob = self.state_fetcher(peer_host)
             if blob:
-                # The peer's state may have moved past the advertised
-                # digest, so no sha1-vs-offer comparison here: the
-                # TRANSPORT is trusted (TCP) and the MERGE is the
-                # integrity check — state_merger parses the blob and
-                # raises on garbage, which counts as a stream failure
-                # below.  What actually arrived is recorded by its own
-                # digest (same rule as the chunked _serve_state_req).
+                # What arrives is recorded under its OWN digest; the
+                # ADVERTISED digest is only marked merged when the
+                # blob's sha1 actually matches it — a peer whose state
+                # moved past the offer (or a tampered body) must not
+                # retire a digest this node never merged.  state_merger
+                # parses the blob and raises on garbage, which counts
+                # as a stream failure below.
                 got = hashlib.sha1(blob).hexdigest()
                 self.state_merger(blob)
                 ok = True
                 now = time.monotonic()
                 with self._mu:
-                    for d in {digest, got}:
-                        self._merged_digests[d] = now
-                        self._udp_state_attempts.pop(d, None)
-                        self._stream_failures.pop(d, None)
+                    self._merged_digests[got] = now
+                    self._udp_state_attempts.pop(got, None)
+                    self._stream_failures.pop(got, None)
                     while len(self._merged_digests) > 64:
                         self._merged_digests.popitem(last=False)
         except Exception as e:  # noqa: BLE001

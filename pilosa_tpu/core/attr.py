@@ -107,24 +107,56 @@ class AttrStore:
             self._conn().commit()
             self._cache[id_] = cur
 
+    # SQLite's bound-parameter ceiling is 999 before 3.32; stay under it.
+    _SELECT_BATCH = 500
+
     def set_bulk_attrs(self, attr_sets: dict[int, dict[str, Any]]) -> None:
-        """Sorted batch write (reference: SetBulkAttrs, attr.go:158-191)."""
+        """Sorted batch write in ONE transaction (reference:
+        SetBulkAttrs, attr.go:158-191 runs a single bolt Update): the
+        current values of all touched ids load via batched ``IN``
+        selects instead of a per-id Python-loop SELECT, the merged rows
+        land through one executemany, and a failure anywhere rolls the
+        whole batch back."""
+        if not attr_sets:
+            return
         with self._lock:
-            for id_ in sorted(attr_sets):
+            ids = sorted(attr_sets)
+            for id_ in ids:
                 validate_attrs(attr_sets[id_])
-            for id_ in sorted(attr_sets):
-                cur = self.attrs(id_)
+            conn = self._conn()
+            missing = [i for i in ids if i not in self._cache]
+            for lo in range(0, len(missing), self._SELECT_BATCH):
+                chunk = missing[lo : lo + self._SELECT_BATCH]
+                marks = ",".join("?" * len(chunk))
+                rows = conn.execute(
+                    f"SELECT id, data FROM attrs WHERE id IN ({marks})",
+                    [_to_db_id(i) for i in chunk],
+                ).fetchall()
+                for db_id, data in rows:
+                    self._cache[_from_db_id(db_id)] = json.loads(data)
+            params: list[tuple[int, str]] = []
+            merged: dict[int, dict[str, Any]] = {}
+            for id_ in ids:
+                cur = dict(self._cache.get(id_, {}))
                 for k, v in attr_sets[id_].items():
                     if v is None:
                         cur.pop(k, None)
                     else:
                         cur[k] = v
-                self._conn().execute(
+                params.append((_to_db_id(id_), json.dumps(cur, sort_keys=True)))
+                merged[id_] = cur
+            try:
+                conn.executemany(
                     "INSERT OR REPLACE INTO attrs (id, data) VALUES (?, ?)",
-                    (_to_db_id(id_), json.dumps(cur, sort_keys=True)),
+                    params,
                 )
-                self._cache[id_] = cur
-            self._conn().commit()
+                conn.commit()
+            except sqlite3.Error:
+                conn.rollback()
+                raise
+            # Cache updates only after the transaction commits — a
+            # rolled-back batch must not leave phantom attrs in memory.
+            self._cache.update(merged)
 
     # --- anti-entropy (reference: attr.go:193-254, 411-441) ---
 

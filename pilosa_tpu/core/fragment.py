@@ -92,6 +92,43 @@ def write_epoch() -> int:
 
 _fragment_serials = itertools.count(1)
 
+# Fragment-close listeners: bound methods (held weakly, so an executor
+# that is never close()d still gets collected) called with the fragment
+# when it leaves service — shutdown or frame/index deletion.  Read-side
+# caches that pin per-fragment device memory (the executor's TopN prep
+# cache) drop their entries here instead of waiting for LRU
+# displacement.
+_close_listeners: "list" = []
+_close_listeners_mu = threading.Lock()
+
+
+def register_close_listener(method) -> None:
+    import weakref
+
+    with _close_listeners_mu:
+        _close_listeners.append(weakref.WeakMethod(method))
+
+
+def unregister_close_listener(method) -> None:
+    with _close_listeners_mu:
+        _close_listeners[:] = [
+            wm for wm in _close_listeners if wm() not in (None, method)
+        ]
+
+
+def _notify_close(frag) -> None:
+    with _close_listeners_mu:
+        listeners = [wm() for wm in _close_listeners]
+        if None in listeners:  # drop collected entries opportunistically
+            _close_listeners[:] = [wm for wm in _close_listeners if wm() is not None]
+    for fn in listeners:
+        if fn is None:
+            continue
+        try:
+            fn(frag)
+        except Exception:  # noqa: BLE001 — listeners must not break close
+            pass
+
 
 def _apply_pending(dev, pending):
     """Fold queued point writes into one device scatter.
@@ -406,6 +443,8 @@ class Fragment:
             # deletes would otherwise serve stale batches until some
             # unrelated write moved the epoch.
             _bump_write_epoch()
+        # Outside the lock: listeners may take their own locks.
+        _notify_close(self)
 
     @property
     def cache_path(self) -> str:
@@ -1839,21 +1878,41 @@ class Fragment:
     # archive backup/restore (reference: fragment.go:1112-1283)
     # ------------------------------------------------------------------
 
+    def _archive_payloads(self) -> list[tuple[str, bytes]]:
+        """Consistent snapshot of the two archive entries, taken under
+        the lock; serialization to tar happens lock-free so a slow
+        consumer never stalls writers."""
+        with self._mu:
+            data = roaring.encode_packed(*self._containers_packed())
+            cache_data = self._encode_cache_ids(self.cache.ids())
+        return [("data", data), ("cache", cache_data)]
+
+    @staticmethod
+    def _write_archive(entries: list[tuple[str, bytes]], w) -> None:
+        tw = tarfile.open(fileobj=w, mode="w|")
+        for name, payload in entries:
+            info = tarfile.TarInfo(name)
+            info.size = len(payload)
+            info.mtime = int(time.time())
+            tw.addfile(info, io.BytesIO(payload))
+        tw.close()
+
     def write_to(self, w) -> None:
         """Stream a tar with "data" (roaring file) and "cache" entries."""
-        with self._mu:
-            tw = tarfile.open(fileobj=w, mode="w|")
-            data = roaring.encode_packed(*self._containers_packed())
-            info = tarfile.TarInfo("data")
-            info.size = len(data)
-            info.mtime = int(time.time())
-            tw.addfile(info, io.BytesIO(data))
-            cache_data = self._encode_cache_ids(self.cache.ids())
-            info = tarfile.TarInfo("cache")
-            info.size = len(cache_data)
-            info.mtime = int(time.time())
-            tw.addfile(info, io.BytesIO(cache_data))
-            tw.close()
+        self._write_archive(self._archive_payloads(), w)
+
+    def tar_chunks(self, chunk_bytes: int = 0) -> Iterable[bytes]:
+        """The archive as a bounded-chunk generator: the tar writer
+        runs against a ChunkPipe on a producer thread, so the HTTP
+        layer pulls constant-size chunks with backpressure instead of
+        materializing the tar (reference: handler.go:1102-1123 +
+        fragment.go:1112-1176 stream WriteTo into the ResponseWriter)."""
+        from pilosa_tpu import stream as stream_mod
+
+        entries = self._archive_payloads()
+        return stream_mod.generate_from_writer(
+            lambda w: self._write_archive(entries, w), chunk_bytes=chunk_bytes
+        )
 
     def read_from(self, r) -> None:
         """Restore from a tar produced by write_to."""

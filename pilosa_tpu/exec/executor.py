@@ -26,8 +26,9 @@ from __future__ import annotations
 
 import threading
 import time
+import queue
 from collections import OrderedDict
-from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import FIRST_COMPLETED, Future, wait
 from dataclasses import dataclass, field, replace
 from datetime import datetime
 
@@ -133,6 +134,76 @@ def merge_counts_by_id(parts):
     return uids, sums
 
 
+class _DaemonPool:
+    """Minimal thread pool with DAEMON workers.
+
+    Stock ThreadPoolExecutor workers are non-daemon and joined at
+    interpreter exit, so one mapper wedged inside a device call (an XLA
+    runtime fault) turns into a process that never exits.  Query
+    fan-out must degrade to a failed query, not a hung shutdown —
+    daemon workers die with the process.  Futures are the ordinary
+    concurrent.futures kind, so wait()/as_completed compose."""
+
+    def __init__(self, max_workers: int):
+        self._max_workers = max_workers
+        self._work: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._threads: list[threading.Thread] = []
+        self._idle = 0
+        self._mu = threading.Lock()
+        self._shutdown = False
+        self._cancel_pending = False
+
+    def submit(self, fn, *args, **kwargs) -> Future:
+        fut: Future = Future()
+        with self._mu:
+            if self._shutdown:
+                raise RuntimeError("cannot submit after shutdown")
+            self._work.put((fut, fn, args, kwargs))
+            # Spawn only when no idle worker can take the item (the
+            # counter is advisory; a race costs one extra thread, never
+            # a lost task).
+            if self._idle == 0 and len(self._threads) < self._max_workers:
+                t = threading.Thread(
+                    target=self._worker, daemon=True, name="exec-pool"
+                )
+                self._threads.append(t)
+                t.start()
+        return fut
+
+    def _worker(self) -> None:
+        while True:
+            with self._mu:
+                self._idle += 1
+            item = self._work.get()
+            with self._mu:
+                self._idle -= 1
+            if item is None:  # retire (shutdown)
+                return
+            fut, fn, args, kwargs = item
+            if self._cancel_pending:
+                fut.cancel()
+                continue
+            if not fut.set_running_or_notify_cancel():
+                continue
+            try:
+                fut.set_result(fn(*args, **kwargs))
+            except BaseException as e:  # noqa: BLE001 — crosses the future
+                fut.set_exception(e)
+
+    def shutdown(self, wait: bool = True, cancel_futures: bool = False) -> None:
+        with self._mu:
+            if self._shutdown:
+                return
+            self._shutdown = True
+            self._cancel_pending = cancel_futures
+            threads = list(self._threads)
+        for _ in threads:
+            self._work.put(None)
+        if wait:
+            for t in threads:
+                t.join()
+
+
 class Executor:
     """Executes PQL queries against a holder, fanning out across a cluster.
 
@@ -154,7 +225,7 @@ class Executor:
         self.cluster = cluster or Cluster(nodes=[Node(host=host)])
         self.client_factory = client_factory
         self.max_writes_per_request = max_writes_per_request
-        self._pool = ThreadPoolExecutor(max_workers=16)
+        self._pool = _DaemonPool(max_workers=16)
         self._zero_rows: dict = {}  # device -> cached all-zero leaf row
         # Assembled leaf-batch LRU (see _cached_batch); executors serve
         # concurrent HTTP request threads, so access is lock-guarded.
@@ -166,9 +237,24 @@ class Executor:
         self._topn_cache: "OrderedDict[tuple, dict]" = OrderedDict()
         # slice->node grouping LRU (see _slices_by_node).
         self._slice_group_cache: "OrderedDict[tuple, dict]" = OrderedDict()
+        # A fragment leaving service (delete/teardown) must release the
+        # TopN prep entries pinning its HBM plane snapshots now, not at
+        # LRU displacement (held weakly — see fragment._close_listeners).
+        fragment_mod.register_close_listener(self._drop_closed_fragment)
 
     def close(self) -> None:
+        fragment_mod.unregister_close_listener(self._drop_closed_fragment)
         self._pool.shutdown(wait=False, cancel_futures=True)
+
+    def _drop_closed_fragment(self, frag) -> None:
+        with self._batch_mu:
+            stale = [
+                k
+                for k, e in self._topn_cache.items()
+                if any(p[0] is frag for p in e.get("parts", ()))
+            ]
+            for k in stale:
+                del self._topn_cache[k]
 
     # ------------------------------------------------------------------
     # entry point (reference: executor.go:65-151)
@@ -1143,6 +1229,13 @@ class Executor:
                         if key in self._topn_cache:
                             self._topn_cache.move_to_end(key)
                     return ent
+                # Version validation failed: the entry can never serve
+                # again (a deleted or rewritten fragment), yet its
+                # SubRefs pin HBM plane snapshots — drop it NOW, before
+                # the rebuild, so a failing build can't resurrect it.
+                with self._batch_mu:
+                    if self._topn_cache.get(key) is ent:
+                        del self._topn_cache[key]
         # Capture validity BEFORE building: a concurrent write during
         # the build leaves the entry conservatively stale.  The vector
         # computed for the failed validation (if any) is reused — it
@@ -1701,9 +1794,15 @@ class Executor:
         return m
 
     def _map_reduce(self, index, slices, c, opt, map_fn, reduce_fn):
-        """Map slices over owning nodes, reduce as responses arrive, and
-        retry a failed node's slices on replicas (reference:
-        executor.go:1149-1243)."""
+        """Map slices over owning nodes, reduce INCREMENTALLY as each
+        response lands, and fail a dead node's slices over to replicas
+        the moment its error arrives (reference: executor.go:1149-1243
+        reduces off a channel the same way).
+
+        A slow or dead node therefore never delays reducing the fast
+        nodes' results: completion order drives the reduce loop
+        (FIRST_COMPLETED waits), and failover work is resubmitted while
+        the healthy nodes' mappers are still in flight."""
         if not opt.remote:
             nodes = list(self.cluster.nodes)
         else:
@@ -1712,43 +1811,54 @@ class Executor:
         if not nodes:
             nodes = [Node(host=self.host)]
 
+        if not slices:
+            # Sliceless execution still runs locally once.
+            resp = self._map_node(Node(host=self.host), [], index, c, opt, map_fn)
+            if resp.error:
+                raise resp.error
+            return reduce_fn(None, resp.result)
+
         result = None
-        pending = [(nodes, slices)]
-        while pending:
-            nodes, want = pending.pop()
-            if not want and not slices:
-                # Sliceless execution still runs locally once.
-                resp = self._map_node(Node(host=self.host), [], index, c, opt, map_fn)
-                if resp.error:
-                    raise resp.error
-                result = reduce_fn(result, resp.result)
-                break
-            m = self._slices_by_node(nodes, index, want)
-            if len(m) == 1:
-                # Single target (the whole single-node case): run the
-                # mapper inline.  A pool hop would add a context switch
-                # per query and cap request concurrency at the pool
-                # size — the caller's own thread is the parallelism.
-                ((node, node_slices),) = m.values()
-                responses = [
-                    self._map_node(node, node_slices, index, c, opt, map_fn)
-                ]
-            else:
-                futures = {
-                    self._pool.submit(
-                        self._map_node, node, node_slices, index, c, opt, map_fn
-                    )
-                    for _, (node, node_slices) in m.items()
-                }
-                responses = [fut.result() for fut in futures]
-            for resp in responses:
+        # future -> node list the future's slices may still fail over to
+        inflight: dict = {}
+
+        def _submit(avail_nodes, want) -> None:
+            m = self._slices_by_node(avail_nodes, index, want)
+            for _, (node, node_slices) in m.items():
+                fut = self._pool.submit(
+                    self._map_node, node, node_slices, index, c, opt, map_fn
+                )
+                inflight[fut] = avail_nodes
+
+        def _failover(resp, avail_nodes) -> None:
+            remaining = [n for n in avail_nodes if n.host != resp.node.host]
+            try:
+                self._slices_by_node(remaining, index, resp.slices)
+            except SliceUnavailableError:
+                raise resp.error
+            _submit(remaining, resp.slices)
+
+        m = self._slices_by_node(nodes, index, slices)
+        if len(m) == 1:
+            # Single target (the whole single-node case): run the
+            # mapper inline.  A pool hop would add a context switch
+            # per query and cap request concurrency at the pool
+            # size — the caller's own thread is the parallelism.
+            ((node, node_slices),) = m.values()
+            resp = self._map_node(node, node_slices, index, c, opt, map_fn)
+            if resp.error is None:
+                return reduce_fn(None, resp.result)
+            _failover(resp, nodes)
+        else:
+            _submit(nodes, slices)
+
+        while inflight:
+            done, _ = wait(list(inflight), return_when=FIRST_COMPLETED)
+            for fut in done:
+                avail_nodes = inflight.pop(fut)
+                resp = fut.result()
                 if resp.error is not None:
-                    remaining = [n for n in nodes if n.host != resp.node.host]
-                    try:
-                        self._slices_by_node(remaining, index, resp.slices)
-                    except SliceUnavailableError:
-                        raise resp.error
-                    pending.append((remaining, resp.slices))
+                    _failover(resp, avail_nodes)
                     continue
                 result = reduce_fn(result, resp.result)
         return result

@@ -14,11 +14,13 @@ import base64
 import http.client
 import io
 import json
+import tempfile
 
 import numpy as np
 import urllib.parse
 from typing import Any
 
+from pilosa_tpu import stream as stream_mod
 from pilosa_tpu.net import codec
 from pilosa_tpu.net import wire_pb2 as wire
 
@@ -42,6 +44,11 @@ class InternalClient:
     def __init__(self, host: str, timeout: float = 30.0):
         self.host = host
         self.timeout = timeout
+        # Streamed-GET open retries (see stream/client.py); mid-stream
+        # failures always propagate.
+        self.stream_retries = 3
+        self.stream_backoff = 0.1
+        self.chunk_bytes = stream_mod.DEFAULT_CHUNK_BYTES
 
     # ------------------------------------------------------------------
     # plumbing
@@ -65,6 +72,75 @@ class InternalClient:
             return resp.status, data
         finally:
             conn.close()
+
+    def _request_chunked(
+        self,
+        method: str,
+        path: str,
+        reader,
+        query: dict[str, Any] | None = None,
+        headers: dict[str, str] | None = None,
+    ) -> tuple[int, bytes]:
+        """Issue a request whose body streams off ``reader`` with
+        chunked transfer encoding — constant-size writes, no payload
+        materialization."""
+        if query:
+            path = path + "?" + urllib.parse.urlencode(query)
+
+        def chunks():
+            while True:
+                data = reader.read(self.chunk_bytes)
+                if not data:
+                    return
+                yield data
+
+        conn = http.client.HTTPConnection(self.host, timeout=self.timeout)
+        try:
+            conn.request(
+                method,
+                path,
+                body=chunks(),
+                headers={**(headers or {}), "Transfer-Encoding": "chunked"},
+                encode_chunked=True,
+            )
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+        finally:
+            conn.close()
+
+    def _open_stream(
+        self,
+        method: str,
+        path: str,
+        query: dict[str, Any] | None = None,
+        headers: dict[str, str] | None = None,
+    ) -> stream_mod.HTTPBodyStream:
+        """Open an error-checked body stream; the connection dial (and
+        the status-line read) retries with backoff, the returned stream
+        does not.  Caller owns close()."""
+        if query:
+            path = path + "?" + urllib.parse.urlencode(query)
+
+        def _open():
+            conn = http.client.HTTPConnection(self.host, timeout=self.timeout)
+            try:
+                conn.request(method, path, headers=headers or {})
+                resp = conn.getresponse()
+            except BaseException:
+                conn.close()
+                raise
+            return stream_mod.HTTPBodyStream(resp, conn, self.chunk_bytes)
+
+        s = stream_mod.open_with_retry(
+            _open, attempts=self.stream_retries, backoff=self.stream_backoff
+        )
+        if s.status >= 400:
+            with s:
+                data = s.read()
+            if s.status == 412:
+                raise PreconditionFailedError(_err_text(data))
+            raise ClientError(s.status, _err_text(data))
+        return s
 
     def _check(self, status: int, data: bytes) -> bytes:
         if status == 412:
@@ -227,64 +303,110 @@ class InternalClient:
             raise ClientError(500, "; ".join(errs))
 
     def export_csv(self, index: str, frame: str, view: str, slice_i: int) -> str:
-        """CSV export with redirect to the owning node on 412
-        (reference: client.go:403-476)."""
+        """Whole-export convenience over :meth:`export_to`."""
+        buf = io.BytesIO()
+        self.export_to(buf, index, frame, view, slice_i)
+        return buf.getvalue().decode()
+
+    def export_to(self, w, index: str, frame: str, view: str, slice_i: int) -> None:
+        """Stream one fragment's CSV into ``w`` in constant-size
+        chunks, redirecting to the owning node on 412 (reference:
+        client.go:403-476).  The redirect decision happens on the
+        status line, before any body moves."""
         try:
-            return self._export_node(index, frame, view, slice_i)
+            src = self._export_stream(index, frame, view, slice_i)
         except PreconditionFailedError:
+            src = None
             for node in self.fragment_nodes(index, slice_i):
                 if node["host"] == self.host:
                     continue
                 try:
-                    return InternalClient(node["host"], self.timeout)._export_node(
-                        index, frame, view, slice_i
-                    )
+                    src = InternalClient(
+                        node["host"], self.timeout
+                    )._export_stream(index, frame, view, slice_i)
+                    break
                 except PreconditionFailedError:
                     continue
-            raise
+            if src is None:
+                raise
+        with src:
+            for chunk in src:
+                w.write(chunk)
 
-    def _export_node(self, index: str, frame: str, view: str, slice_i: int) -> str:
-        status, data = self._request(
+    def _export_stream(
+        self, index: str, frame: str, view: str, slice_i: int
+    ) -> stream_mod.HTTPBodyStream:
+        return self._open_stream(
             "GET",
             "/export",
             query={"index": index, "frame": frame, "view": view, "slice": slice_i},
             headers={"Accept": "text/csv"},
         )
-        return self._check(status, data).decode()
 
     # ------------------------------------------------------------------
     # backup / restore (reference: client.go:478-702)
     # ------------------------------------------------------------------
 
+    def stream_backup_slice(
+        self, index: str, frame: str, view: str, slice_i: int
+    ) -> stream_mod.HTTPBodyStream | None:
+        """Open one fragment's tar archive as a body stream; None if
+        the fragment does not exist (reference: client.go:590-648
+        returns a ReadCloser).  Caller owns close()."""
+        try:
+            return self._open_stream(
+                "GET",
+                "/fragment/data",
+                query={
+                    "index": index,
+                    "frame": frame,
+                    "view": view,
+                    "slice": slice_i,
+                },
+            )
+        except ClientError as e:
+            if e.status == 404:
+                return None
+            raise
+
     def backup_slice(
         self, index: str, frame: str, view: str, slice_i: int
     ) -> bytes | None:
-        """Fetch one fragment's tar archive; None if the fragment does
-        not exist (reference: client.go:590-648)."""
-        status, data = self._request(
-            "GET",
+        """Whole-archive convenience over :meth:`stream_backup_slice`."""
+        src = self.stream_backup_slice(index, frame, view, slice_i)
+        if src is None:
+            return None
+        with src:
+            return src.read()
+
+    def restore_slice_from(
+        self, index: str, frame: str, view: str, slice_i: int, reader
+    ) -> None:
+        """POST one fragment archive off ``reader`` with a chunked body
+        — constant memory on both ends."""
+        status, data = self._request_chunked(
+            "POST",
             "/fragment/data",
+            reader,
             query={"index": index, "frame": frame, "view": view, "slice": slice_i},
         )
-        if status == 404:
-            return None
-        return self._check(status, data)
+        self._check(status, data)
 
     def restore_slice(
         self, index: str, frame: str, view: str, slice_i: int, payload: bytes
     ) -> None:
-        status, data = self._request(
-            "POST",
-            "/fragment/data",
-            query={"index": index, "frame": frame, "view": view, "slice": slice_i},
-            body=payload,
-        )
-        self._check(status, data)
+        self.restore_slice_from(index, frame, view, slice_i, io.BytesIO(payload))
 
     def backup_to(self, w, index: str, frame: str, view: str) -> None:
         """Stream every slice's archive into one tar-of-tars keyed by
         slice id (reference: client.go:478-560 writes a single tar with
-        numbered entries)."""
+        numbered entries).
+
+        Tar entry headers need sizes up front but a chunked response
+        has none, so each slice spools through a SpooledTemporaryFile
+        (disk past a few chunks) — peak MEMORY stays at chunk scale no
+        matter the fragment size (the reference spools the same way,
+        client.go:529-545)."""
         import tarfile
         import time as _time
 
@@ -299,24 +421,34 @@ class InternalClient:
         tw = tarfile.open(fileobj=w, mode="w|")
         max_slices = self.max_slice_by_index(inverse=inverse)
         for slice_i in range(max_slices.get(index, 0) + 1):
-            data = self.backup_slice(index, frame, view, slice_i)
-            if data is None:
+            src = self.stream_backup_slice(index, frame, view, slice_i)
+            if src is None:
                 continue
-            info = tarfile.TarInfo(str(slice_i))
-            info.size = len(data)
-            info.mtime = int(_time.time())
-            tw.addfile(info, io.BytesIO(data))
+            with src, tempfile.SpooledTemporaryFile(
+                max_size=4 * self.chunk_bytes
+            ) as spool:
+                for chunk in src:
+                    spool.write(chunk)
+                size = spool.tell()
+                spool.seek(0)
+                info = tarfile.TarInfo(str(slice_i))
+                info.size = size
+                info.mtime = int(_time.time())
+                tw.addfile(info, spool)
         tw.close()
 
     def restore_from(self, r, index: str, frame: str, view: str) -> None:
-        """reference: client.go:562-588"""
+        """Restore a tar-of-tars, streaming each member straight from
+        the archive reader into a chunked POST (reference:
+        client.go:562-588)."""
         import tarfile
 
         tr = tarfile.open(fileobj=r, mode="r|")
         for member in tr:
             slice_i = int(member.name)
-            payload = tr.extractfile(member).read()
-            self.restore_slice(index, frame, view, slice_i, payload)
+            self.restore_slice_from(
+                index, frame, view, slice_i, tr.extractfile(member)
+            )
         tr.close()
 
     def restore_frame(self, host: str, index: str, frame: str) -> None:

@@ -44,11 +44,12 @@ import traceback
 import urllib.parse
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Callable
+from typing import Any, Callable, Iterable
 
 import numpy as np
 
 from pilosa_tpu import __version__
+from pilosa_tpu import stream as stream_mod
 from pilosa_tpu.core import attr as attr_mod
 from pilosa_tpu.core import timequantum as tq
 from pilosa_tpu.core.bitmap import RowBitmap
@@ -68,9 +69,33 @@ class Request:
     query: dict[str, str] = field(default_factory=dict)
     headers: dict[str, str] = field(default_factory=dict)
     body: bytes = b""
+    # Incremental body source (file-like with read(n)); set by the HTTP
+    # adapter instead of materializing the payload.  Routes marked
+    # @stream_body consume it directly; everyone else gets ``body``
+    # materialized by dispatch.
+    stream: Any = None
 
     def header(self, key: str) -> str:
         return self.headers.get(key.lower(), "")
+
+    def body_reader(self):
+        """The body as a file object — the pending stream when one
+        exists, else the materialized bytes."""
+        return self.stream if self.stream is not None else io.BytesIO(self.body)
+
+    def read_body(self) -> bytes:
+        """Materialize (and cache) the body."""
+        if self.stream is not None:
+            self.body = self.stream.read()
+            self.stream = None
+        return self.body
+
+
+def stream_body(fn):
+    """Mark a route handler as consuming ``Request.stream`` itself —
+    dispatch will not materialize the body first."""
+    fn.streams_body = True
+    return fn
 
 
 @dataclass
@@ -78,6 +103,19 @@ class Response:
     status: int = 200
     body: bytes = b""
     content_type: str = JSON
+    # Iterator body: when set, the HTTP adapter streams it with chunked
+    # transfer encoding and constant-size writes instead of sending
+    # ``body`` with a Content-Length.
+    body_iter: Iterable[bytes] | None = None
+
+    @classmethod
+    def stream(
+        cls, chunks: Iterable[bytes], content_type: str, chunk_bytes: int = 0
+    ) -> "Response":
+        return cls(
+            body_iter=stream_mod.IterBody(chunks, chunk_bytes=chunk_bytes),
+            content_type=content_type,
+        )
 
     @classmethod
     def json(cls, obj: Any, status: int = 200) -> "Response":
@@ -107,6 +145,7 @@ class Handler:
         version: str = __version__,
         logger=None,
         stats=None,
+        stream_chunk_bytes: int = 0,
     ):
         self.holder = holder
         self.executor = executor
@@ -116,6 +155,9 @@ class Handler:
         self.version = version
         self.logger = logger or (lambda msg: print(msg, file=sys.stderr))
         self.stats = stats
+        # Chunk size for streamed (chunked transfer encoding) bodies:
+        # CSV export and fragment archives move in writes of this size.
+        self.stream_chunk_bytes = stream_chunk_bytes or stream_mod.DEFAULT_CHUNK_BYTES
         # Serialized NodeStatus provider (wired by Server): serves the
         # gossip stream fallback's GET /state (the TCP push/pull analog,
         # reference: gossip/gossip.go:191-222).
@@ -170,6 +212,10 @@ class Handler:
             for method, pattern, fn in self._compiled:
                 m = pattern.match(req.path.rstrip("/") or "/")
                 if m and method == req.method:
+                    if req.stream is not None and not getattr(
+                        fn, "streams_body", False
+                    ):
+                        req.read_body()
                     resp = fn(req, **m.groupdict())
                     break
             else:
@@ -458,10 +504,13 @@ class Handler:
             for slice_i in range(ms + 1):
                 view = f.create_view_if_not_exists(view_name)
                 frag = view.create_fragment_if_not_exists(slice_i)
-                data = client.backup_slice(index, frame, view_name, slice_i)
-                if data is None:
+                # Stream the remote archive straight into the fragment
+                # instead of materializing it first.
+                src = client.stream_backup_slice(index, frame, view_name, slice_i)
+                if src is None:
                     continue
-                frag.read_from(io.BytesIO(data))
+                with src:
+                    frag.read_from(src)
         return Response.json({})
 
     # ------------------------------------------------------------------
@@ -653,8 +702,12 @@ class Handler:
         frag = self.holder.fragment(index, frame, view, slice_i)
         if frag is None:
             return Response.error("fragment not found", 404)
-        return Response(
-            body=b"".join(frag.csv_chunks()), content_type="text/csv"
+        # Stream the CSV: csv_chunks is a row-block generator and the
+        # adapter moves constant-size chunks, so the response never
+        # materializes (reference: handler.go:1049-1098 writes rows
+        # straight to the ResponseWriter).
+        return Response.stream(
+            frag.csv_chunks(), "text/csv", chunk_bytes=self.stream_chunk_bytes
         )
 
     # ------------------------------------------------------------------
@@ -686,10 +739,15 @@ class Handler:
         frag, err = self._fragment_from_query(req)
         if err:
             return err
-        buf = io.BytesIO()
-        frag.write_to(buf)
-        return Response(body=buf.getvalue(), content_type="application/octet-stream")
+        # Chunked tar stream (reference: handler.go:1102-1123 hands the
+        # ResponseWriter to Fragment.WriteTo).
+        return Response.stream(
+            frag.tar_chunks(chunk_bytes=self.stream_chunk_bytes),
+            "application/octet-stream",
+            chunk_bytes=self.stream_chunk_bytes,
+        )
 
+    @stream_body
     def handle_post_fragment_data(self, req: Request) -> Response:
         index = req.query.get("index", "")
         frame = req.query.get("frame", "")
@@ -702,7 +760,9 @@ class Handler:
             return Response.error("frame not found", 404)
         vw = f.create_view_if_not_exists(view)
         frag = vw.create_fragment_if_not_exists(int(slice_s))
-        frag.read_from(io.BytesIO(req.body))
+        # The tar reader pulls straight off the request body stream —
+        # a chunked restore applies archive entries as they arrive.
+        frag.read_from(req.body_reader())
         return Response.json({})
 
     def handle_get_fragment_blocks(self, req: Request) -> Response:
@@ -903,7 +963,13 @@ def _dt_from_unix(ts: int):
 def make_http_server(handler: Handler, host: str = "127.0.0.1", port: int = 0):
     """Mount a Handler on a ThreadingHTTPServer; returns the server
     (call .serve_forever() in a thread; .server_address has the bound
-    port when port=0)."""
+    port when port=0).
+
+    Bodies stream in both directions: chunked (or Content-Length)
+    request bodies reach streaming routes as an incremental reader, and
+    a Response.body_iter goes out with chunked transfer encoding in
+    constant-size writes — no large body is ever held whole.
+    """
 
     class _Adapter(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -911,21 +977,59 @@ def make_http_server(handler: Handler, host: str = "127.0.0.1", port: int = 0):
         def _run(self):
             parsed = urllib.parse.urlsplit(self.path)
             query = dict(urllib.parse.parse_qsl(parsed.query))
-            length = int(self.headers.get("Content-Length") or 0)
-            body = self.rfile.read(length) if length else b""
+            te = (self.headers.get("Transfer-Encoding") or "").lower()
+            if "chunked" in te:
+                body_stream = stream_mod.ChunkedBodyReader(self.rfile)
+            else:
+                length = int(self.headers.get("Content-Length") or 0)
+                body_stream = stream_mod.LengthBodyReader(self.rfile, length)
             req = Request(
                 method=self.command,
                 path=parsed.path,
                 query=query,
                 headers={k.lower(): v for k, v in self.headers.items()},
-                body=body,
+                stream=body_stream,
             )
             resp = handler.dispatch(req)
+            # Unread request bytes must leave the socket before the
+            # response for keep-alive framing to survive; a huge
+            # abandoned body drops the connection instead.
+            try:
+                if not body_stream.drain():
+                    self.close_connection = True
+            except (OSError, ValueError):
+                self.close_connection = True
+            if resp.body_iter is not None:
+                self._send_stream(resp)
+            else:
+                self.send_response(resp.status)
+                self.send_header("Content-Type", resp.content_type)
+                self.send_header("Content-Length", str(len(resp.body)))
+                self.end_headers()
+                self.wfile.write(resp.body)
+
+        def _send_stream(self, resp: Response) -> None:
             self.send_response(resp.status)
             self.send_header("Content-Type", resp.content_type)
-            self.send_header("Content-Length", str(len(resp.body)))
+            self.send_header("Transfer-Encoding", "chunked")
             self.end_headers()
-            self.wfile.write(resp.body)
+            try:
+                for chunk in resp.body_iter:
+                    if chunk:
+                        self.wfile.write(stream_mod.encode_chunk(chunk))
+                self.wfile.write(stream_mod.CHUNK_TERMINATOR)
+            except (BrokenPipeError, ConnectionResetError):
+                self.close_connection = True
+            except Exception as e:  # noqa: BLE001 — mid-stream producer error
+                # Headers are gone; all we can do is truncate the
+                # chunked body (no terminator => client sees an error)
+                # and log.
+                handler.logger(f"stream error {self.path}: {e}")
+                self.close_connection = True
+            finally:
+                close = getattr(resp.body_iter, "close", None)
+                if close is not None:
+                    close()
 
         do_GET = do_POST = do_DELETE = do_PATCH = _run
 

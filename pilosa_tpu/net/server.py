@@ -48,6 +48,7 @@ class Server:
         stats=None,
         compilation_cache_dir: str | None = None,
         prewarm: bool = False,
+        stream_chunk_bytes: int = 0,
     ):
         self.data_dir = data_dir
         self.host = host
@@ -62,6 +63,9 @@ class Server:
         self.stats = stats
         self.compilation_cache_dir = compilation_cache_dir
         self.prewarm = prewarm
+        # Chunk size for streamed HTTP bodies (export/backup data
+        # plane); 0 = stream.DEFAULT_CHUNK_BYTES.
+        self.stream_chunk_bytes = stream_chunk_bytes
 
         self.holder = Holder(data_dir)
         self.executor: Executor | None = None
@@ -104,6 +108,15 @@ class Server:
                     else f" (configured {self.compilation_cache_dir})"
                 )
                 self.logger(f"compilation cache: {active}{note}")
+            else:
+                # A configured-but-broken cache dir (unwritable path,
+                # JAX without the knob) must be VISIBLE: every restart
+                # silently pays full recompiles otherwise.
+                self.logger(
+                    "compilation cache DISABLED: could not enable "
+                    f"{self.compilation_cache_dir!r}; queries recompile "
+                    "from scratch on every process start"
+                )
         self.holder.open()
         if self.prewarm:
             warmup.prewarm_async(logger=self.logger)
@@ -133,6 +146,7 @@ class Server:
             version=__version__,
             logger=self.logger,
             stats=self.stats,
+            stream_chunk_bytes=self.stream_chunk_bytes,
         )
         # ONE provider feeds both /state (the stream fallback's pull
         # endpoint, any cluster type) and gossip's piggybacked state —

@@ -23,10 +23,16 @@ from __future__ import annotations
 
 import threading
 
+from pilosa_tpu import stream as stream_mod
 from pilosa_tpu.core.fragment import PairSet
 from pilosa_tpu.core.view import VIEW_STANDARD, is_inverse_view
 from pilosa_tpu.net.client import ClientError, InternalClient
 from pilosa_tpu.ops.bitplane import SLICE_WIDTH
+
+# Repair writes pushed per request: far enough under the server's
+# max-writes-per-request default (5000) to leave headroom, and keeps a
+# badly diverged block from assembling one huge PQL string in memory.
+REPAIR_BATCH = 1000
 
 
 class HolderSyncer:
@@ -222,18 +228,25 @@ class FragmentSyncer:
                 # Standard diffs push back as generated PQL, which fans
                 # out through the remote's whole write path (all views,
                 # caches, op-log) — reference: fragment.go:1465-1492.
-                lines = []
-                for r, c in zip(set_ps.row_ids, set_ps.column_ids):
-                    lines.append(
-                        f'SetBit(frame="{f.frame}", rowID={r}, columnID={base + c})'
-                    )
-                for r, c in zip(clear_ps.row_ids, clear_ps.column_ids):
-                    lines.append(
-                        f'ClearBit(frame="{f.frame}", rowID={r}, columnID={base + c})'
-                    )
-                self.client_factory(host).execute_query(
-                    f.index, "\n".join(lines), remote=False
-                )
+                # Batched so a badly diverged block never assembles one
+                # huge request (or trips max-writes-per-request).
+                def _lines():
+                    for r, c in zip(set_ps.row_ids, set_ps.column_ids):
+                        yield (
+                            f'SetBit(frame="{f.frame}", rowID={r},'
+                            f" columnID={base + c})"
+                        )
+                    for r, c in zip(clear_ps.row_ids, clear_ps.column_ids):
+                        yield (
+                            f'ClearBit(frame="{f.frame}", rowID={r},'
+                            f" columnID={base + c})"
+                        )
+
+                client = self.client_factory(host)
+                for batch in stream_mod.batched(_lines(), REPAIR_BATCH):
+                    if self.is_closing():
+                        return
+                    client.execute_query(f.index, "\n".join(batch), remote=False)
             else:
                 # Derived views repair via the view-scoped raw write
                 # path: PQL cannot target an individual inverse/time
