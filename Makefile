@@ -5,7 +5,7 @@
 
 PYTHON ?= python
 
-.PHONY: default test bench install build docker clean generate
+.PHONY: default test lint bench install build docker clean generate
 
 default: build test
 
@@ -13,6 +13,11 @@ default: build test
 # forces the backend; never touches a real TPU).
 test:
 	$(PYTHON) -m pytest tests/ -q
+
+# Fail on undefined names / unused imports across the package (ruff "F"
+# rules, configured in pyproject.toml).
+lint:
+	$(PYTHON) -m ruff check pilosa_tpu/
 
 # Compile the C++ codec and verify the wire module imports.
 build:

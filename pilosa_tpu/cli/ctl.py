@@ -72,6 +72,7 @@ def build_server(cfg: config_mod.Config):
     for host in cfg.cluster.hosts:
         cluster.add_node(host)
 
+    stats = new_stats_client(cfg.metrics.service, cfg.metrics.host)
     broadcaster = bc.NopBroadcaster()
     receiver = bc.NopBroadcastReceiver()
     if cfg.cluster.type == "http":
@@ -86,6 +87,7 @@ def build_server(cfg: config_mod.Config):
             host=cfg.host,
             seed=cfg.cluster.gossip_seed,
             logger=logger,
+            stats=stats,
         )
         broadcaster = nodeset
         receiver = nodeset
@@ -101,10 +103,12 @@ def build_server(cfg: config_mod.Config):
         polling_interval=cfg.cluster.polling_interval,
         max_writes_per_request=cfg.max_writes_per_request,
         logger=logger,
-        stats=new_stats_client(cfg.metrics.service, cfg.metrics.host),
+        stats=stats,
         compilation_cache_dir=_resolve_cache_dir(cfg),
         prewarm=cfg.tpu.prewarm,
         stream_chunk_bytes=cfg.net.stream_chunk_bytes,
+        slow_query_ms=cfg.obs.slow_query_ms,
+        trace_ring=cfg.obs.trace_ring,
     )
 
 

@@ -101,6 +101,7 @@ class GossipNodeSet:
         state_merger=None,
         state_fetcher=None,
         logger=None,
+        stats=None,
     ):
         self.host = host  # the node's HTTP host:port (cluster identity)
         if bind:
@@ -126,6 +127,11 @@ class GossipNodeSet:
         # tool — injectable for tests.
         self.state_fetcher = state_fetcher or self._http_state_fetch
         self.logger = logger or (lambda m: None)
+        # Datagram traffic counters (gossip.sent/recv + bytes); Nop
+        # unless the server wires a real stats client.
+        from pilosa_tpu.obs.stats import NopStatsClient
+
+        self.stats = stats or NopStatsClient()
 
         self._handler = None  # BroadcastHandler (the server)
         self._sock: socket.socket | None = None
@@ -355,7 +361,10 @@ class GossipNodeSet:
 
     def _send(self, addr, obj: dict) -> None:
         if self._sock is not None:
-            self._sock.sendto(json.dumps(obj).encode(), tuple(addr))
+            data = json.dumps(obj).encode()
+            self._sock.sendto(data, tuple(addr))
+            self.stats.count("gossip.sent")
+            self.stats.count("gossip.sentBytes", len(data))
 
     def _send_logged(self, addr, obj: dict) -> None:
         """Best-effort send: failures are LOGGED, never silently dropped
@@ -392,6 +401,8 @@ class GossipNodeSet:
                 continue
             except OSError:
                 return
+            self.stats.count("gossip.recv")
+            self.stats.count("gossip.recvBytes", len(data))
             try:
                 obj = json.loads(data)
             except json.JSONDecodeError:

@@ -23,6 +23,8 @@ from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Iterable, Protocol
 
+from pilosa_tpu.obs.stats import NopStatsClient
+
 # reference: cache.go:29-32
 DEFAULT_CACHE_SIZE = 50000
 THRESHOLD_FACTOR = 1.1
@@ -76,20 +78,25 @@ class LRUCache:
     def __init__(self, max_entries: int = DEFAULT_CACHE_SIZE):
         self.max_entries = max_entries or DEFAULT_CACHE_SIZE
         self._od: OrderedDict[int, int] = OrderedDict()
+        # Re-tagged by the owning fragment (index:/frame:/view:/slice:).
+        self.stats = NopStatsClient()
 
     def add(self, row_id: int, n: int) -> None:
         self._od[row_id] = n
         self._od.move_to_end(row_id)
         while len(self._od) > self.max_entries:
             self._od.popitem(last=False)
+            self.stats.count("cacheEvict")
 
     bulk_add = add
 
     def get(self, row_id: int) -> int:
-        n = self._od.get(row_id, 0)
         if row_id in self._od:
             self._od.move_to_end(row_id)
-        return n
+            self.stats.count("cacheHit")
+            return self._od[row_id]
+        self.stats.count("cacheMiss")
+        return 0
 
     def len(self) -> int:
         return len(self._od)
@@ -138,6 +145,8 @@ class RankCache:
         self._updated_at = 0.0
         self._stale = True
         self.threshold_value = 0
+        # Re-tagged by the owning fragment (index:/frame:/view:/slice:).
+        self.stats = NopStatsClient()
 
     def add(self, row_id: int, n: int) -> None:
         # Reject values below the established floor unless already present
@@ -159,7 +168,12 @@ class RankCache:
     bulk_add = add
 
     def get(self, row_id: int) -> int:
-        return self.entries.get(row_id, 0)
+        n = self.entries.get(row_id)
+        if n is None:
+            self.stats.count("cacheMiss")
+            return 0
+        self.stats.count("cacheHit")
+        return n
 
     def len(self) -> int:
         return len(self.entries)
@@ -212,10 +226,14 @@ class RankCache:
         self._stale = False
 
     def _prune(self) -> None:
+        dropped = len(self.entries)
         keep = sort_pairs(Pair(i, c) for i, c in self.entries.items())[
             : self.max_entries
         ]
         self.entries = {p.id: p.count for p in keep}
+        dropped -= len(self.entries)
+        if dropped > 0:
+            self.stats.count("cacheEvict", dropped)
         if len(keep) == self.max_entries and keep:
             self.threshold_value = keep[-1].count
         self._stale = True

@@ -1941,6 +1941,7 @@ class Fragment:
                     if ids is None:
                         continue
                     self.cache = cache_mod.new_cache(self.cache_type, self.cache_size)
+                    self.cache.stats = self.stats
                     for row_id in ids:
                         if isinstance(row_id, int) and (
                             row_id in self._slot_of or row_id in self._sparse

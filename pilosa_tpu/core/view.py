@@ -95,6 +95,7 @@ class View:
         )
         frag.row_attr_store = self.row_attr_store
         frag.stats = self.stats.with_tags(f"slice:{slice_i}")
+        frag.cache.stats = frag.stats  # hit/miss/evict counters
         frag.logger = self.logger
         return frag
 

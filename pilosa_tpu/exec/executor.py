@@ -24,6 +24,7 @@ TPU-native execution differs in structure, not results:
 
 from __future__ import annotations
 
+import contextvars
 import threading
 import time
 import queue
@@ -46,6 +47,8 @@ from pilosa_tpu.core import fragment as fragment_mod
 from pilosa_tpu.core.fragment import TopOptions
 from pilosa_tpu.core.view import VIEW_INVERSE, VIEW_STANDARD
 from pilosa_tpu.exec import plan
+from pilosa_tpu.exec import warmup
+from pilosa_tpu.obs import trace
 from pilosa_tpu.ops import bitplane as bp
 from pilosa_tpu.pql.parser import Call, Query
 
@@ -155,10 +158,14 @@ class _DaemonPool:
 
     def submit(self, fn, *args, **kwargs) -> Future:
         fut: Future = Future()
+        # Carry the submitter's contextvars into the worker so trace
+        # spans started in a mapper attach to the submitting request's
+        # trace (obs/trace.py keeps the current span in a ContextVar).
+        ctx = contextvars.copy_context()
         with self._mu:
             if self._shutdown:
                 raise RuntimeError("cannot submit after shutdown")
-            self._work.put((fut, fn, args, kwargs))
+            self._work.put((fut, ctx, fn, args, kwargs))
             # Spawn only when no idle worker can take the item (the
             # counter is advisory; a race costs one extra thread, never
             # a lost task).
@@ -179,14 +186,14 @@ class _DaemonPool:
                 self._idle -= 1
             if item is None:  # retire (shutdown)
                 return
-            fut, fn, args, kwargs = item
+            fut, ctx, fn, args, kwargs = item
             if self._cancel_pending:
                 fut.cancel()
                 continue
             if not fut.set_running_or_notify_cancel():
                 continue
             try:
-                fut.set_result(fn(*args, **kwargs))
+                fut.set_result(ctx.run(fn, *args, **kwargs))
             except BaseException as e:  # noqa: BLE001 — crosses the future
                 fut.set_exception(e)
 
@@ -219,12 +226,18 @@ class Executor:
         cluster: Cluster | None = None,
         client_factory=None,
         max_writes_per_request: int = DEFAULT_MAX_WRITES_PER_REQUEST,
+        tracer=None,
     ):
         self.holder = holder
         self.host = host
         self.cluster = cluster or Cluster(nodes=[Node(host=host)])
         self.client_factory = client_factory
         self.max_writes_per_request = max_writes_per_request
+        self.tracer = tracer or trace.NOP_TRACER
+        # (expr, reduce, batch shape) programs this executor has already
+        # dispatched — distinguishes compile-bearing first calls from
+        # pure execution in the device span annotations.
+        self._seen_programs: set = set()
         self._pool = _DaemonPool(max_workers=16)
         self._zero_rows: dict = {}  # device -> cached all-zero leaf row
         # Assembled leaf-batch LRU (see _cached_batch); executors serve
@@ -307,7 +320,10 @@ class Executor:
                     raise FrameNotFoundError()
                 if call.is_inverse(f.row_label, column_label):
                     call_slices = inverse_slices
-            results.append(self._execute_call(index, call, call_slices, opt))
+            with self.tracer.span(f"call.{call.name}", index=index):
+                results.append(
+                    self._execute_call(index, call, call_slices, opt)
+                )
         return results
 
     # ------------------------------------------------------------------
@@ -537,6 +553,13 @@ class Executor:
     _BATCH_CACHE_CAP = 4
 
     def _cached_batch(self, index: str, c: Call, slices: list[int]):
+        """Traced wrapper over :meth:`_cached_batch_build` — the "plan"
+        stage of a query trace (tree decomposition + leaf batch
+        assembly), annotated with whether the batch cache served it."""
+        with self.tracer.span("plan", slices=len(slices)) as sp:
+            return self._cached_batch_build(index, c, slices, sp)
+
+    def _cached_batch_build(self, index: str, c: Call, slices: list[int], sp):
         """The assembled device batch for a bitmap call tree over
         ``slices``, CACHED across queries.
 
@@ -567,8 +590,10 @@ class Executor:
                     with self._batch_mu:
                         if key in self._batch_cache:
                             self._batch_cache.move_to_end(key)
+                    sp.annotate(batch_cache="hit")
                     return ent
 
+        sp.annotate(batch_cache="miss")
         # Capture validity BEFORE building: a concurrent write during
         # assembly leaves the entry conservatively stale.  The same
         # sweep counts mirror-less fragments for the cold-path choice.
@@ -782,6 +807,24 @@ class Executor:
         views = list(tq.views_by_time_range(view_name, start, end, quantum))
         return frame, str(quantum), views
 
+    def _device_span(self, ent: dict, reduce: str):
+        """Span for one fused device program dispatch+fetch, annotated
+        with compile-vs-execute visibility: ``warm`` is whether this
+        executor already dispatched the same (tree shape, reduce, batch
+        shape) program — a cold call bears XLA compilation unless the
+        persistent compile cache (exec/warmup.py) serves it, which
+        ``persistent_cache`` records."""
+        shape = None if ent["batch"] is None else tuple(ent["batch"].shape)
+        key = (ent["expr"], reduce, shape)
+        warm = key in self._seen_programs
+        self._seen_programs.add(key)
+        return self.tracer.span(
+            "exec.device",
+            reduce=reduce,
+            warm=warm,
+            persistent_cache=bool(warmup.enabled_cache_dir()),
+        )
+
     def _eval_tree_slices(
         self, index: str, c: Call, slices: list[int], reduce: str
     ) -> dict[int, object]:
@@ -800,21 +843,22 @@ class Executor:
         if ent["batch"] is None:
             return out
 
-        if ent["mesh"] is not None:
-            # plain-XLA formulation: partitions cleanly under SPMD
-            res = jax.device_get(
-                plan.compiled_batched(ent["expr"], reduce)(
-                    ent["batch"]
+        with self._device_span(ent, reduce):
+            if ent["mesh"] is not None:
+                # plain-XLA formulation: partitions cleanly under SPMD
+                res = jax.device_get(
+                    plan.compiled_batched(ent["expr"], reduce)(
+                        ent["batch"]
+                    )
                 )
-            )
-        else:
-            res = plan.compiled_batched(ent["expr"], reduce)(ent["batch"])
-            if reduce == "row":
-                # Every consumer of row results materializes them on the
-                # host (client responses, merges), so fetch the WHOLE
-                # batch in ONE transfer — per-slice lazy slices would
-                # each pay a device round trip when coerced.
-                res = np.asarray(res)
+            else:
+                res = plan.compiled_batched(ent["expr"], reduce)(ent["batch"])
+                if reduce == "row":
+                    # Every consumer of row results materializes them on
+                    # the host (client responses, merges), so fetch the
+                    # WHOLE batch in ONE transfer — per-slice lazy slices
+                    # would each pay a device round trip when coerced.
+                    res = np.asarray(res)
         out.update({s: res[p] for s, p in ent["pos_of"].items()})
         return out
 
@@ -849,30 +893,31 @@ class Executor:
             return 0
         kept_slices = ent["kept"]
 
-        if ent["mesh"] is not None:
-            # Zero pad slices contribute nothing, so the budget is on the
-            # real slice count, not the padded batch size.
-            if len(kept_slices) <= plan.MAX_ONDEVICE_COUNT_PARTIALS:
-                limbs = plan.compiled_total_count(ent["expr"], ent["mesh"])(
-                    ent["batch"]
+        with self._device_span(ent, "count"):
+            if ent["mesh"] is not None:
+                # Zero pad slices contribute nothing, so the budget is on
+                # the real slice count, not the padded batch size.
+                if len(kept_slices) <= plan.MAX_ONDEVICE_COUNT_PARTIALS:
+                    limbs = plan.compiled_total_count(ent["expr"], ent["mesh"])(
+                        ent["batch"]
+                    )
+                    return plan.recombine_count_limbs(jax.device_get(limbs))
+                res = jax.device_get(
+                    plan.compiled_batched(ent["expr"], "count")(
+                        ent["batch"]
+                    )
                 )
-                return plan.recombine_count_limbs(jax.device_get(limbs))
-            res = jax.device_get(
-                plan.compiled_batched(ent["expr"], "count")(
-                    ent["batch"]
-                )
-            )
-            return int(sum(int(res[p]) for p in ent["pos_of"].values()))
+                return int(sum(int(res[p]) for p in ent["pos_of"].values()))
 
-        # Single device: same limb total-count program, no collective —
-        # 8 bytes home instead of a per-slice partial vector (zero pad
-        # slices contribute nothing).
-        if len(kept_slices) <= plan.MAX_ONDEVICE_COUNT_PARTIALS:
-            limbs = plan.compiled_total_count(ent["expr"])(ent["batch"])
-            return plan.recombine_count_limbs(jax.device_get(limbs))
-        res = plan.compiled_batched(ent["expr"], "count")(ent["batch"])
-        res = jax.device_get(res)
-        return sum(int(res[p]) for p in ent["pos_of"].values())
+            # Single device: same limb total-count program, no collective
+            # — 8 bytes home instead of a per-slice partial vector (zero
+            # pad slices contribute nothing).
+            if len(kept_slices) <= plan.MAX_ONDEVICE_COUNT_PARTIALS:
+                limbs = plan.compiled_total_count(ent["expr"])(ent["batch"])
+                return plan.recombine_count_limbs(jax.device_get(limbs))
+            res = plan.compiled_batched(ent["expr"], "count")(ent["batch"])
+            res = jax.device_get(res)
+            return sum(int(res[p]) for p in ent["pos_of"].values())
 
     def _assemble_mesh_batch(self, stacks, kept_slices, mesh):
         """Group slices by home device (slice mod n_devices, matching
@@ -1867,7 +1912,10 @@ class Executor:
         resp = _MapResponse(node=node, slices=node_slices)
         try:
             if node.host == self.host:
-                resp.result = map_fn(node_slices)
+                with self.tracer.span(
+                    "map.local", node=node.host, slices=len(node_slices)
+                ):
+                    resp.result = map_fn(node_slices)
             else:
                 results = self._exec_remote(
                     node, index, Query(calls=[c]), node_slices, opt
@@ -1878,11 +1926,28 @@ class Executor:
         return resp
 
     def _exec_remote(self, node, index, q, slices, opt) -> list:
-        """Forward a query to a peer (reference: executor.go:1045-1129)."""
+        """Forward a query to a peer (reference: executor.go:1045-1129).
+
+        The rpc span's ids travel as X-Trace-Id/X-Span-Id headers; the
+        remote handler continues the trace under them and ships its
+        spans back, which the client absorbs into this node's trace."""
         if self.client_factory is None:
             raise ExecutorError(f"no client for remote node {node.host}")
         client = self.client_factory(node)
-        return client.execute_query(index, str(q), slices, remote=True)
+        with self.tracer.span(
+            "rpc.execute", node=node.host, slices=len(slices) if slices else 0
+        ) as sp:
+            headers = self.tracer.remote_headers(sp)
+            if headers and getattr(client, "supports_trace", False):
+                return client.execute_query(
+                    index,
+                    str(q),
+                    slices,
+                    remote=True,
+                    trace_headers=headers,
+                    tracer=self.tracer,
+                )
+            return client.execute_query(index, str(q), slices, remote=True)
 
 
 # ---------------------------------------------------------------------------

@@ -41,6 +41,10 @@ class PreconditionFailedError(ClientError):
 class InternalClient:
     """HTTP client pinned to one host ("host:port")."""
 
+    # The executor checks this before passing trace kwargs, so injected
+    # test doubles with the bare execute_query signature keep working.
+    supports_trace = True
+
     def __init__(self, host: str, timeout: float = 30.0):
         self.host = host
         self.timeout = timeout
@@ -62,6 +66,21 @@ class InternalClient:
         body: bytes = b"",
         headers: dict[str, str] | None = None,
     ) -> tuple[int, bytes]:
+        status, data, _ = self._request_meta(
+            method, path, query=query, body=body, headers=headers
+        )
+        return status, data
+
+    def _request_meta(
+        self,
+        method: str,
+        path: str,
+        query: dict[str, Any] | None = None,
+        body: bytes = b"",
+        headers: dict[str, str] | None = None,
+    ) -> tuple[int, bytes, dict[str, str]]:
+        """Like :meth:`_request` but also returns the response headers
+        (lower-cased keys) — the trace span export rides one."""
         if query:
             path = path + "?" + urllib.parse.urlencode(query)
         conn = http.client.HTTPConnection(self.host, timeout=self.timeout)
@@ -69,7 +88,8 @@ class InternalClient:
             conn.request(method, path, body=body, headers=headers or {})
             resp = conn.getresponse()
             data = resp.read()
-            return resp.status, data
+            resp_headers = {k.lower(): v for k, v in resp.getheaders()}
+            return resp.status, data, resp_headers
         finally:
             conn.close()
 
@@ -160,19 +180,31 @@ class InternalClient:
         slices: list[int] | None = None,
         remote: bool = False,
         column_attrs: bool = False,
+        trace_headers: dict[str, str] | None = None,
+        tracer=None,
     ) -> list:
+        """``trace_headers`` (X-Trace-Id/X-Span-Id) continue the caller's
+        trace on the peer; the peer's spans come back in an
+        X-Trace-Spans response header and are absorbed into ``tracer``."""
         pb = wire.QueryRequest(
             Query=query,
             Slices=slices or [],
             Remote=remote,
             ColumnAttrs=column_attrs,
         )
-        status, data = self._request(
+        headers = {"Content-Type": PROTOBUF, "Accept": PROTOBUF}
+        if trace_headers:
+            headers.update(trace_headers)
+        status, data, resp_headers = self._request_meta(
             "POST",
             f"/index/{index}/query",
             body=pb.SerializeToString(),
-            headers={"Content-Type": PROTOBUF, "Accept": PROTOBUF},
+            headers=headers,
         )
+        if tracer is not None:
+            payload = resp_headers.get("x-trace-spans")
+            if payload:
+                tracer.absorb(payload)
         resp = wire.QueryResponse()
         resp.ParseFromString(self._check(status, data))
         if resp.Err:

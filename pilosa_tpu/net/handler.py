@@ -56,6 +56,7 @@ from pilosa_tpu.core.bitmap import RowBitmap
 from pilosa_tpu.exec.executor import ExecOptions, TooManyWritesError
 from pilosa_tpu.net import codec
 from pilosa_tpu.net import wire_pb2 as wire
+from pilosa_tpu.obs import prom, trace
 from pilosa_tpu.pql.parser import parse_string
 
 PROTOBUF = "application/x-protobuf"
@@ -107,6 +108,8 @@ class Response:
     # transfer encoding and constant-size writes instead of sending
     # ``body`` with a Content-Length.
     body_iter: Iterable[bytes] | None = None
+    # Extra response headers (trace span export, etc.).
+    headers: dict[str, str] = field(default_factory=dict)
 
     @classmethod
     def stream(
@@ -146,6 +149,8 @@ class Handler:
         logger=None,
         stats=None,
         stream_chunk_bytes: int = 0,
+        tracer=None,
+        slow_query_ms: float = 0.0,
     ):
         self.holder = holder
         self.executor = executor
@@ -155,6 +160,13 @@ class Handler:
         self.version = version
         self.logger = logger or (lambda msg: print(msg, file=sys.stderr))
         self.stats = stats
+        # Query-path tracing (obs/trace.py): always-on when a Tracer is
+        # wired (Server does); NOP otherwise.
+        self.tracer = tracer or trace.NOP_TRACER
+        # Structured slow-query log threshold in ms ([obs] slow-query-ms);
+        # 0 disables.  Distinct from cluster.long-query-time (the
+        # reference-parity plain-text log below).
+        self.slow_query_ms = slow_query_ms
         # Chunk size for streamed (chunked transfer encoding) bodies:
         # CSV export and fragment archives move in writes of this size.
         self.stream_chunk_bytes = stream_chunk_bytes or stream_mod.DEFAULT_CHUNK_BYTES
@@ -195,6 +207,8 @@ class Handler:
             ("POST", r"/fragment/import-view", self.handle_post_import_view),
             ("GET", r"/fragment/block/data", self.handle_get_fragment_block_data),
             ("GET", r"/debug/vars", self.handle_get_vars),
+            ("GET", r"/debug/traces", self.handle_get_traces),
+            ("GET", r"/metrics", self.handle_get_metrics),
             ("GET", r"/debug/pprof(?P<rest>/.*)?", self.handle_get_pprof),
         ]
         self._compiled = [
@@ -518,17 +532,77 @@ class Handler:
     # ------------------------------------------------------------------
 
     def handle_post_query(self, req: Request, index: str) -> Response:
+        """Traced query entry: the root span opens here (continuing a
+        propagated trace on the remote leg of a fan-out), the body runs
+        under it, and the finalized trace feeds the ring buffer, the
+        remote span export header, and the structured slow-query log."""
+        in_trace = req.header(trace.TRACE_HEADER)
+        root = self.tracer.start_trace(
+            "query",
+            trace_id=in_trace or None,
+            parent_span_id=req.header(trace.SPAN_HEADER) or None,
+            index=index,
+            node=getattr(self.executor, "host", ""),
+        )
+        token = root.activate()
+        try:
+            resp = self._handle_post_query(req, index, root)
+        finally:
+            root.deactivate(token)
+            record = self.tracer.finish_root(root)
+        if record is not None:
+            if in_trace:
+                # Remote leg: ship this node's spans back to the
+                # coordinator, which absorbs them into the one trace.
+                resp.headers[trace.SPANS_HEADER] = self.tracer.export_payload(
+                    record
+                )
+            elif (
+                self.slow_query_ms > 0
+                and record["duration_ms"] >= self.slow_query_ms
+            ):
+                try:
+                    self._log_slow_query(index, root, record)
+                except Exception:  # noqa: BLE001 — logging never drops a response
+                    pass
+        return resp
+
+    def _log_slow_query(self, index: str, root, record: dict) -> None:
+        """Exactly one structured line per slow coordinator query."""
+        self.logger(
+            "slow query "
+            + json.dumps(
+                {
+                    "ms": record["duration_ms"],
+                    "index": index,
+                    "query": root.tags.get("query", ""),
+                    "slices": root.tags.get("slices", "all"),
+                    "trace_id": record["trace_id"],
+                    "stages": trace.stage_breakdown(record),
+                },
+                sort_keys=True,
+            )
+        )
+
+    def _handle_post_query(self, req: Request, index: str, root) -> Response:
         try:
             qreq = self._read_query_request(req)
         except ValueError as e:
             return self._query_error(req, str(e), 400)
+        root.annotate(
+            query=qreq["query"][:512],
+            slices=qreq["slices"] if qreq["slices"] is not None else "all",
+            remote=qreq["remote"],
+        )
         try:
-            q = parse_string(qreq["query"])
+            with self.tracer.span("parse"):
+                q = parse_string(qreq["query"])
         except Exception as e:  # parser error
             return self._query_error(req, str(e), 400)
         opt = ExecOptions(remote=qreq["remote"])
         try:
-            results = self.executor.execute(index, q, qreq["slices"], opt)
+            with self.tracer.span("execute"):
+                results = self.executor.execute(index, q, qreq["slices"], opt)
         except TooManyWritesError as e:
             return self._query_error(req, str(e), 413)
         except Exception as e:  # noqa: BLE001 — executor boundary
@@ -806,6 +880,33 @@ class Handler:
             payload["stats"] = self.stats.snapshot()
         return Response.json(payload)
 
+    def handle_get_traces(self, req: Request) -> Response:
+        """The tracer's retained query traces as JSON; ``?min_ms=``
+        filters on trace (root span) duration."""
+        try:
+            min_ms = float(req.query.get("min_ms", "0"))
+        except ValueError:
+            return Response.error("invalid min_ms", 400)
+        return Response.json({"traces": self.tracer.traces(min_ms=min_ms)})
+
+    def handle_get_metrics(self, req: Request) -> Response:
+        """Prometheus text exposition of the Expvar store plus process
+        gauges (obs/prom.py)."""
+        snap: dict = {}
+        if self.stats is not None and hasattr(self.stats, "snapshot"):
+            try:
+                snap = self.stats.snapshot()
+            except Exception:  # noqa: BLE001 — stats must not fail the scrape
+                snap = {}
+        body = prom.render(
+            snap,
+            extra_gauges={
+                "uptime_seconds": time.time() - self._start_time,
+                "threads": threading.active_count(),
+            },
+        )
+        return Response(body=body.encode(), content_type=prom.CONTENT_TYPE)
+
     def handle_get_pprof(self, req: Request, rest: str | None = None) -> Response:
         """Profiling endpoints — the Python analog of the reference's
         net/http/pprof mount (reference: handler.go:111-112):
@@ -999,24 +1100,43 @@ def make_http_server(handler: Handler, host: str = "127.0.0.1", port: int = 0):
                     self.close_connection = True
             except (OSError, ValueError):
                 self.close_connection = True
+            # Streamed request bodies count toward the bytes-moved
+            # surface (reads already happened inside the route).
+            received = getattr(body_stream, "bytes_read", 0)
+            if received:
+                self._count_stream_bytes("stream.bytesReceived", received)
             if resp.body_iter is not None:
                 self._send_stream(resp)
             else:
                 self.send_response(resp.status)
                 self.send_header("Content-Type", resp.content_type)
+                for k, v in resp.headers.items():
+                    self.send_header(k, v)
                 self.send_header("Content-Length", str(len(resp.body)))
                 self.end_headers()
                 self.wfile.write(resp.body)
 
+        def _count_stream_bytes(self, name: str, n: int) -> None:
+            if handler.stats is None or n <= 0:
+                return
+            try:
+                handler.stats.count(name, n)
+            except Exception:  # noqa: BLE001 — stats never break transport
+                pass
+
         def _send_stream(self, resp: Response) -> None:
             self.send_response(resp.status)
             self.send_header("Content-Type", resp.content_type)
+            for k, v in resp.headers.items():
+                self.send_header(k, v)
             self.send_header("Transfer-Encoding", "chunked")
             self.end_headers()
+            sent = 0
             try:
                 for chunk in resp.body_iter:
                     if chunk:
                         self.wfile.write(stream_mod.encode_chunk(chunk))
+                        sent += len(chunk)
                 self.wfile.write(stream_mod.CHUNK_TERMINATOR)
             except (BrokenPipeError, ConnectionResetError):
                 self.close_connection = True
@@ -1027,6 +1147,7 @@ def make_http_server(handler: Handler, host: str = "127.0.0.1", port: int = 0):
                 handler.logger(f"stream error {self.path}: {e}")
                 self.close_connection = True
             finally:
+                self._count_stream_bytes("stream.bytesSent", sent)
                 close = getattr(resp.body_iter, "close", None)
                 if close is not None:
                     close()

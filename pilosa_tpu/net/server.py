@@ -22,6 +22,7 @@ from pilosa_tpu.exec import warmup
 from pilosa_tpu.net import wire_pb2 as wire
 from pilosa_tpu.net.client import InternalClient, client_factory
 from pilosa_tpu.net.handler import Handler, make_http_server
+from pilosa_tpu.obs.trace import Tracer
 
 # reference: server.go:38-40
 DEFAULT_ANTI_ENTROPY_INTERVAL = 600.0
@@ -49,6 +50,8 @@ class Server:
         compilation_cache_dir: str | None = None,
         prewarm: bool = False,
         stream_chunk_bytes: int = 0,
+        slow_query_ms: float = 0.0,
+        trace_ring: int = 64,
     ):
         self.data_dir = data_dir
         self.host = host
@@ -66,6 +69,12 @@ class Server:
         # Chunk size for streamed HTTP bodies (export/backup data
         # plane); 0 = stream.DEFAULT_CHUNK_BYTES.
         self.stream_chunk_bytes = stream_chunk_bytes
+        # Always-on query tracing (Dapper model): every query gets a
+        # trace; the last trace_ring traces are retained and served at
+        # GET /debug/traces.  slow_query_ms > 0 additionally emits one
+        # structured slow-query log line per over-threshold query.
+        self.tracer = Tracer(capacity=trace_ring)
+        self.slow_query_ms = slow_query_ms
 
         self.holder = Holder(data_dir)
         self.executor: Executor | None = None
@@ -147,6 +156,8 @@ class Server:
             logger=self.logger,
             stats=self.stats,
             stream_chunk_bytes=self.stream_chunk_bytes,
+            tracer=self.tracer,
+            slow_query_ms=self.slow_query_ms,
         )
         # ONE provider feeds both /state (the stream fallback's pull
         # endpoint, any cluster type) and gossip's piggybacked state —
@@ -194,6 +205,7 @@ class Server:
             host=self.host,
             cluster=self.cluster,
             client_factory=client_factory,
+            tracer=self.tracer,
             **kwargs,
         )
         self.handler.executor = self.executor
@@ -229,6 +241,12 @@ class Server:
         if self.executor is not None:
             self.executor.close()
         self.holder.close()
+        # Release stats transports (the StatsD UDP socket) last: the
+        # close path above may still observe.
+        if self.stats is not None:
+            close = getattr(self.stats, "close", None)
+            if close is not None:
+                close()
 
     def __enter__(self):
         self.open()

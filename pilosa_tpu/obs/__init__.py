@@ -1,7 +1,10 @@
-"""Observability: stats clients, hierarchical tags, latency histograms.
+"""Observability: stats clients, hierarchical tags, latency histograms,
+query-path distributed tracing, Prometheus exposition.
 
 reference: stats.go (StatsClient interface + nop/expvar/multi impls),
-statsd/statsd.go (DataDog dogstatsd client).
+statsd/statsd.go (DataDog dogstatsd client).  trace.py (Span/Tracer with
+X-Trace-Id/X-Span-Id propagation) and prom.py (/metrics rendering) are
+pilosa_tpu extensions.
 """
 
 from pilosa_tpu.obs.stats import (
@@ -11,11 +14,16 @@ from pilosa_tpu.obs.stats import (
     StatsDClient,
     new_stats_client,
 )
+from pilosa_tpu.obs.trace import NOP_TRACER, NopTracer, Span, Tracer
 
 __all__ = [
     "ExpvarStatsClient",
     "MultiStatsClient",
+    "NOP_TRACER",
     "NopStatsClient",
+    "NopTracer",
+    "Span",
     "StatsDClient",
+    "Tracer",
     "new_stats_client",
 ]

@@ -25,6 +25,21 @@ def union_string_slice(a: list[str], b: list[str]) -> list[str]:
     return sorted(set(a) | set(b))
 
 
+def _percentile(sorted_values: list[float], q: float) -> float:
+    """Linear-interpolated quantile of SORTED ``sorted_values`` (numpy's
+    default method): position ``q * (n - 1)`` interpolates between its
+    neighbors, so small samples aren't biased the way plain index
+    truncation is (``[1,2,3,4]`` p50 = 2.5, not 3)."""
+    n = len(sorted_values)
+    if n == 1:
+        return sorted_values[0]
+    pos = q * (n - 1)
+    lo = int(pos)
+    hi = min(lo + 1, n - 1)
+    frac = pos - lo
+    return sorted_values[lo] * (1.0 - frac) + sorted_values[hi] * frac
+
+
 class NopStatsClient:
     """reference: stats.go:66-76"""
 
@@ -50,6 +65,9 @@ class NopStatsClient:
         pass
 
     def timing(self, name: str, value: float) -> None:
+        pass
+
+    def close(self) -> None:
         pass
 
 
@@ -109,8 +127,11 @@ class ExpvarStatsClient:
     def timing(self, name: str, value: float) -> None:
         self.histogram(name, value)
 
+    def close(self) -> None:
+        pass
+
     def snapshot(self) -> dict:
-        """For /debug/vars."""
+        """For /debug/vars (and the /metrics Prometheus rendering)."""
         with self._store["lock"]:
             out: dict = {
                 "counts": dict(self._store["counts"]),
@@ -127,8 +148,10 @@ class ExpvarStatsClient:
                     "min": s[0],
                     "max": s[-1],
                     "mean": sum(s) / len(s),
-                    "p50": s[len(s) // 2],
-                    "p99": s[min(len(s) - 1, int(len(s) * 0.99))],
+                    "p50": _percentile(s, 0.5),
+                    "p90": _percentile(s, 0.9),
+                    "p99": _percentile(s, 0.99),
+                    "p999": _percentile(s, 0.999),
                 }
             out["histograms"] = hists
             return out
@@ -140,6 +163,11 @@ class StatsDClient:
     ``pilosa.``, fire-and-forget."""
 
     PREFIX = "pilosa."
+    # Datagram clamp: a metric+tags payload past this many bytes would
+    # hit EMSGSIZE (or fragment) on typical MTUs; oversize datagrams
+    # drop the tag suffix first, then truncate (dogstatsd servers skip
+    # a malformed line; an EMSGSIZE loses it silently either way).
+    MAX_PAYLOAD = 1432
 
     def __init__(self, host: str = "127.0.0.1:8125", _tags: list[str] | None = None):
         self.host = host
@@ -150,11 +178,15 @@ class StatsDClient:
 
     def _send(self, name: str, payload: str, tags: list[str] | None = None) -> None:
         all_tags = union_string_slice(self._tags, tags or [])
-        msg = f"{self.PREFIX}{name}:{payload}"
+        base = f"{self.PREFIX}{name}:{payload}"
+        msg = base
         if all_tags:
             msg += f"|#{','.join(all_tags)}"
+        data = msg.encode()
+        if len(data) > self.MAX_PAYLOAD:
+            data = base.encode()[: self.MAX_PAYLOAD]
         try:
-            self._sock.sendto(msg.encode(), self._addr)
+            self._sock.sendto(data, self._addr)
         except OSError:
             pass  # fire-and-forget
 
@@ -187,6 +219,11 @@ class StatsDClient:
     def timing(self, name: str, value: float) -> None:
         self._send(name, f"{value}|ms")
 
+    def close(self) -> None:
+        """Release the UDP socket.  with_tags children share the parent
+        socket, so closing any one releases it for all."""
+        self._sock.close()
+
 
 class MultiStatsClient:
     """Fan-out to several clients (reference: stats.go:152-219)."""
@@ -195,7 +232,12 @@ class MultiStatsClient:
         self.clients = list(clients)
 
     def tags(self) -> list[str]:
-        return self.clients[0].tags() if self.clients else []
+        # Union over ALL children, not just the first (parity with
+        # reference stats.go MultiStatsClient.Tags).
+        out: list[str] = []
+        for c in self.clients:
+            out = union_string_slice(out, c.tags())
+        return out
 
     def with_tags(self, *tags: str) -> "MultiStatsClient":
         return MultiStatsClient([c.with_tags(*tags) for c in self.clients])
@@ -223,6 +265,12 @@ class MultiStatsClient:
     def timing(self, name: str, value: float) -> None:
         for c in self.clients:
             c.timing(name, value)
+
+    def close(self) -> None:
+        for c in self.clients:
+            close = getattr(c, "close", None)
+            if close is not None:
+                close()
 
     def snapshot(self) -> dict:
         for c in self.clients:

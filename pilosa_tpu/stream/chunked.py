@@ -29,6 +29,9 @@ class LengthBodyReader:
     def __init__(self, fp, length: int):
         self._fp = fp
         self._remaining = max(0, int(length))
+        # Total body bytes consumed — the adapter's stream.bytesReceived
+        # counter reads this after the request completes.
+        self.bytes_read = 0
 
     def read(self, n: int = -1) -> bytes:
         if self._remaining <= 0:
@@ -36,6 +39,7 @@ class LengthBodyReader:
         want = self._remaining if n is None or n < 0 else min(n, self._remaining)
         data = self._fp.read(want)
         self._remaining -= len(data)
+        self.bytes_read += len(data)
         if not data:
             self._remaining = 0  # peer hung up early
         return data
@@ -60,6 +64,9 @@ class ChunkedBodyReader:
         self._fp = fp
         self._chunk_left = 0  # unread bytes of the current frame
         self._done = False
+        # Decoded body bytes consumed (frame payloads only, not the
+        # chunked framing) — see LengthBodyReader.bytes_read.
+        self.bytes_read = 0
 
     def _next_frame(self) -> None:
         line = self._fp.readline(1024)
@@ -104,6 +111,7 @@ class ChunkedBodyReader:
                 self._done = True  # peer hung up mid-frame
                 break
             self._chunk_left -= len(data)
+            self.bytes_read += len(data)
             out += data
         return out
 

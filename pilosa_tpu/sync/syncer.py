@@ -247,6 +247,10 @@ class FragmentSyncer:
                     if self.is_closing():
                         return
                     client.execute_query(f.index, "\n".join(batch), remote=False)
+                    # reference: fragment.go:1412 counts repairs; per
+                    # batch here so dashboards see push progress.
+                    f.stats.count("repairBatch")
+                    f.stats.count("repairBits", len(batch))
             else:
                 # Derived views repair via the view-scoped raw write
                 # path: PQL cannot target an individual inverse/time
@@ -258,4 +262,9 @@ class FragmentSyncer:
                     f.slice,
                     (set_ps.row_ids, [base + c for c in set_ps.column_ids]),
                     (clear_ps.row_ids, [base + c for c in clear_ps.column_ids]),
+                )
+                f.stats.count("repairBatch")
+                f.stats.count(
+                    "repairBits",
+                    len(set_ps.column_ids) + len(clear_ps.column_ids),
                 )

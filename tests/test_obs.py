@@ -1,0 +1,486 @@
+"""Observability: query tracing, /metrics exposition, slow-query log,
+and the stats-client satellites (tag union, close/clamp, percentiles,
+failure isolation)."""
+
+import json
+import re
+import socket
+import time
+
+import pytest
+
+from pilosa_tpu.cluster import broadcast as bc
+from pilosa_tpu.cluster.topology import Cluster
+from pilosa_tpu.net.client import InternalClient
+from pilosa_tpu.net.handler import Handler, Request
+from pilosa_tpu.net.server import Server
+from pilosa_tpu.obs import prom, trace
+from pilosa_tpu.obs import stats as stats_mod
+from pilosa_tpu.ops.bitplane import SLICE_WIDTH
+
+
+# ---------------------------------------------------------------------------
+# tracer unit tests
+# ---------------------------------------------------------------------------
+
+
+class TestTracer:
+    def test_span_tree_and_ring(self):
+        tr = trace.Tracer(capacity=4)
+        root = tr.start_trace("query", index="i")
+        token = root.activate()
+        with tr.span("parse"):
+            pass
+        with tr.span("execute"):
+            with tr.span("plan", slices=3):
+                pass
+        root.deactivate(token)
+        rec = tr.finish_root(root)
+        assert rec["trace_id"] == root.trace_id
+        names = [s["name"] for s in rec["spans"]]
+        assert names[0] == "query"
+        assert set(names) == {"query", "parse", "execute", "plan"}
+        by_name = {s["name"]: s for s in rec["spans"]}
+        assert by_name["parse"]["parent_id"] == root.span_id
+        assert by_name["plan"]["parent_id"] == by_name["execute"]["span_id"]
+        assert by_name["plan"]["tags"]["slices"] == 3
+        assert all(s["duration_ms"] is not None for s in rec["spans"])
+        assert tr.traces() == [rec]
+
+    def test_ring_capacity_and_min_ms(self):
+        tr = trace.Tracer(capacity=2)
+        for i in range(3):
+            tr.finish_root(tr.start_trace(f"q{i}"))
+        got = tr.traces()
+        assert [t["name"] for t in got] == ["q1", "q2"]
+        assert tr.traces(min_ms=1e9) == []
+
+    def test_absorb_remote_spans(self):
+        tr = trace.Tracer()
+        root = tr.start_trace("query")
+        payload = json.dumps(
+            {
+                "trace_id": root.trace_id,
+                "spans": [
+                    {
+                        "name": "query",
+                        "span_id": "abc",
+                        "parent_id": root.span_id,
+                        "start": time.time(),
+                        "duration_ms": 1.5,
+                        "tags": {"node": "remote:1"},
+                    }
+                ],
+            }
+        )
+        tr.absorb(payload)
+        rec = tr.finish_root(root)
+        remote = [s for s in rec["spans"] if s["span_id"] == "abc"]
+        assert remote and remote[0]["tags"]["node"] == "remote:1"
+        # Garbage payloads are ignored, never raise.
+        tr.absorb("not json")
+        tr.absorb('{"no": "trace_id"}')
+
+    def test_propagated_trace_continues_ids(self):
+        tr = trace.Tracer()
+        root = tr.start_trace("query", trace_id="t" * 32, parent_span_id="p" * 16)
+        assert root.trace_id == "t" * 32
+        assert root.parent_id == "p" * 16
+        rec = tr.finish_root(root)
+        assert rec["trace_id"] == "t" * 32
+
+    def test_stage_breakdown_excludes_root(self):
+        tr = trace.Tracer()
+        root = tr.start_trace("query")
+        token = root.activate()
+        with tr.span("parse"):
+            pass
+        with tr.span("parse"):
+            pass
+        root.deactivate(token)
+        rec = tr.finish_root(root)
+        stages = trace.stage_breakdown(rec)
+        assert set(stages) == {"parse"}
+        assert stages["parse"] >= 0
+
+    def test_error_annotation(self):
+        tr = trace.Tracer()
+        root = tr.start_trace("query")
+        token = root.activate()
+        with pytest.raises(ValueError):
+            with tr.span("execute"):
+                raise ValueError("boom")
+        root.deactivate(token)
+        rec = tr.finish_root(root)
+        ex = [s for s in rec["spans"] if s["name"] == "execute"][0]
+        assert ex["tags"]["error"] == "ValueError"
+
+    def test_nop_tracer(self):
+        tr = trace.NOP_TRACER
+        root = tr.start_trace("query")
+        with tr.span("x", anything=1) as sp:
+            sp.annotate(more=2)
+        assert tr.finish_root(root) is None
+        assert tr.traces() == []
+        assert tr.remote_headers(root) == {}
+
+
+# ---------------------------------------------------------------------------
+# stats satellites
+# ---------------------------------------------------------------------------
+
+
+class TestStatsSatellites:
+    def test_multi_tags_union(self):
+        a = stats_mod.ExpvarStatsClient().with_tags("index:i")
+        b = stats_mod.ExpvarStatsClient().with_tags("frame:f", "index:i")
+        m = stats_mod.MultiStatsClient([a, b])
+        assert m.tags() == ["frame:f", "index:i"]
+        assert stats_mod.MultiStatsClient([]).tags() == []
+
+    def test_percentiles_interpolated(self):
+        c = stats_mod.ExpvarStatsClient()
+        for v in (1.0, 2.0, 3.0, 4.0):
+            c.histogram("lat", v)
+        h = c.snapshot()["histograms"]["lat"]
+        assert h["p50"] == pytest.approx(2.5)
+        assert h["p90"] == pytest.approx(3.7)
+        assert h["p99"] == pytest.approx(3.97)
+        assert h["p999"] == pytest.approx(3.997)
+        # Single sample: every quantile is the sample.
+        c.histogram("one", 7.0)
+        h1 = c.snapshot()["histograms"]["one"]
+        assert h1["p50"] == h1["p999"] == 7.0
+
+    def test_statsd_close_releases_socket(self):
+        c = stats_mod.StatsDClient("127.0.0.1:19999")
+        child = c.with_tags("index:i")
+        c.close()
+        # Closed socket: sends are swallowed (fire-and-forget), and the
+        # shared child socket is released too.
+        c.count("x")
+        child.count("y")
+        assert c._sock.fileno() == -1
+
+    def test_multi_close_fans_out(self):
+        closed = []
+
+        class Rec:
+            def close(self):
+                closed.append(True)
+
+        stats_mod.MultiStatsClient([Rec(), Rec()]).close()
+        assert len(closed) == 2
+
+    def test_statsd_datagram_clamped(self):
+        rx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        rx.bind(("127.0.0.1", 0))
+        rx.settimeout(2.0)
+        port = rx.getsockname()[1]
+        huge_tags = [f"tag{i}:{'v' * 50}" for i in range(60)]
+        c = stats_mod.StatsDClient(f"127.0.0.1:{port}").with_tags(*huge_tags)
+        c.count("bits", 1)
+        data, _ = rx.recvfrom(65536)
+        assert len(data) <= stats_mod.StatsDClient.MAX_PAYLOAD
+        # Oversize drops the tag suffix, keeping the metric parseable.
+        assert data.startswith(b"pilosa.bits:1|c")
+        rx.close()
+        c.close()
+
+    def test_raising_stats_never_drops_response(self):
+        class Raising:
+            def histogram(self, name, value):
+                raise RuntimeError("stats backend down")
+
+            def count(self, name, value=1):
+                raise RuntimeError("stats backend down")
+
+        h = Handler(stats=Raising())
+        resp = h.dispatch(Request(method="GET", path="/version"))
+        assert resp.status == 200
+        assert b"version" in resp.body
+
+
+# ---------------------------------------------------------------------------
+# prometheus exposition
+# ---------------------------------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? [-+0-9.einfa]+$"
+)
+
+
+def _assert_valid_exposition(text: str) -> None:
+    assert text.endswith("\n")
+    for line in text.rstrip("\n").split("\n"):
+        if line.startswith("# TYPE "):
+            parts = line.split(" ")
+            assert parts[3] in ("counter", "gauge", "summary"), line
+        else:
+            assert _SAMPLE_RE.match(line), f"bad exposition line: {line!r}"
+
+
+class TestProm:
+    def test_render_kinds_and_labels(self):
+        c = stats_mod.ExpvarStatsClient()
+        c.with_tags("index:i", "frame:f").count("setBit", 3)
+        c.gauge("rows", 7.0)
+        for v in (1.0, 2.0, 3.0, 4.0):
+            c.histogram("lat", v)
+        text = prom.render(c.snapshot(), extra_gauges={"uptime_seconds": 12.5})
+        _assert_valid_exposition(text)
+        assert '# TYPE pilosa_setBit_total counter' in text
+        assert 'pilosa_setBit_total{frame="f",index="i"} 3' in text
+        assert "# TYPE pilosa_rows gauge" in text
+        assert "pilosa_rows 7" in text
+        assert "# TYPE pilosa_lat summary" in text
+        assert 'pilosa_lat{quantile="0.5"} 2.5' in text
+        assert "pilosa_lat_sum 10" in text
+        assert "pilosa_lat_count 4" in text
+        assert "pilosa_uptime_seconds 12.5" in text
+
+    def test_name_sanitization(self):
+        text = prom.render({"counts": {"http.POST./index/i/query": 2}})
+        _assert_valid_exposition(text)
+        assert "pilosa_http_POST__index_i_query_total 2" in text
+
+    def test_empty_snapshot(self):
+        assert prom.render({}) == ""
+        _assert_valid_exposition(prom.render({}, extra_gauges={"threads": 3}))
+
+
+# ---------------------------------------------------------------------------
+# single-node integration: /metrics, /debug/traces, slow-query log
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def obs_server(tmp_path):
+    logs = []
+    s = Server(
+        data_dir=str(tmp_path / "data"),
+        stats=stats_mod.ExpvarStatsClient(),
+        logger=logs.append,
+        slow_query_ms=0.0001,  # every query is "slow"
+        anti_entropy_interval=3600,
+        polling_interval=3600,
+        cache_flush_interval=3600,
+    )
+    s.open()
+    yield s, logs
+    s.close()
+
+
+class TestObsEndpoints:
+    def _populate(self, s):
+        s.holder.create_index_if_not_exists("i")
+        f = s.holder.index("i").create_frame_if_not_exists("f")
+        f.set_bit("standard", 1, 5)
+        f.set_bit("standard", 1, 9)
+
+    def test_metrics_exposition(self, obs_server):
+        s, _ = obs_server
+        self._populate(s)
+        c = InternalClient(s.host, timeout=10.0)
+        assert c.execute_pql("i", 'Count(Bitmap(frame="f", rowID=1))') == 2
+        status, data, headers = c._request_meta("GET", "/metrics")
+        assert status == 200
+        assert headers["content-type"].startswith("text/plain")
+        text = data.decode()
+        _assert_valid_exposition(text)
+        # Fragment write counter with hierarchical labels.
+        assert re.search(
+            r'pilosa_setBit_total\{[^}]*index="i"[^}]*\} 2', text
+        ), text
+        # Per-call query counter from the executor.
+        assert 'pilosa_Count_total{index="i"} 1' in text
+        # Handler latency summary and process gauges.
+        assert "# TYPE pilosa_uptime_seconds gauge" in text
+
+    def test_trace_ring_and_min_ms_filter(self, obs_server):
+        s, _ = obs_server
+        self._populate(s)
+        c = InternalClient(s.host, timeout=10.0)
+        c.execute_pql("i", 'Count(Bitmap(frame="f", rowID=1))')
+        status, data = c._request("GET", "/debug/traces")
+        traces = json.loads(data)["traces"]
+        assert status == 200 and traces
+        t = traces[-1]
+        names = {sp["name"] for sp in t["spans"]}
+        assert {"query", "parse", "execute", "call.Count", "plan"} <= names
+        assert t["spans"][0]["tags"]["query"].startswith("Count(")
+        # min_ms far above any query filters everything out.
+        status, data = c._request(
+            "GET", "/debug/traces", query={"min_ms": "1000000"}
+        )
+        assert json.loads(data)["traces"] == []
+        # invalid filter is a 400, not a 500
+        status, _ = c._request("GET", "/debug/traces", query={"min_ms": "x"})
+        assert status == 400
+
+    def test_slow_query_log_exactly_one_line(self, obs_server):
+        s, logs = obs_server
+        self._populate(s)
+        c = InternalClient(s.host, timeout=10.0)
+        c.execute_pql("i", 'Count(Bitmap(frame="f", rowID=1))')
+        slow = [m for m in logs if m.startswith("slow query ")]
+        assert len(slow) == 1, slow
+        payload = json.loads(slow[0][len("slow query "):])
+        assert payload["index"] == "i"
+        assert payload["query"].startswith("Count(")
+        assert payload["ms"] >= 0.0001
+        assert payload["trace_id"]
+        assert "parse" in payload["stages"] and "execute" in payload["stages"]
+
+    def test_slow_query_log_disabled_by_default(self, tmp_path):
+        logs = []
+        s = Server(
+            data_dir=str(tmp_path / "d2"),
+            logger=logs.append,
+            anti_entropy_interval=3600,
+            polling_interval=3600,
+            cache_flush_interval=3600,
+        )
+        s.open()
+        try:
+            self._populate(s)
+            c = InternalClient(s.host, timeout=10.0)
+            c.execute_pql("i", 'Count(Bitmap(frame="f", rowID=1))')
+            assert not [m for m in logs if m.startswith("slow query ")]
+        finally:
+            s.close()
+
+    def test_cache_counters_surface(self, obs_server):
+        s, _ = obs_server
+        self._populate(s)
+        c = InternalClient(s.host, timeout=10.0)
+        # Explicit-ids TopN resolves counts through the ranked cache
+        # (fragment._row_count_locked), exercising hit (row 1) and miss
+        # (row 99) counters.
+        c.execute_pql("i", 'TopN(frame="f", n=2, ids=[1, 99])')
+        snap = s.stats.snapshot()
+        assert any(k.startswith("cacheHit") or k.startswith("cacheMiss")
+                   for k in snap["counts"]), snap["counts"]
+
+
+# ---------------------------------------------------------------------------
+# multi-node: one trace spans the HTTP fan-out
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def two_obs_servers(tmp_path):
+    recv0, recv1 = bc.HTTPBroadcastReceiver(), bc.HTTPBroadcastReceiver()
+    b0, b1 = bc.HTTPBroadcaster([]), bc.HTTPBroadcaster([])
+    cluster0, cluster1 = Cluster(replica_n=1), Cluster(replica_n=1)
+    servers = []
+    for i, (cl, br, rc) in enumerate(
+        ((cluster0, b0, recv0), (cluster1, b1, recv1))
+    ):
+        servers.append(
+            Server(
+                data_dir=str(tmp_path / f"n{i}"),
+                cluster=cl,
+                broadcaster=br,
+                broadcast_receiver=rc,
+                stats=stats_mod.ExpvarStatsClient(),
+                anti_entropy_interval=3600,
+                polling_interval=3600,
+                cache_flush_interval=3600,
+            )
+        )
+    s0, s1 = servers
+    s0.open()
+    s1.open()
+    b0.internal_hosts.append(recv1.bound_host)
+    b1.internal_hosts.append(recv0.bound_host)
+    for c in (cluster0, cluster1):
+        for host in sorted([s0.host, s1.host]):
+            if c.node_by_host(host) is None:
+                c.add_node(host)
+        c.nodes.sort(key=lambda n: n.host)
+    yield s0, s1
+    s0.close()
+    s1.close()
+
+
+class TestDistributedTrace:
+    def test_single_trace_covers_remote_fanout(self, two_obs_servers):
+        s0, s1 = two_obs_servers
+        for s in (s0, s1):
+            s.holder.create_index_if_not_exists("i")
+            s.holder.index("i").create_frame_if_not_exists("f")
+        c0 = InternalClient(s0.host, timeout=10.0)
+        n_slices = 6
+        for sl in range(n_slices):
+            c0.execute_query(
+                "i", f'SetBit(frame="f", rowID=1, columnID={sl * SLICE_WIDTH})'
+            )
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            if (
+                s0.holder.index("i").max_slice() == n_slices - 1
+                and s1.holder.index("i").max_slice() == n_slices - 1
+            ):
+                break
+            time.sleep(0.02)
+        assert (
+            c0.execute_pql("i", 'Count(Bitmap(frame="f", rowID=1))')
+            == n_slices
+        )
+
+        # The coordinator retains ONE trace for the count query whose
+        # spans cover parse, plan, local slice execution, AND the remote
+        # node's leg (absorbed across the HTTP hop via X-Trace-Id).
+        status, data = c0._request("GET", "/debug/traces")
+        assert status == 200
+        counts = [
+            t
+            for t in json.loads(data)["traces"]
+            if t["spans"][0]["tags"].get("query", "").startswith("Count(")
+        ]
+        assert len(counts) == 1
+        t = counts[0]
+        names = {sp["name"] for sp in t["spans"]}
+        assert {"parse", "execute", "call.Count", "plan", "map.local",
+                "rpc.execute", "exec.device"} <= names
+        assert all(
+            sp.get("duration_ms") is not None for sp in t["spans"]
+        )
+
+        by_id = {sp["span_id"]: sp for sp in t["spans"]}
+        rpc = [sp for sp in t["spans"] if sp["name"] == "rpc.execute"]
+        assert rpc and rpc[0]["tags"]["node"] == s1.host
+        # The remote leg's root span came back across the hop: a "query"
+        # span tagged with the remote node, parented under the rpc span.
+        remote_roots = [
+            sp
+            for sp in t["spans"]
+            if sp["name"] == "query" and sp["tags"].get("node") == s1.host
+        ]
+        assert remote_roots
+        assert remote_roots[0]["parent_id"] in {r["span_id"] for r in rpc}
+        # Remote-side execution spans rode along too.
+        remote_ids = {remote_roots[0]["span_id"]}
+        for sp in t["spans"]:
+            if sp["parent_id"] in remote_ids:
+                remote_ids.add(sp["span_id"])
+        assert any(
+            by_id[i]["name"] == "execute" for i in remote_ids if i in by_id
+        )
+
+        # The remote node independently retained its leg under the SAME
+        # trace id (linked via the propagated X-Trace-Id).
+        c1 = InternalClient(s1.host, timeout=10.0)
+        _, data1 = c1._request("GET", "/debug/traces")
+        remote_trace_ids = {
+            tt["trace_id"] for tt in json.loads(data1)["traces"]
+        }
+        assert t["trace_id"] in remote_trace_ids
+
+        # /metrics on the coordinator includes fragment + query counters.
+        status, data, _ = c0._request_meta("GET", "/metrics")
+        text = data.decode()
+        _assert_valid_exposition(text)
+        assert "pilosa_setBit_total" in text
+        assert 'pilosa_Count_total{index="i"} 1' in text
