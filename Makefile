@@ -5,7 +5,7 @@
 
 PYTHON ?= python
 
-.PHONY: default test lint bench install build docker clean generate
+.PHONY: default test lint check bench install build docker clean generate
 
 default: build test
 
@@ -18,6 +18,12 @@ test:
 # rules, configured in pyproject.toml).
 lint:
 	$(PYTHON) -m ruff check pilosa_tpu/
+
+# The CI gate (.github/workflows/check.yml): lint plus the tier-1 test
+# suite (everything not marked slow) on the forced CPU backend.
+check: lint
+	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow' \
+		--continue-on-collection-errors -p no:cacheprovider
 
 # Compile the C++ codec and verify the wire module imports.
 build:

@@ -109,6 +109,8 @@ def build_server(cfg: config_mod.Config):
         stream_chunk_bytes=cfg.net.stream_chunk_bytes,
         slow_query_ms=cfg.obs.slow_query_ms,
         trace_ring=cfg.obs.trace_ring,
+        hbm_budget_bytes=cfg.device.hbm_budget_bytes,
+        device_prefetch=cfg.device.prefetch,
     )
 
 

@@ -44,6 +44,7 @@ from typing import Any, Iterable, Sequence
 
 import numpy as np
 
+from pilosa_tpu import device as device_mod
 from pilosa_tpu.core import cache as cache_mod
 from pilosa_tpu.core.bitmap import RowBitmap
 from pilosa_tpu.core.cache import Pair
@@ -70,6 +71,8 @@ DENSE_ROW_BUDGET = 1 << 16
 PROMOTE_BITS = 32 * 1024
 # Paged-to-device sparse rows kept per fragment (LRU, 128 KiB each).
 SPARSE_DEVICE_CACHE = 64
+# Device bytes of one paged row (uint32[WORDS_PER_SLICE]).
+ROW_NBYTES = bp.WORDS_PER_SLICE * 4
 # Largest legal row id: op-log positions are u64 and pos = row*2^20+off.
 MAX_ROW_ID = 1 << 44
 
@@ -265,6 +268,10 @@ class Fragment:
         # Process-unique identity for cache version vectors: unlike
         # id(), a serial is never reused by a recreated fragment.
         self._serial = next(_fragment_serials)
+        # Residency-pool identities (device/pool.py): the dense-plane
+        # HBM mirror and the paged-sparse-row cache account separately.
+        self._pool_key = ("frag", self._serial, "mirror")
+        self._sparse_pool_key = ("frag", self._serial, "sparse")
 
         self._mu = threading.RLock()
         # Two-tier row storage.  DENSE: plane row *slots* hold up to
@@ -436,7 +443,13 @@ class Fragment:
                 fcntl.flock(self._file.fileno(), fcntl.LOCK_UN)
                 self._file.close()
                 self._file = None
+            # Explicit HBM release: drop the mirror AND the paged sparse
+            # rows, and deregister both from the residency pool — a
+            # deleted frame or an in-process restart returns its device
+            # bytes now, not whenever GC reaches self._device.
             self._invalidate_device()
+            self._sparse_dev.clear()
+            device_mod.pool().remove(self._sparse_pool_key)
             self._opened = False
             # A fragment leaving service (shutdown OR frame/index/view
             # deletion) must invalidate epoch-validated read caches —
@@ -589,7 +602,8 @@ class Fragment:
         ):
             return
         del self._sparse[row_id]
-        self._sparse_dev.pop(row_id, None)
+        if self._sparse_dev.pop(row_id, None) is not None:
+            self._sync_sparse_pool_locked()
         slot = self._alloc_dense_slot(row_id)
         self._plane[slot] = bp.np_columns_to_row(offs)
         self._invalidate_device()
@@ -856,6 +870,7 @@ class Fragment:
         self._plane = plane
         self._sparse = sparse
         self._sparse_dev.clear()
+        self._sync_sparse_pool_locked()
         self._max_row_id = max_row
         self._count_of = counts
         self._block_sums.clear()
@@ -909,6 +924,7 @@ class Fragment:
                 np.concatenate(segs) if segs else np.empty(0, np.uint32)
             )
         self._sparse_dev.clear()
+        self._sync_sparse_pool_locked()
 
         self._max_row_id = max(per_row) if per_row else 0
         self._count_of = counts
@@ -1066,20 +1082,76 @@ class Fragment:
 
     def _invalidate_device(self) -> None:
         """Bulk plane changes (import, restore, load) force a full
-        re-upload; queued point updates would be stale."""
+        re-upload; queued point updates would be stale.  The residency
+        pool drops the mirror's accounting with it."""
         self._device = None
         self._device_version = -1
         self._device_pending.clear()
+        device_mod.pool().remove(self._pool_key)
+
+    def _pool_info(self) -> dict:
+        return {
+            "fragment": f"{self.index}/{self.frame}/{self.view}/{self.slice}",
+            "slice": self.slice,
+        }
+
+    def _evict_mirror(self) -> bool:
+        """Residency-pool eviction hook: drop the HBM mirror.  The host
+        plane is authoritative, so the next ``device_plane()`` rebuilds
+        it — but ``_device_pending`` must clear COHERENTLY under the
+        fragment lock: queued point writes describe deltas against the
+        dropped mirror, and replaying them onto a freshly-uploaded
+        (already current) plane would be wrong.  Non-blocking acquire:
+        the pool may pick this fragment while another thread is inside
+        ``device_plane()``; skipping an actively-used mirror is always
+        safe, dropping it mid-upload is not."""
+        if not self._mu.acquire(blocking=False):
+            return False
+        try:
+            self._device = None
+            self._device_version = -1
+            self._device_pending.clear()
+            return True
+        finally:
+            self._mu.release()
+
+    def _evict_sparse_rows(self) -> bool:
+        """Residency-pool eviction hook for the paged-sparse-row cache:
+        page everything out (rebuilt on demand from the host offset
+        arrays)."""
+        if not self._mu.acquire(blocking=False):
+            return False
+        try:
+            self._sparse_dev.clear()
+            return True
+        finally:
+            self._mu.release()
+
+    def _sync_sparse_pool_locked(self) -> None:
+        """Re-account the paged-sparse-row cache after a mutation path
+        shrank it (write invalidation, promotion, bulk load).  Callers
+        hold ``_mu``."""
+        n = len(self._sparse_dev)
+        if n == 0:
+            device_mod.pool().remove(self._sparse_pool_key)
+        else:
+            device_mod.pool().resize(
+                self._sparse_pool_key,
+                {bp.home_device(self.slice): n * ROW_NBYTES},
+            )
 
     def device_plane(self):
         """The HBM mirror of the plane, pinned to the slice's home device
         (slice mod n_devices) so multi-device query batches assemble
         shard-local (parallel/mesh.home_device).  Point writes since the
         last read apply as one batched on-device scatter; bulk changes
-        re-upload."""
+        re-upload.  Every (re)upload admits through the residency pool
+        FIRST, so LRU mirrors are evicted to make room and accounted
+        residency never exceeds the HBM budget."""
         import jax
 
         with self._mu:
+            pool = device_mod.pool()
             if self._device is not None and self._device_version != self._version:
                 if self._device_pending:
                     self._device = _apply_pending(
@@ -1090,11 +1162,23 @@ class Fragment:
                 else:
                     self._device = None
             if self._device is None or self._device_version != self._version:
-                self._device = jax.device_put(
-                    self._plane, bp.home_device(self.slice)
+                dev = bp.home_device(self.slice)
+                pool.admit(
+                    self._pool_key,
+                    {dev: int(self._plane.nbytes)},
+                    self._evict_mirror,
+                    category="mirror",
+                    info=self._pool_info(),
                 )
+                try:
+                    self._device = jax.device_put(self._plane, dev)
+                except BaseException:
+                    pool.remove(self._pool_key)
+                    raise
                 self._device_pending.clear()
                 self._device_version = self._version
+            else:
+                pool.touch(self._pool_key)
             return self._device
 
     def has_row(self, row_id: int) -> bool:
@@ -1123,10 +1207,20 @@ class Fragment:
             dev = self._sparse_dev.get(row_id)
             if dev is not None:
                 self._sparse_dev.move_to_end(row_id)
+                device_mod.pool().touch(self._sparse_pool_key)
                 return dev
-            dev = jax.device_put(
-                bp.np_columns_to_row(offs), bp.home_device(self.slice)
+            home = bp.home_device(self.slice)
+            device_mod.pool().admit(
+                self._sparse_pool_key,
+                {
+                    home: min(len(self._sparse_dev) + 1, SPARSE_DEVICE_CACHE)
+                    * ROW_NBYTES
+                },
+                self._evict_sparse_rows,
+                category="sparse",
+                info=self._pool_info(),
             )
+            dev = jax.device_put(bp.np_columns_to_row(offs), home)
             self._sparse_dev[row_id] = dev
             while len(self._sparse_dev) > SPARSE_DEVICE_CACHE:
                 self._sparse_dev.popitem(last=False)
@@ -1208,7 +1302,8 @@ class Fragment:
         self._version += 1
         _bump_write_epoch()
         self._row_cache.pop(row_id, None)
-        self._sparse_dev.pop(row_id, None)
+        if self._sparse_dev.pop(row_id, None) is not None:
+            self._sync_sparse_pool_locked()
         self._dirty_blocks.add(row_id // HASH_BLOCK_SIZE)
         n = self._count_of[row_id] = self._count_of.get(row_id, 0) + delta
         self.cache.add(row_id, n)
@@ -1302,6 +1397,7 @@ class Fragment:
             _bump_write_epoch()
             self._invalidate_device()
             self._sparse_dev.clear()
+            self._sync_sparse_pool_locked()
             self._row_cache.clear()
             self._dirty_blocks.update(int(r) // HASH_BLOCK_SIZE for r in uniq)
             d_items = [(r, s) for r, s in slot_of.items() if s is not None]

@@ -147,7 +147,7 @@ class Holder:
                 for name, idx in self._indexes.items()
             }
 
-    def warm_device_mirrors(self, budget_bytes: int = 8 << 30) -> int:
+    def warm_device_mirrors(self, budget_bytes: int | None = None) -> int:
         """Upload every fragment's dense plane to its home device, up to
         ``budget_bytes`` of HBM — so a restarted node's first queries
         gather on-device instead of paying the host->device staging (the
@@ -156,7 +156,16 @@ class Holder:
         Largest planes first: they are the ones whose first-query
         staging hurts.  Returns the number of fragments warmed.  Safe
         to run in the background while serving — device_plane() is the
-        same call the query path makes."""
+        same call the query path makes.
+
+        ``budget_bytes=None`` adopts the residency pool's configured
+        HBM budget (device/pool.py) so warming never floods past what
+        the pool would immediately evict back out; with the pool
+        unbounded it falls back to a conservative 8 GiB."""
+        if budget_bytes is None:
+            from pilosa_tpu import device as device_mod
+
+            budget_bytes = device_mod.pool().budget_bytes() or (8 << 30)
         frags = [
             frag
             for index in self.indexes().values()

@@ -1,0 +1,75 @@
+"""pilosa_tpu.device — HBM residency management.
+
+The process has ONE set of devices, so it gets ONE residency manager:
+``pool()`` returns the process-global :class:`PlanePool` every device
+allocation registers with (fragment mirrors, paged sparse rows, the
+executor's batch/TopN cache entries), and ``prefetcher()`` the shared
+async mirror :class:`Prefetcher`.  The server configures the pool from
+``[device]`` config at open; bare library use (tests, bench) gets an
+unconfigured pool, whose budget resolves from the
+``PILOSA_DEVICE_HBM_BUDGET_BYTES`` env or device detection — unbounded
+on the CPU backend, so nothing changes for code that never asked for a
+budget.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from pilosa_tpu.device.pool import PlanePool  # noqa: F401 — re-export
+from pilosa_tpu.device.prefetch import Prefetcher  # noqa: F401 — re-export
+
+_mu = threading.Lock()
+_pool: PlanePool | None = None
+_prefetcher: Prefetcher | None = None
+
+
+def pool() -> PlanePool:
+    """The process-global residency manager."""
+    global _pool
+    if _pool is None:
+        with _mu:
+            if _pool is None:
+                _pool = PlanePool()
+    return _pool
+
+
+def prefetcher() -> Prefetcher:
+    """The shared prefetcher, bound to the global pool."""
+    global _prefetcher
+    if _prefetcher is None:
+        with _mu:
+            if _prefetcher is None:
+                _prefetcher = Prefetcher()
+    return _prefetcher
+
+
+def _set_pool(p: PlanePool | None) -> PlanePool | None:
+    """Swap the global pool (tests only); returns the previous one."""
+    global _pool
+    with _mu:
+        prev = _pool
+        _pool = p
+        return prev
+
+
+def bytes_by_device(arr) -> dict:
+    """{device: bytes} attribution for a jax array — a sharded array
+    splits its nbytes evenly over its devices (the slice axis shards
+    evenly by construction, parallel/mesh.assemble_sharded_batch), a
+    committed array lands whole on its one device."""
+    if arr is None:
+        return {}
+    nbytes = int(getattr(arr, "nbytes", 0) or 0)
+    if not nbytes:
+        return {}
+    devs = None
+    try:
+        devs = list(arr.devices())
+    except Exception:  # noqa: BLE001 — older arrays expose .device
+        d = getattr(arr, "device", None)
+        devs = [d] if d is not None else None
+    if not devs:
+        return {}
+    share = nbytes // len(devs)
+    return {d: share for d in devs}

@@ -1,0 +1,446 @@
+"""PlanePool — the HBM residency manager.
+
+Every device allocation the system keeps alive across queries registers
+here: fragment plane mirrors (core/fragment.py `device_plane`), paged
+sparse rows, and the executor's batch / TopN-prep cache entries
+(exec/executor.py).  The pool keeps per-device byte accounting against a
+budget (`[device] hbm-budget-bytes`) and reclaims by LRU eviction of
+unpinned entries whenever an admission would exceed it — correctness is
+free because the host numpy plane is always authoritative: an evicted
+mirror simply rebuilds on the next read.
+
+Design points:
+
+* **Admission-before-upload.**  Owners call :meth:`admit` BEFORE the
+  ``device_put``, so accounted residency never exceeds budget (modulo
+  pinned saturation, which is counted, not hidden).
+* **Pin leases.**  The executor pins the entries a fused program reads
+  for the duration of dispatch+fetch; pinned entries are never victims,
+  so eviction can never drop a plane mid-query.
+* **Non-blocking evict callbacks.**  An evict callback must clear the
+  owner's device reference under the OWNER's lock — but owners call
+  into the pool while holding that lock (e.g. ``device_plane`` admits
+  under the fragment lock).  To stay deadlock-free, callbacks acquire
+  the owner lock with ``blocking=False`` and return False when they
+  lose the race; the pool skips that victim (it is being actively used)
+  and moves to the next.  The pool's own lock is reentrant, so a
+  callback that calls back into :meth:`remove` is also safe.
+* **LRU order** is the entry insertion/touch order; :meth:`touch` on a
+  cache hit moves an entry to the MRU end.
+
+Budget resolution (per device): an explicit positive ``configure``
+value wins, then the ``PILOSA_DEVICE_HBM_BUDGET_BYTES`` env override,
+then a safe fraction of the detected device memory
+(``memory_stats()['bytes_limit']``), else unbounded — which is what the
+CPU backend reports, so tests and laptops never evict unless asked to.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Callable
+
+from pilosa_tpu.obs import trace
+from pilosa_tpu.obs.stats import NopStatsClient
+
+# Auto-detected budget = this fraction of the device's reported
+# bytes_limit: headroom for XLA scratch, collectives, and transient
+# program outputs that never register with the pool.
+DEFAULT_BUDGET_FRACTION = 0.8
+
+ENV_BUDGET = "PILOSA_DEVICE_HBM_BUDGET_BYTES"
+
+
+def _device_label(dev) -> str:
+    """Stable printable identity for a device key (jax Device or any
+    hashable stand-in the unit tests use)."""
+    i = getattr(dev, "id", None)
+    if i is not None:
+        return f"{getattr(dev, 'platform', 'dev')}:{i}"
+    return str(dev)
+
+
+@dataclass
+class _Entry:
+    key: tuple
+    bytes_by_device: dict
+    evict: Callable[[], bool]
+    category: str  # "mirror" | "sparse" | "cache"
+    info: dict = field(default_factory=dict)
+    pins: int = 0
+
+    @property
+    def nbytes(self) -> int:
+        return sum(self.bytes_by_device.values())
+
+
+class PlanePool:
+    """Per-device byte accounting + LRU eviction for long-lived device
+    arrays.  Thread-safe; one instance serves the whole process (see
+    ``pilosa_tpu.device.pool()``)."""
+
+    def __init__(self, budget_bytes: int = 0, stats=None, tracer=None):
+        # Reentrant: evict callbacks may legally call remove()/resize()
+        # back into the pool from under _mu.
+        self._mu = threading.RLock()
+        self._entries: "OrderedDict[tuple, _Entry]" = OrderedDict()
+        self._resident: dict = {}  # device -> bytes
+        self._pinned: dict = {}  # device -> bytes held by pinned entries
+        self._max_resident: dict = {}  # device -> high-water bytes
+        self._cat_bytes: dict[str, int] = {}  # category -> bytes
+        self._evictions = 0
+        self._evict_skipped = 0
+        self._over_budget = 0
+        self._prefetch_hits = 0
+        self._prefetch_misses = 0
+        # 0 = auto (env -> detect -> unbounded); > 0 = explicit bytes.
+        self._budget = int(budget_bytes or 0)
+        self._detected: int | None = None
+        self.stats = stats or NopStatsClient()
+        self.tracer = tracer or trace.NOP_TRACER
+        self._dev_stats: dict = {}  # device -> tagged stats child
+
+    # ------------------------------------------------------------------
+    # configuration / budget
+    # ------------------------------------------------------------------
+
+    def configure(self, budget_bytes: int | None = None, stats=None, tracer=None) -> None:
+        """Server wiring: budget from config (0 = auto), stats/tracer
+        for gauges and evict/prefetch spans."""
+        with self._mu:
+            if budget_bytes is not None:
+                self._budget = int(budget_bytes)
+            if stats is not None:
+                self.stats = stats
+                self._dev_stats.clear()
+            if tracer is not None:
+                self.tracer = tracer
+
+    def budget_bytes(self) -> int:
+        """The effective PER-DEVICE budget; 0 means unbounded."""
+        if self._budget > 0:
+            return self._budget
+        raw = os.environ.get(ENV_BUDGET, "")
+        if raw:
+            try:
+                v = int(raw)
+                if v > 0:
+                    return v
+            except ValueError:
+                pass
+        return self._detect_budget()
+
+    def _detect_budget(self) -> int:
+        if self._detected is None:
+            limit = 0
+            try:
+                import jax
+
+                ms = getattr(jax.local_devices()[0], "memory_stats", None)
+                mem = ms() if callable(ms) else None
+                if mem and mem.get("bytes_limit"):
+                    limit = int(mem["bytes_limit"] * DEFAULT_BUDGET_FRACTION)
+            except Exception:  # noqa: BLE001 — detection is best-effort
+                limit = 0
+            self._detected = limit
+        return self._detected
+
+    # ------------------------------------------------------------------
+    # tenant lifecycle
+    # ------------------------------------------------------------------
+
+    def admit(
+        self,
+        key: tuple,
+        bytes_by_device: dict,
+        evict: Callable[[], bool],
+        category: str = "cache",
+        info: dict | None = None,
+    ) -> None:
+        """Register (or re-register with new bytes) an entry, evicting
+        LRU unpinned entries first so every touched device stays within
+        budget.  Call BEFORE the actual device allocation; on upload
+        failure call :meth:`remove`.  Re-admission preserves pins."""
+        budget = self.budget_bytes()
+        need = {d: int(n) for d, n in bytes_by_device.items() if n}
+        with self._mu:
+            old = self._entries.pop(key, None)
+            pins = 0
+            if old is not None:
+                pins = old.pins
+                self._debit(old)
+            if budget and need and any(
+                self._resident.get(d, 0) + n > budget for d, n in need.items()
+            ):
+                with self.tracer.span("evict", trigger=category) as sp:
+                    n_ev = self._evict_for_locked(need, budget, key)
+                    sp.annotate(evicted=n_ev)
+                if n_ev:
+                    self._evictions += n_ev
+                    self.stats.count("device.evictions", n_ev)
+            ent = _Entry(
+                key=key,
+                bytes_by_device=need,
+                evict=evict,
+                category=category,
+                info=dict(info or {}),
+                pins=pins,
+            )
+            self._entries[key] = ent
+            self._credit(ent)
+            if budget and any(
+                self._resident.get(d, 0) > budget for d in need
+            ):
+                # All remaining tenants on the device were pinned (or
+                # their owners were busy): correctness beats the budget,
+                # but the breach is counted, never silent.
+                self._over_budget += 1
+                self.stats.count("device.overBudget")
+            self._publish_locked(need)
+
+    def touch(self, key: tuple) -> None:
+        with self._mu:
+            if key in self._entries:
+                self._entries.move_to_end(key)
+
+    def resize(self, key: tuple, bytes_by_device: dict) -> None:
+        """Update an entry's bytes in place (e.g. the sparse-row cache
+        shrinking) without changing its LRU position or running
+        admission eviction."""
+        with self._mu:
+            ent = self._entries.get(key)
+            if ent is None:
+                return
+            self._debit(ent)
+            ent.bytes_by_device = {
+                d: int(n) for d, n in bytes_by_device.items() if n
+            }
+            self._credit(ent)
+            self._publish_locked(ent.bytes_by_device)
+
+    def remove(self, key: tuple) -> None:
+        with self._mu:
+            ent = self._entries.pop(key, None)
+            if ent is not None:
+                self._debit(ent)
+                self._publish_locked(ent.bytes_by_device)
+
+    def contains(self, key: tuple) -> bool:
+        with self._mu:
+            return key in self._entries
+
+    # ------------------------------------------------------------------
+    # pin leases
+    # ------------------------------------------------------------------
+
+    def pin(self, key: tuple) -> bool:
+        """Take a pin lease on an entry; False when it is not resident
+        (the caller's snapshot reference still keeps its array alive —
+        the lease only guards the POOL's eviction choices)."""
+        with self._mu:
+            ent = self._entries.get(key)
+            if ent is None:
+                return False
+            ent.pins += 1
+            if ent.pins == 1:
+                for d, n in ent.bytes_by_device.items():
+                    self._pinned[d] = self._pinned.get(d, 0) + n
+            return True
+
+    def unpin(self, key: tuple) -> None:
+        with self._mu:
+            ent = self._entries.get(key)
+            if ent is None or ent.pins == 0:
+                return
+            ent.pins -= 1
+            if ent.pins == 0:
+                for d, n in ent.bytes_by_device.items():
+                    self._pinned[d] = max(0, self._pinned.get(d, 0) - n)
+
+    class _PinLease:
+        def __init__(self, pool: "PlanePool", keys):
+            self._pool = pool
+            self._keys = keys
+            self._held: list = []
+
+        def __enter__(self):
+            for k in self._keys:
+                if k is not None and self._pool.pin(k):
+                    self._held.append(k)
+            return self
+
+        def __exit__(self, *exc):
+            for k in self._held:
+                self._pool.unpin(k)
+
+    def pinned(self, *keys) -> "PlanePool._PinLease":
+        """Context manager pinning every present key for the block —
+        the executor's per-program lease.  None keys are skipped."""
+        return PlanePool._PinLease(self, keys)
+
+    # ------------------------------------------------------------------
+    # eviction (callers hold _mu)
+    # ------------------------------------------------------------------
+
+    def _evict_for_locked(self, need: dict, budget: int, exclude_key) -> int:
+        evicted = 0
+        for k in list(self._entries.keys()):
+            if all(
+                self._resident.get(d, 0) + n <= budget
+                for d, n in need.items()
+            ):
+                break
+            if k == exclude_key:
+                continue
+            ent = self._entries.get(k)
+            if ent is None or ent.pins > 0:
+                continue
+            # Only evicting entries that share a device with the need
+            # can make room.
+            if not any(d in need for d in ent.bytes_by_device):
+                continue
+            try:
+                ok = bool(ent.evict())
+            except Exception:  # noqa: BLE001 — a broken owner must not
+                ok = True  # wedge the pool; drop the accounting.
+            if ok:
+                # The callback may have re-entered remove() itself.
+                ent2 = self._entries.pop(k, None)
+                if ent2 is not None:
+                    self._debit(ent2)
+                evicted += 1
+            else:
+                self._evict_skipped += 1
+                self.stats.count("device.evictSkipped")
+        return evicted
+
+    # ------------------------------------------------------------------
+    # accounting (callers hold _mu)
+    # ------------------------------------------------------------------
+
+    def _credit(self, ent: _Entry) -> None:
+        for d, n in ent.bytes_by_device.items():
+            r = self._resident.get(d, 0) + n
+            self._resident[d] = r
+            if r > self._max_resident.get(d, 0):
+                self._max_resident[d] = r
+            if ent.pins > 0:
+                self._pinned[d] = self._pinned.get(d, 0) + n
+        self._cat_bytes[ent.category] = (
+            self._cat_bytes.get(ent.category, 0) + ent.nbytes
+        )
+
+    def _debit(self, ent: _Entry) -> None:
+        for d, n in ent.bytes_by_device.items():
+            self._resident[d] = max(0, self._resident.get(d, 0) - n)
+            if ent.pins > 0:
+                self._pinned[d] = max(0, self._pinned.get(d, 0) - n)
+        self._cat_bytes[ent.category] = max(
+            0, self._cat_bytes.get(ent.category, 0) - ent.nbytes
+        )
+
+    def _dev_stat(self, dev):
+        c = self._dev_stats.get(dev)
+        if c is None:
+            c = self.stats.with_tags(f"device:{_device_label(dev)}")
+            self._dev_stats[dev] = c
+        return c
+
+    def _publish_locked(self, devices) -> None:
+        for d in devices:
+            self._dev_stat(d).gauge(
+                "device.residentBytes", float(self._resident.get(d, 0))
+            )
+        self.stats.gauge(
+            "device.cacheBytes", float(self._cat_bytes.get("cache", 0))
+        )
+
+    # ------------------------------------------------------------------
+    # prefetch bookkeeping (incremented by device/prefetch.py)
+    # ------------------------------------------------------------------
+
+    def count_prefetch(self, hit: int = 0, miss: int = 0) -> None:
+        with self._mu:
+            self._prefetch_hits += hit
+            self._prefetch_misses += miss
+        if hit:
+            self.stats.count("device.prefetch.hit", hit)
+        if miss:
+            self.stats.count("device.prefetch.miss", miss)
+
+    # ------------------------------------------------------------------
+    # introspection
+    # ------------------------------------------------------------------
+
+    @property
+    def evictions(self) -> int:
+        return self._evictions
+
+    def resident_bytes(self, dev=None) -> int:
+        with self._mu:
+            if dev is not None:
+                return self._resident.get(dev, 0)
+            return sum(self._resident.values())
+
+    def max_resident_bytes(self, dev=None) -> int:
+        with self._mu:
+            if dev is not None:
+                return self._max_resident.get(dev, 0)
+            return max(self._max_resident.values(), default=0)
+
+    def snapshot(self) -> dict:
+        """JSON-ready state for ``GET /debug/hbm``: per-device budget /
+        resident / pinned / high-water bytes with each device's entries
+        (LRU -> MRU), a flat per-fragment residency table, and the
+        eviction/prefetch counters."""
+        budget = self.budget_bytes()
+        with self._mu:
+            per_dev: dict = {}
+            fragments: list[dict] = []
+            for ent in self._entries.values():  # LRU -> MRU order
+                row = {
+                    "kind": ent.category,
+                    "bytes": ent.nbytes,
+                    "pinned": ent.pins > 0,
+                }
+                row.update(ent.info)
+                for d, n in ent.bytes_by_device.items():
+                    dd = per_dev.setdefault(
+                        d,
+                        {
+                            "device": _device_label(d),
+                            "budget_bytes": budget,
+                            "resident_bytes": self._resident.get(d, 0),
+                            "pinned_bytes": self._pinned.get(d, 0),
+                            "max_resident_bytes": self._max_resident.get(d, 0),
+                            "entries": [],
+                        },
+                    )
+                    dd["entries"].append(dict(row, bytes=n))
+                if "fragment" in ent.info:
+                    fragments.append(
+                        dict(
+                            row,
+                            devices=[
+                                _device_label(d) for d in ent.bytes_by_device
+                            ],
+                        )
+                    )
+            return {
+                "budget_bytes": budget,
+                "cache_bytes": self._cat_bytes.get("cache", 0),
+                "devices": sorted(
+                    per_dev.values(), key=lambda d: d["device"]
+                ),
+                "fragments": fragments,
+                "counters": {
+                    "evictions": self._evictions,
+                    "evictSkipped": self._evict_skipped,
+                    "overBudget": self._over_budget,
+                    "prefetchHit": self._prefetch_hits,
+                    "prefetchMiss": self._prefetch_misses,
+                },
+            }

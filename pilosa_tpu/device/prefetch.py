@@ -1,0 +1,119 @@
+"""Async mirror prefetcher — overlap host->device staging with plan work.
+
+A planned query's leaf fragments are known before its batch assembles
+(exec/plan.py `collect_leaf_calls` + the executor's resolution walk);
+any of them whose HBM mirror is cold would otherwise re-upload
+serially, one 2-8 MiB `device_put` at a time, inside the assembly loop.
+The prefetcher re-materializes those cold mirrors CONCURRENTLY on their
+home devices — transfers to distinct devices genuinely overlap, and
+even same-device uploads overlap the executor's host-side planning.
+
+Workers call the same ``Fragment.device_plane()`` the query path uses,
+so admission, budget eviction, and coherence all ride the fragment lock
+— a prefetch can never produce a stale mirror, and the assembly thread
+that reaches a fragment mid-upload simply blocks on that fragment's
+lock until its mirror is ready (the overlap is across fragments, not
+within one).
+
+Threads are daemons for the same reason the executor's pool uses them:
+a worker wedged inside a device call must degrade to a lost prefetch,
+never a process that cannot exit.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+DEFAULT_WORKERS = 8
+
+
+class Prefetcher:
+    """Re-materialize cold fragment mirrors in background threads.
+
+    ``pool`` supplies the hit/miss counters and is usually the global
+    ``pilosa_tpu.device.pool()`` (the default when None).
+    """
+
+    def __init__(self, pool=None, max_workers: int = DEFAULT_WORKERS):
+        self._pool = pool
+        self._max_workers = max_workers
+        self._work: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._threads: list[threading.Thread] = []
+        self._idle = 0
+        self._mu = threading.Lock()
+
+    def pool(self):
+        if self._pool is not None:
+            return self._pool
+        from pilosa_tpu import device as device_mod
+
+        return device_mod.pool()
+
+    def prefetch(self, frags, wait: bool = False) -> int:
+        """Schedule uploads for every COLD fragment in ``frags``;
+        already-resident mirrors count as prefetch hits.  Returns the
+        number scheduled.  ``wait=True`` blocks until every scheduled
+        upload finished (tests and the bench use it; the executor fires
+        and forgets — per-fragment locks provide the synchronization)."""
+        pool = self.pool()
+        cold = []
+        hits = 0
+        for f in frags:
+            if f is None:
+                continue
+            # Advisory peek (no lock): a racing writer only flips a
+            # fragment cold, and the worker re-checks under the lock.
+            if f._device is not None and f._device_version == f._version:
+                hits += 1
+            else:
+                cold.append(f)
+        if hits:
+            pool.count_prefetch(hit=hits)
+        if not cold:
+            return 0
+        done = threading.Event()
+        remaining = [len(cold)]
+        rlock = threading.Lock()
+        for f in cold:
+            self._submit(f, pool, remaining, rlock, done)
+        if wait:
+            done.wait()
+        return len(cold)
+
+    # ------------------------------------------------------------------
+
+    def _submit(self, frag, pool, remaining, rlock, done) -> None:
+        with self._mu:
+            self._work.put((frag, pool, remaining, rlock, done))
+            if self._idle == 0 and len(self._threads) < self._max_workers:
+                t = threading.Thread(
+                    target=self._worker, daemon=True, name="hbm-prefetch"
+                )
+                self._threads.append(t)
+                t.start()
+
+    def _worker(self) -> None:
+        while True:
+            with self._mu:
+                self._idle += 1
+            item = self._work.get()
+            with self._mu:
+                self._idle -= 1
+            frag, pool, remaining, rlock, done = item
+            try:
+                was_cold = (
+                    frag._device is None
+                    or frag._device_version != frag._version
+                )
+                frag.device_plane()
+                pool.count_prefetch(
+                    hit=0 if was_cold else 1, miss=1 if was_cold else 0
+                )
+            except Exception:  # noqa: BLE001 — prefetch is best-effort;
+                pass  # the query path re-raises any real failure itself
+            finally:
+                with rlock:
+                    remaining[0] -= 1
+                    if remaining[0] == 0:
+                        done.set()

@@ -25,6 +25,7 @@ TPU-native execution differs in structure, not results:
 from __future__ import annotations
 
 import contextvars
+import functools
 import threading
 import time
 import queue
@@ -37,6 +38,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pilosa_tpu import device as device_mod
 from pilosa_tpu.cluster.topology import Cluster, Node
 from pilosa_tpu.parallel import mesh as pmesh
 from pilosa_tpu.core import cache as cache_mod
@@ -227,6 +229,7 @@ class Executor:
         client_factory=None,
         max_writes_per_request: int = DEFAULT_MAX_WRITES_PER_REQUEST,
         tracer=None,
+        prefetcher=None,
     ):
         self.holder = holder
         self.host = host
@@ -234,6 +237,11 @@ class Executor:
         self.client_factory = client_factory
         self.max_writes_per_request = max_writes_per_request
         self.tracer = tracer or trace.NOP_TRACER
+        # Async HBM mirror prefetcher (device/prefetch.py): when wired
+        # (Server does, gated on [device] prefetch), a query's cold leaf
+        # mirrors re-materialize concurrently while planning proceeds.
+        # None = disabled (bare library use stays fully deterministic).
+        self.prefetcher = prefetcher
         # (expr, reduce, batch shape) programs this executor has already
         # dispatched — distinguishes compile-bearing first calls from
         # pure execution in the device span annotations.
@@ -248,7 +256,9 @@ class Executor:
         # walks, union assembly, and gather prep cached per (query,
         # slice set), validated like _batch_cache entries.
         self._topn_cache: "OrderedDict[tuple, dict]" = OrderedDict()
-        # slice->node grouping LRU (see _slices_by_node).
+        # slice->node grouping LRU (see _slices_by_node) — host-only
+        # dicts, no device bytes, so unlike the two caches above it is
+        # NOT a residency-pool tenant; the count cap bounds it.
         self._slice_group_cache: "OrderedDict[tuple, dict]" = OrderedDict()
         # A fragment leaving service (delete/teardown) must release the
         # TopN prep entries pinning its HBM plane snapshots now, not at
@@ -258,6 +268,18 @@ class Executor:
     def close(self) -> None:
         fragment_mod.unregister_close_listener(self._drop_closed_fragment)
         self._pool.shutdown(wait=False, cancel_futures=True)
+        # Deregister every cache entry from the residency pool so a
+        # closed executor's device arrays stop counting as resident.
+        pool = device_mod.pool()
+        with self._batch_mu:
+            batch_keys = list(self._batch_cache)
+            topn_keys = list(self._topn_cache)
+            self._batch_cache.clear()
+            self._topn_cache.clear()
+        for k in batch_keys:
+            pool.remove(self._batch_pool_key(k))
+        for k in topn_keys:
+            pool.remove(self._topn_pool_key(k))
 
     def _drop_closed_fragment(self, frag) -> None:
         with self._batch_mu:
@@ -268,6 +290,56 @@ class Executor:
             ]
             for k in stale:
                 del self._topn_cache[k]
+        for k in stale:
+            device_mod.pool().remove(self._topn_pool_key(k))
+
+    # ------------------------------------------------------------------
+    # HBM residency-pool tenancy (device/pool.py): both device-holding
+    # caches are byte-accounted pool tenants — the pool's LRU eviction
+    # (not just the entry-count caps) bounds their device footprint.
+    # ------------------------------------------------------------------
+
+    def _batch_pool_key(self, key: tuple) -> tuple:
+        return ("exec", id(self), "batch", key)
+
+    def _topn_pool_key(self, key: tuple) -> tuple:
+        return ("exec", id(self), "topn", key)
+
+    def _register_cache_entry(self, pool_key, arrays, info, evict):
+        """Admit a cache entry's device arrays to the residency pool;
+        returns the pool key, or None when nothing lives on device."""
+        bbd: dict = {}
+        for arr in arrays:
+            for d, n in device_mod.bytes_by_device(arr).items():
+                bbd[d] = bbd.get(d, 0) + n
+        if not bbd:
+            return None
+        device_mod.pool().admit(
+            pool_key, bbd, evict, category="cache", info=info
+        )
+        return pool_key
+
+    def _evict_batch_key(self, key: tuple) -> bool:
+        """Pool eviction hook for a batch-cache entry.  Non-blocking:
+        the insert path holds ``_batch_mu`` while it calls into the
+        pool, so a blocking acquire here could deadlock — skipping a
+        busy cache is always safe."""
+        if not self._batch_mu.acquire(blocking=False):
+            return False
+        try:
+            self._batch_cache.pop(key, None)
+            return True
+        finally:
+            self._batch_mu.release()
+
+    def _evict_topn_key(self, key: tuple) -> bool:
+        if not self._batch_mu.acquire(blocking=False):
+            return False
+        try:
+            self._topn_cache.pop(key, None)
+            return True
+        finally:
+            self._batch_mu.release()
 
     # ------------------------------------------------------------------
     # entry point (reference: executor.go:65-151)
@@ -310,6 +382,12 @@ class Executor:
         if q.calls and all(c.name == "SetRowAttrs" for c in q.calls):
             return self._execute_bulk_set_row_attrs(index, q.calls, opt)
 
+        # Async HBM prefetch: kick cold leaf-mirror uploads for the whole
+        # query now, so host->device staging overlaps the per-call
+        # planning below (per-fragment locks synchronize the rendezvous).
+        if self.prefetcher is not None and slices:
+            self._prefetch_query(index, q.calls, slices)
+
         results = []
         for call in q.calls:
             call_slices = slices
@@ -325,6 +403,61 @@ class Executor:
                     self._execute_call(index, call, call_slices, opt)
                 )
         return results
+
+    def _prefetch_query(self, index: str, calls, slices: list[int]) -> None:
+        """Walk the query's leaf fragments (exec/plan tree + TopN frame)
+        and schedule cold-mirror uploads on the prefetcher.  Strictly
+        best-effort: any resolution error here is swallowed — the call's
+        own execution raises the authoritative error.  Frame/view
+        resolution is hoisted out of the per-slice loop and only COLD
+        fragments collect, so the all-warm steady state costs one dict
+        lookup + two attribute compares per existing fragment."""
+        frags: list = []
+        seen: set[int] = set()
+
+        def add_view(frame_name: str, view_name: str) -> None:
+            v = self.holder.view(index, frame_name, view_name)
+            if v is None:
+                return
+            have = v.fragment_slices()
+            for s in slices:
+                if s not in have:
+                    continue
+                frag = v.fragment(s)
+                if frag is None or id(frag) in seen:
+                    continue
+                # Advisory cold check (no lock): a racing writer only
+                # flips a mirror cold; the worker re-checks under the
+                # fragment lock.
+                if (
+                    frag._device is None
+                    or frag._device_version != frag._version
+                ):
+                    seen.add(id(frag))
+                    frags.append(frag)
+
+        try:
+            idx = self.holder.index(index)
+            if idx is None:
+                return
+            for call in calls:
+                if call.name in WRITE_CALLS:
+                    continue
+                for leaf in plan.collect_leaf_calls(call):
+                    if leaf.name != "Bitmap":
+                        continue
+                    frame = leaf.args.get("frame") or DEFAULT_FRAME
+                    _, col_ok = _uint_arg(leaf, idx.column_label)
+                    add_view(
+                        frame, VIEW_INVERSE if col_ok else VIEW_STANDARD
+                    )
+                if call.name == "TopN":
+                    add_view(*self._topn_frame_view(call))
+        except Exception:  # noqa: BLE001 — prefetch must never fail a query
+            return
+        if frags:
+            with self.tracer.span("prefetch", fragments=len(frags)):
+                self.prefetcher.prefetch(frags)
 
     # ------------------------------------------------------------------
     # dispatch (reference: executor.go:156-182)
@@ -548,8 +681,11 @@ class Executor:
         return expr, stacks, kept_slices, empties
 
     # Assembled leaf batches kept per (index, canonical call, slice set):
-    # the working set of a hot query is one entry, and each holds device
-    # memory comparable to the queried planes.
+    # the working set of a hot query is one entry.  Each entry holds
+    # device memory comparable to the queried planes, so entries are
+    # byte-accounted residency-pool tenants (device/pool.py) — under an
+    # HBM budget the pool's LRU eviction, not this count cap, is the
+    # operative bound; the cap remains as the unbounded-budget backstop.
     _BATCH_CACHE_CAP = 4
 
     def _cached_batch(self, index: str, c: Call, slices: list[int]):
@@ -590,6 +726,7 @@ class Executor:
                     with self._batch_mu:
                         if key in self._batch_cache:
                             self._batch_cache.move_to_end(key)
+                    device_mod.pool().touch(self._batch_pool_key(key))
                     sp.annotate(batch_cache="hit")
                     return ent
 
@@ -663,10 +800,22 @@ class Executor:
                     pos_of={s: i for i, s in enumerate(kept_slices)},
                 )
         if cacheable:
+            displaced = []
             with self._batch_mu:
                 self._batch_cache[key] = ent
                 while len(self._batch_cache) > self._BATCH_CACHE_CAP:
-                    self._batch_cache.popitem(last=False)
+                    displaced.append(self._batch_cache.popitem(last=False)[0])
+            # Pool tenancy OUTSIDE _batch_mu: admission may evict other
+            # tenants, whose callbacks take _batch_mu non-blocking.
+            pool = device_mod.pool()
+            for k in displaced:
+                pool.remove(self._batch_pool_key(k))
+            ent["pool_key"] = self._register_cache_entry(
+                self._batch_pool_key(key),
+                [ent["batch"]],
+                {"cache": "batch", "index": index, "query": str(c)},
+                functools.partial(self._evict_batch_key, key),
+            )
         return ent
 
     def _assemble_mesh_batch_host(self, index: str, leaves, slices, mesh):
@@ -843,7 +992,11 @@ class Executor:
         if ent["batch"] is None:
             return out
 
-        with self._device_span(ent, reduce):
+        # Pin lease for the duration of the fused program: the pool may
+        # not evict the batch out from under the dispatch+fetch.
+        with device_mod.pool().pinned(ent.get("pool_key")), self._device_span(
+            ent, reduce
+        ):
             if ent["mesh"] is not None:
                 # plain-XLA formulation: partitions cleanly under SPMD
                 res = jax.device_get(
@@ -893,7 +1046,9 @@ class Executor:
             return 0
         kept_slices = ent["kept"]
 
-        with self._device_span(ent, "count"):
+        with device_mod.pool().pinned(ent.get("pool_key")), self._device_span(
+            ent, "count"
+        ):
             if ent["mesh"] is not None:
                 # Zero pad slices contribute nothing, so the budget is on
                 # the real slice count, not the padded batch size.
@@ -1247,13 +1402,16 @@ class Executor:
                 # served again (the expiry below), and each pins an HBM
                 # plane snapshot via its SubRefs — dead entries must not
                 # hold device memory until LRU displacement.
-                for k in [
+                expired = [
                     k
                     for k, e in self._topn_cache.items()
                     if now - e["built_at"] >= cache_mod.RECALCULATE_INTERVAL_S
-                ]:
+                ]
+                for k in expired:
                     del self._topn_cache[k]
                 ent = self._topn_cache.get(key)
+            for k in expired:
+                device_mod.pool().remove(self._topn_pool_key(k))
             # Entries also EXPIRE on the rank caches' re-sort throttle:
             # candidate counts come from the ranked caches, whose
             # throttled re-sort (RECALCULATE_INTERVAL_S) happens inside
@@ -1273,6 +1431,7 @@ class Executor:
                     with self._batch_mu:
                         if key in self._topn_cache:
                             self._topn_cache.move_to_end(key)
+                    device_mod.pool().touch(self._topn_pool_key(key))
                     return ent
                 # Version validation failed: the entry can never serve
                 # again (a deleted or rewritten fragment), yet its
@@ -1281,6 +1440,7 @@ class Executor:
                 with self._batch_mu:
                     if self._topn_cache.get(key) is ent:
                         del self._topn_cache[key]
+                device_mod.pool().remove(self._topn_pool_key(key))
         # Capture validity BEFORE building: a concurrent write during
         # the build leaves the entry conservatively stale.  The vector
         # computed for the failed validation (if any) is reused — it
@@ -1298,10 +1458,23 @@ class Executor:
         ent["versions"] = versions
         ent["built_at"] = time.monotonic()
         if cacheable:
+            displaced = []
             with self._batch_mu:
                 self._topn_cache[key] = ent
                 while len(self._topn_cache) > self._TOPN_CACHE_CAP:
-                    self._topn_cache.popitem(last=False)
+                    displaced.append(self._topn_cache.popitem(last=False)[0])
+            pool = device_mod.pool()
+            for k in displaced:
+                pool.remove(self._topn_pool_key(k))
+            # Byte-account the entry's HBM plane snapshots (SubRefs):
+            # the pool, not the entry-count cap, now bounds how much
+            # device memory TopN prep keeps alive.
+            self._register_cache_entry(
+                self._topn_pool_key(key),
+                [p[5].plane for p in ent.get("parts", ()) if p[5] is not None],
+                {"cache": "topn", "index": index, "query": str(c)},
+                functools.partial(self._evict_topn_key, key),
+            )
         return ent
 
     def _topn_folded_build(self, index: str, c: Call, slices: list[int]) -> dict:
@@ -1431,7 +1604,13 @@ class Executor:
             st = replace(st_proto, counts=None, dev_counts=None)
             states.append((frag, topt, cand_ids, cand_mask, st))
             score_parts.append((st, sub_ref, srcw, src_slot))
-        self._score_topn_parts(score_parts)
+        # Pin the prep entry and every scored fragment's mirror for the
+        # fused scorer's dispatch+fetch: the pool may evict none of the
+        # planes this program reads mid-query.
+        pin_keys = [self._topn_pool_key((index, str(c), tuple(slices)))]
+        pin_keys += [p[0]._pool_key for p in ent["parts"]]
+        with device_mod.pool().pinned(*pin_keys):
+            self._score_topn_parts(score_parts)
 
         # Phase-1 winner selection per slice, from the same scores the
         # two-phase protocol's first round would have produced for the
@@ -1509,12 +1688,15 @@ class Executor:
                 for s in local_slices
             ]
             states = [p for p in prepped if p is not None]
-            self._score_topn_parts(
-                [
-                    self._attach_dev_src(index, c, frag, part)
-                    for frag, part in states
-                ]
-            )
+            with device_mod.pool().pinned(
+                *[frag._pool_key for frag, _ in states]
+            ):
+                self._score_topn_parts(
+                    [
+                        self._attach_dev_src(index, c, frag, part)
+                        for frag, part in states
+                    ]
+                )
             states = [(frag, part[0]) for frag, part in states]
             # Merge all slices' results in one numpy pass (counts sum
             # by id — Pairs.Add semantics, reference: cache.go:312-334);

@@ -63,6 +63,27 @@ def decompose(call: Call) -> tuple[tuple, list[Call]]:
     return rec(call), leaves
 
 
+def collect_leaf_calls(call: Call) -> list[Call]:
+    """Every Bitmap/Range leaf reachable under ``call``, crossing
+    non-bitmap wrappers (Count's child, TopN's src tree) — the
+    prefetcher's walk (device/prefetch.py).  Unlike :func:`decompose`
+    it never raises on unknown interior calls: the prefetcher only
+    needs the leaves' (frame, view, row) identities to re-materialize
+    cold mirrors, not a valid bitmap expression, so anything
+    unrecognized just recurses into its children."""
+    out: list[Call] = []
+
+    def rec(c: Call) -> None:
+        if c.name in LEAF_CALLS:
+            out.append(c)
+            return
+        for ch in c.children:
+            rec(ch)
+
+    rec(call)
+    return out
+
+
 def _eval_expr(expr: tuple, leaves):
     if expr[0] == "leaf":
         return leaves[expr[1]]

@@ -25,6 +25,7 @@ clients of the reference server work unchanged:
   GET/POST /fragment/data                   fragment tar backup/restore
   GET    /fragment/blocks /fragment/block/data   sync checksums / block dump
   GET    /debug/vars /debug/pprof/          expvar metrics / profiling info
+  GET    /debug/hbm                         HBM residency (budget/resident/pinned)
 
 The handler itself is transport-independent: ``Handler.dispatch`` maps a
 parsed request to a ``Response``; ``serve`` mounts it on a stdlib
@@ -207,6 +208,7 @@ class Handler:
             ("POST", r"/fragment/import-view", self.handle_post_import_view),
             ("GET", r"/fragment/block/data", self.handle_get_fragment_block_data),
             ("GET", r"/debug/vars", self.handle_get_vars),
+            ("GET", r"/debug/hbm", self.handle_get_hbm),
             ("GET", r"/debug/traces", self.handle_get_traces),
             ("GET", r"/metrics", self.handle_get_metrics),
             ("GET", r"/debug/pprof(?P<rest>/.*)?", self.handle_get_pprof),
@@ -879,6 +881,15 @@ class Handler:
         if self.stats is not None and hasattr(self.stats, "snapshot"):
             payload["stats"] = self.stats.snapshot()
         return Response.json(payload)
+
+    def handle_get_hbm(self, req: Request) -> Response:
+        """HBM residency (device/pool.py): per-device budget / resident
+        / pinned / high-water bytes with each device's LRU-ordered
+        entries, a per-fragment residency table, and the eviction /
+        prefetch counters."""
+        from pilosa_tpu import device as device_mod
+
+        return Response.json(device_mod.pool().snapshot())
 
     def handle_get_traces(self, req: Request) -> Response:
         """The tracer's retained query traces as JSON; ``?min_ms=``

@@ -52,6 +52,8 @@ class Server:
         stream_chunk_bytes: int = 0,
         slow_query_ms: float = 0.0,
         trace_ring: int = 64,
+        hbm_budget_bytes: int = 0,
+        device_prefetch: bool = True,
     ):
         self.data_dir = data_dir
         self.host = host
@@ -75,6 +77,11 @@ class Server:
         # structured slow-query log line per over-threshold query.
         self.tracer = Tracer(capacity=trace_ring)
         self.slow_query_ms = slow_query_ms
+        # HBM residency manager ([device] config): per-device budget for
+        # pool-registered device memory (0 = auto), plus the async
+        # cold-mirror prefetcher toggle.
+        self.hbm_budget_bytes = hbm_budget_bytes
+        self.device_prefetch = device_prefetch
 
         self.holder = Holder(data_dir)
         self.executor: Executor | None = None
@@ -103,6 +110,18 @@ class Server:
         # Route storage-layer notices (e.g. op-log tail repairs on
         # fragment open) through the server's configured logger.
         self.holder.logger = self.logger
+        # Configure the process-global HBM residency pool before any
+        # fragment opens (device mirrors register on first upload): the
+        # budget bounds mirrors, paged sparse rows, and executor caches;
+        # gauges/counters flow through the server's stats client and
+        # evict/prefetch spans into its tracer.
+        from pilosa_tpu import device as device_mod
+
+        device_mod.pool().configure(
+            budget_bytes=self.hbm_budget_bytes,
+            stats=self.stats,
+            tracer=self.tracer,
+        )
         # Cold-start elimination (see exec/warmup.py): persistent XLA
         # compile cache so restarts deserialize programs from disk, and
         # a background pre-warm of the standard query shapes so even a
@@ -206,6 +225,9 @@ class Server:
             cluster=self.cluster,
             client_factory=client_factory,
             tracer=self.tracer,
+            prefetcher=(
+                device_mod.prefetcher() if self.device_prefetch else None
+            ),
             **kwargs,
         )
         self.handler.executor = self.executor
