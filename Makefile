@@ -5,7 +5,7 @@
 
 PYTHON ?= python
 
-.PHONY: default test lint check bench install build docker clean generate
+.PHONY: default test lint check bench bench-smoke install build docker clean generate
 
 default: build test
 
@@ -37,6 +37,12 @@ install:
 # accelerator when one is reachable, else re-execs onto the CPU backend.
 bench:
 	$(PYTHON) bench.py
+
+# Tiny CPU-only bench pass (seconds, few slices): asserts the JSON
+# artifact parses and the coalesce counters are present.  Non-blocking
+# in CI (.github/workflows/check.yml).
+bench-smoke:
+	$(PYTHON) tools/bench_smoke.py
 
 docker:
 	docker build -t pilosa-tpu .

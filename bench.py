@@ -478,8 +478,9 @@ def main() -> None:
     # A real Holder with 954 fragments; the query arrives as PQL text and
     # runs the full dispatch: parse -> leaf resolution -> batch assembly
     # (cached across queries) -> fused program -> reduce.
+    coalesce_stats = None
     try:
-        e2e_s = with_retries(
+        e2e_s, coalesce_stats = with_retries(
             "e2e executor tier",
             lambda: run_executor_tiers(
                 leaves, host_count, rng, dev_s, cpu_fallback
@@ -558,6 +559,8 @@ def main() -> None:
             out["raw_kernel_pct_hbm_peak"] = round(
                 bytes_per_query / dev_s / 1e9 * 1e9 / hbm_peak * 100, 2
             )
+    if coalesce_stats is not None:
+        out["coalesce"] = coalesce_stats
     if hbm_pressure is not None:
         out["hbm_pressure"] = hbm_pressure
     print(json.dumps(out))
@@ -718,9 +721,11 @@ def run_hbm_pressure_tier(rng, cpu_fb=False) -> dict:
         return out
 
 
-def run_executor_tiers(leaves, host_count, rng, dev_s, cpu_fb=False) -> float:
-    """Executor tiers; returns the e2e per-query seconds under
-    concurrent load (the throughput the north-star metric names).
+def run_executor_tiers(leaves, host_count, rng, dev_s, cpu_fb=False):
+    """Executor tiers; returns ``(e2e_s, coalesce_stats)`` — the e2e
+    per-query seconds under concurrent load (the throughput the
+    north-star metric names) and the coalescer's per-tier launch /
+    occupancy record for the artifact.
 
     ``dev_s`` may be None when the raw-kernel slope was unreliable (the
     "x raw kernel" annotations degrade gracefully).  ``cpu_fb`` is
@@ -730,12 +735,43 @@ def run_executor_tiers(leaves, host_count, rng, dev_s, cpu_fb=False) -> float:
     import jax  # noqa: F401 — backend already up
     # One trim policy for every fallback-shortened tier.
     trim = dict(n_serial=2, trials=1) if cpu_fb else dict(n_serial=8, trials=3)
+    from pilosa_tpu.exec.coalesce import CoalesceScheduler
     from pilosa_tpu.exec.executor import Executor
     from pilosa_tpu.pql.parser import parse_string
 
+    # The coalescer under test is the production configuration: the
+    # concurrent tiers below are exactly the query storms it exists for,
+    # and its launches/occupancy land in the artifact so the perf
+    # trajectory shows WHERE the throughput came from.
+    co = CoalesceScheduler()
+    coalesce_stats = {"tiers": {}}
+
+    def co_tier(label: str, queries: int, before: dict) -> dict:
+        snap = co.snapshot()
+        launches = snap["launches"] - before["launches"]
+        qn = snap["queries"] - before["queries"]
+        tier = {
+            "launches": launches,
+            "coalesced_queries": qn,
+            "mean_batch_occupancy": (
+                round(qn / launches, 2) if launches else None
+            ),
+            "dispatches_per_query": (
+                round(launches / queries, 3) if queries else None
+            ),
+            "pad_rows": snap["pad_rows"] - before["pad_rows"],
+        }
+        coalesce_stats["tiers"][label] = tier
+        log(
+            f"coalesce {label}: {launches} launches for {qn} queries ->"
+            f" mean occupancy {tier['mean_batch_occupancy']},"
+            f" {tier['dispatches_per_query']} dispatches/query"
+        )
+        return snap
+
     with tempfile.TemporaryDirectory() as d:
         holder = build_holder(leaves, d)
-        ex = Executor(holder, host="localhost:0")
+        ex = Executor(holder, host="localhost:0", coalescer=co)
         pq = parse_string("Count(Intersect(Bitmap(rowID=1, frame=f), Bitmap(rowID=2, frame=f)))")
         t0 = time.perf_counter()
         (got,) = ex.execute("i", pq)
@@ -751,8 +787,10 @@ def run_executor_tiers(leaves, host_count, rng, dev_s, cpu_fb=False) -> float:
         def check_count(res):
             assert int(res[0]) == host_count, f"e2e bit-exactness: {res[0]}"
 
+        co_before = co.snapshot()
+        n_conc_16 = 16 if cpu_fb else 48
         p50, e2e_16, conc_p50 = measure_query(
-            ex, "i", pq, check_count, n_conc=16 if cpu_fb else 48, **trim
+            ex, "i", pq, check_count, n_conc=n_conc_16, **trim
         )
         log(
             f"e2e executor Intersect+Count: sync p50 {p50*1e3:.2f} ms/query"
@@ -760,6 +798,11 @@ def run_executor_tiers(leaves, host_count, rng, dev_s, cpu_fb=False) -> float:
             f" ms/query throughput, p50 latency under load"
             f" {conc_p50*1e3:.2f} ms"
             + (f" ({e2e_16/dev_s:.2f}x raw kernel)" if dev_s else "")
+        )
+        co_before = co_tier(
+            "count_concurrent_16",
+            trim["n_serial"] + trim["trials"] * n_conc_16,
+            co_before,
         )
         # N threads x ~70 ms tunnel RTT floor throughput at ~70/N
         # ms/query REGARDLESS of engine speed (r03's 4.61 ms at 16
@@ -777,6 +820,9 @@ def run_executor_tiers(leaves, host_count, rng, dev_s, cpu_fb=False) -> float:
                 f"e2e executor Intersect+Count CONCURRENT({threads}):"
                 f" {per_q*1e3:.2f} ms/query throughput"
                 + (f" ({per_q/dev_s:.2f}x raw kernel)" if dev_s else "")
+            )
+            co_before = co_tier(
+                f"count_concurrent_{threads}", 3 * 3 * threads, co_before
             )
         best_t = min(tiers, key=tiers.get)
         e2e_s = tiers[best_t]
@@ -881,8 +927,17 @@ def run_executor_tiers(leaves, host_count, rng, dev_s, cpu_fb=False) -> float:
                 f" ms/query throughput"
             )
         ex.close()
+        co.close()
         holder.close()
-    return e2e_s
+    coalesce_stats["total"] = co.snapshot()
+    log(
+        f"coalesce total: {coalesce_stats['total']['launches']} launches"
+        f" for {coalesce_stats['total']['queries']} coalesced queries"
+        f" (mean occupancy {coalesce_stats['total']['mean_occupancy']},"
+        f" max {coalesce_stats['total']['max_occupancy']},"
+        f" pad rows {coalesce_stats['total']['pad_rows']})"
+    )
+    return e2e_s, coalesce_stats
 
 
 if __name__ == "__main__":

@@ -111,6 +111,9 @@ def build_server(cfg: config_mod.Config):
         trace_ring=cfg.obs.trace_ring,
         hbm_budget_bytes=cfg.device.hbm_budget_bytes,
         device_prefetch=cfg.device.prefetch,
+        coalesce=cfg.exec.coalesce,
+        coalesce_max_batch=cfg.exec.coalesce_max_batch,
+        coalesce_max_wait_us=cfg.exec.coalesce_max_wait_us,
     )
 
 
@@ -221,6 +224,41 @@ def _start_cpu_profile(path: str, seconds: int):
                 print(f"warning: cpu profile write failed: {e}", file=sys.stderr)
 
     return _stop
+
+
+def run_warm(args) -> int:
+    """Offline compile warm-up: populate the persistent XLA compile
+    cache with the standard query-shape programs AND the coalescer's
+    power-of-two bucket shapes, so a subsequently started server (or
+    the next process on this machine) answers its first queries — and
+    its first coalesced batches — without a multi-second cold compile.
+    Honors the config's `[tpu] compilation-cache-dir` resolution; the
+    warm is wasted (in-process only) when the cache is disabled, which
+    is reported."""
+    from pilosa_tpu.exec import warmup
+
+    cfg = config_mod.load(args.config or None)
+    cache_dir = _resolve_cache_dir(cfg)
+    if cache_dir and warmup.enable_compile_cache(cache_dir):
+        print(f"compilation cache: {warmup.enabled_cache_dir()}", file=sys.stderr)
+    else:
+        print(
+            "warning: persistent compile cache disabled; warming only "
+            "this process's in-memory jit cache",
+            file=sys.stderr,
+        )
+    t0 = time.monotonic()
+    n = warmup.prewarm(coalesce=cfg.exec.coalesce)
+    if not cfg.exec.coalesce:
+        print(
+            "note: [exec] coalesce is off; coalescer buckets not warmed",
+            file=sys.stderr,
+        )
+    print(
+        f"warmed {n} query programs in {time.monotonic() - t0:.1f}s",
+        file=sys.stderr,
+    )
+    return 0
 
 
 # ---------------------------------------------------------------------------

@@ -45,6 +45,14 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.set_defaults(fn=ctl.run_server)
 
+    p = sub.add_parser(
+        "warm",
+        help="pre-compile standard + coalescer query programs into the "
+        "persistent compile cache",
+    )
+    p.add_argument("-c", "--config", default="", help="TOML config file")
+    p.set_defaults(fn=ctl.run_warm)
+
     p = sub.add_parser("import", help="bulk-import CSV bits (row,col[,ts])")
     _add_host(p)
     p.add_argument("-i", "--index", required=True)

@@ -48,6 +48,7 @@ from pilosa_tpu.core.cache import Pair
 from pilosa_tpu.core import fragment as fragment_mod
 from pilosa_tpu.core.fragment import TopOptions
 from pilosa_tpu.core.view import VIEW_INVERSE, VIEW_STANDARD
+from pilosa_tpu.exec import coalesce as coalesce_mod
 from pilosa_tpu.exec import plan
 from pilosa_tpu.exec import warmup
 from pilosa_tpu.obs import trace
@@ -149,7 +150,9 @@ class _DaemonPool:
     daemon workers die with the process.  Futures are the ordinary
     concurrent.futures kind, so wait()/as_completed compose."""
 
-    def __init__(self, max_workers: int):
+    def __init__(self, max_workers: int, stats=None):
+        from pilosa_tpu.obs.stats import NopStatsClient
+
         self._max_workers = max_workers
         self._work: "queue.SimpleQueue" = queue.SimpleQueue()
         self._threads: list[threading.Thread] = []
@@ -157,6 +160,23 @@ class _DaemonPool:
         self._mu = threading.Lock()
         self._shutdown = False
         self._cancel_pending = False
+        # Pool visibility (/metrics): queued-but-unclaimed items, items
+        # being run right now, and total worker threads ever spawned —
+        # without these the pool's contribution to query latency is
+        # unattributable (and coalescing wins invisible).
+        self.stats = stats or NopStatsClient()
+        self._depth = 0
+        self._active = 0
+        # Zero-publish up front: an idle pool is visible in /metrics
+        # from boot, not only after its first fan-out.
+        self._publish()
+
+    def _publish(self) -> None:
+        # Advisory reads outside _mu: gauges are monotonic snapshots,
+        # and a stats backend must never extend the pool's critical
+        # section.
+        self.stats.gauge("exec.pool.queueDepth", float(self._depth))
+        self.stats.gauge("exec.pool.activeWorkers", float(self._active))
 
     def submit(self, fn, *args, **kwargs) -> Future:
         fut: Future = Future()
@@ -164,10 +184,12 @@ class _DaemonPool:
         # spans started in a mapper attach to the submitting request's
         # trace (obs/trace.py keeps the current span in a ContextVar).
         ctx = contextvars.copy_context()
+        spawned = False
         with self._mu:
             if self._shutdown:
                 raise RuntimeError("cannot submit after shutdown")
             self._work.put((fut, ctx, fn, args, kwargs))
+            self._depth += 1
             # Spawn only when no idle worker can take the item (the
             # counter is advisory; a race costs one extra thread, never
             # a lost task).
@@ -177,6 +199,10 @@ class _DaemonPool:
                 )
                 self._threads.append(t)
                 t.start()
+                spawned = True
+        if spawned:
+            self.stats.count("exec.pool.spawned")
+        self._publish()
         return fut
 
     def _worker(self) -> None:
@@ -189,15 +215,24 @@ class _DaemonPool:
             if item is None:  # retire (shutdown)
                 return
             fut, ctx, fn, args, kwargs = item
-            if self._cancel_pending:
-                fut.cancel()
-                continue
-            if not fut.set_running_or_notify_cancel():
-                continue
+            with self._mu:
+                self._depth -= 1
+                self._active += 1
+            self._publish()
             try:
-                fut.set_result(ctx.run(fn, *args, **kwargs))
-            except BaseException as e:  # noqa: BLE001 — crosses the future
-                fut.set_exception(e)
+                if self._cancel_pending:
+                    fut.cancel()
+                    continue
+                if not fut.set_running_or_notify_cancel():
+                    continue
+                try:
+                    fut.set_result(ctx.run(fn, *args, **kwargs))
+                except BaseException as e:  # noqa: BLE001 — crosses the future
+                    fut.set_exception(e)
+            finally:
+                with self._mu:
+                    self._active -= 1
+                self._publish()
 
     def shutdown(self, wait: bool = True, cancel_futures: bool = False) -> None:
         with self._mu:
@@ -230,6 +265,7 @@ class Executor:
         max_writes_per_request: int = DEFAULT_MAX_WRITES_PER_REQUEST,
         tracer=None,
         prefetcher=None,
+        coalescer=None,
     ):
         self.holder = holder
         self.host = host
@@ -242,11 +278,20 @@ class Executor:
         # mirrors re-materialize concurrently while planning proceeds.
         # None = disabled (bare library use stays fully deterministic).
         self.prefetcher = prefetcher
+        # Cross-query coalescing scheduler (exec/coalesce.py): when
+        # wired (Server does, gated on [exec] coalesce), concurrent
+        # queries sharing a compile key ride ONE fused launch.  The
+        # scheduler is OWNED by whoever wired it (Server.close /
+        # bench), not by this executor — several executors may share
+        # one.  None = every query dispatches its own launch.
+        self.coalescer = coalescer
         # (expr, reduce, batch shape) programs this executor has already
         # dispatched — distinguishes compile-bearing first calls from
         # pure execution in the device span annotations.
         self._seen_programs: set = set()
-        self._pool = _DaemonPool(max_workers=16)
+        self._pool = _DaemonPool(
+            max_workers=16, stats=getattr(holder, "stats", None)
+        )
         self._zero_rows: dict = {}  # device -> cached all-zero leaf row
         # Assembled leaf-batch LRU (see _cached_batch); executors serve
         # concurrent HTTP request threads, so access is lock-guarded.
@@ -974,6 +1019,36 @@ class Executor:
             persistent_cache=bool(warmup.enabled_cache_dir()),
         )
 
+    def _coalesce_eval(self, ent: dict, reduce: str):
+        """Route one assembled batch through the coalescing scheduler;
+        returns the host result rows for THIS entry (``[n, words]`` for
+        "row", int32 ``[n]`` partials for "count"), or None when the
+        scheduler is closed (callers fall back to a direct launch).
+
+        The per-query ``coalesce`` span covers queue wait + the shared
+        launch and carries the launch's batch stats (occupancy, rows,
+        padding) — the trace-level evidence that N queries rode one
+        dispatch.  Compile-warmth bookkeeping matches _device_span so a
+        coalesced first launch is as visible as a direct one."""
+        shape = tuple(ent["batch"].shape)
+        pkey = (ent["expr"], reduce, shape)
+        warm = pkey in self._seen_programs
+        self._seen_programs.add(pkey)
+        with self.tracer.span("coalesce", reduce=reduce, warm=warm) as sp:
+            try:
+                fut = self.coalescer.submit(
+                    ent["expr"],
+                    reduce,
+                    ent["batch"],
+                    pin_keys=(ent.get("pool_key"),),
+                )
+            except coalesce_mod.CoalesceClosed:
+                sp.annotate(fallback="closed")
+                return None
+            res, info = fut.result(timeout=coalesce_mod.RESULT_TIMEOUT_S)
+            sp.annotate(**info)
+        return res
+
     def _eval_tree_slices(
         self, index: str, c: Call, slices: list[int], reduce: str
     ) -> dict[int, object]:
@@ -991,6 +1066,15 @@ class Executor:
             out[s] = 0 if reduce == "count" else None
         if ent["batch"] is None:
             return out
+
+        # Coalesced path: concurrent queries sharing this compile key
+        # ride one launch; the scheduler pins every batch in the launch
+        # and scatters this entry's rows back.
+        if self.coalescer is not None:
+            res = self._coalesce_eval(ent, reduce)
+            if res is not None:
+                out.update({s: res[p] for s, p in ent["pos_of"].items()})
+                return out
 
         # Pin lease for the duration of the fused program: the pool may
         # not evict the batch out from under the dispatch+fetch.
@@ -1045,6 +1129,14 @@ class Executor:
         if ent["batch"] is None:
             return 0
         kept_slices = ent["kept"]
+
+        # Coalesced path: the per-slice "count" partials are int32-exact
+        # (a slice-row is <= 2^20 bits) and the entry's positions sum in
+        # unbounded Python ints — identical totals to the limb program.
+        if self.coalescer is not None:
+            res = self._coalesce_eval(ent, "count")
+            if res is not None:
+                return sum(int(res[p]) for p in ent["pos_of"].values())
 
         with device_mod.pool().pinned(ent.get("pool_key")), self._device_span(
             ent, "count"

@@ -87,7 +87,34 @@ def _n_leaves(expr) -> int:
     return sum(_n_leaves(e) for e in expr[1:])
 
 
-def prewarm(buckets=(1, 2, 4, 8), exprs=_STANDARD_EXPRS) -> int:
+# Bucket sizes the coalescer's concatenated launches land on: entry
+# batches are pow2-padded per query, and distinct-entry concatenation
+# re-pads the total to the next power of two (exec/coalesce.py).  The
+# coalescer always runs the per-slice vmapped "count" program (NOT the
+# limb total-count), so those jit keys need their own warm.
+_COALESCE_BUCKETS = (1, 2, 4, 8, 16)
+
+
+def prewarm_coalesce(
+    buckets=_COALESCE_BUCKETS, exprs=_STANDARD_EXPRS[1:3]
+) -> int:
+    """Compile the coalescer's (tree shape x bucket) "count" programs —
+    by default the Intersect/Union 2-leaf Count shapes, the headline
+    concurrent query mix.  The "row" programs at small buckets are
+    already covered by :func:`prewarm`; larger coalesced row buckets
+    compile on first use (a row result that size is dominated by its
+    own fetch, not the compile)."""
+    warmed = 0
+    for expr in exprs:
+        nl = _n_leaves(expr)
+        for bucket in buckets:
+            batch = np.zeros((bucket, nl, bp.WORDS_PER_SLICE), dtype=np.uint32)
+            plan.compiled_batched(expr, "count")(batch).block_until_ready()
+            warmed += 1
+    return warmed
+
+
+def prewarm(buckets=(1, 2, 4, 8), exprs=_STANDARD_EXPRS, coalesce=False) -> int:
     """Compile the standard (tree shape x slice bucket) programs.
 
     Triggers real compilations by calling each program on a zero batch
@@ -135,16 +162,18 @@ def prewarm(buckets=(1, 2, 4, 8), exprs=_STANDARD_EXPRS) -> int:
                 plan.compiled_total_count(expr, mesh)(batch).block_until_ready()
                 plan.compiled_batched(expr, "row")(batch).block_until_ready()
                 warmed += 2
+    if coalesce:
+        warmed += prewarm_coalesce()
     return warmed
 
 
-def prewarm_async(logger=None) -> threading.Thread:
+def prewarm_async(logger=None, coalesce=False) -> threading.Thread:
     """Run :func:`prewarm` on a daemon thread (server open must not
     block on compiles); returns the thread for tests to join."""
 
     def run():
         try:
-            n = prewarm()
+            n = prewarm(coalesce=coalesce)
             if logger is not None:
                 logger(f"prewarm: {n} standard query programs compiled")
         except Exception as e:  # pragma: no cover - diagnostics only

@@ -571,20 +571,18 @@ class Handler:
 
     def _log_slow_query(self, index: str, root, record: dict) -> None:
         """Exactly one structured line per slow coordinator query."""
-        self.logger(
-            "slow query "
-            + json.dumps(
-                {
-                    "ms": record["duration_ms"],
-                    "index": index,
-                    "query": root.tags.get("query", ""),
-                    "slices": root.tags.get("slices", "all"),
-                    "trace_id": record["trace_id"],
-                    "stages": trace.stage_breakdown(record),
-                },
-                sort_keys=True,
-            )
-        )
+        line = {
+            "ms": record["duration_ms"],
+            "index": index,
+            "query": root.tags.get("query", ""),
+            "slices": root.tags.get("slices", "all"),
+            "trace_id": record["trace_id"],
+            "stages": trace.stage_breakdown(record),
+        }
+        co = _coalesce_batch_stats(record)
+        if co is not None:
+            line["coalesce"] = co
+        self.logger("slow query " + json.dumps(line, sort_keys=True))
 
     def _handle_post_query(self, req: Request, index: str, root) -> Response:
         try:
@@ -1000,6 +998,27 @@ class Handler:
                 self.broadcaster.send_sync(msg)
             except Exception as e:  # noqa: BLE001 — broadcast is best-effort
                 self.logger(f"broadcast error: {e}")
+
+
+def _coalesce_batch_stats(record: dict) -> dict | None:
+    """Aggregate the coalescer's batch stats from a trace's ``coalesce``
+    spans (exec/coalesce.py annotates each with its launch's occupancy)
+    — the slow-query line's evidence of whether a slow query rode a
+    shared launch and how full it was.  None when the query never hit
+    the coalescer."""
+    spans = [s for s in record.get("spans", ()) if s.get("name") == "coalesce"]
+    occ = [
+        s["tags"]["batch_queries"]
+        for s in spans
+        if isinstance(s.get("tags", {}).get("batch_queries"), (int, float))
+    ]
+    if not spans:
+        return None
+    out: dict = {"launches": len(spans)}
+    if occ:
+        out["mean_occupancy"] = round(sum(occ) / len(occ), 2)
+        out["max_occupancy"] = max(occ)
+    return out
 
 
 def _sample_cpu_counts(

@@ -54,6 +54,9 @@ class Server:
         trace_ring: int = 64,
         hbm_budget_bytes: int = 0,
         device_prefetch: bool = True,
+        coalesce: bool = True,
+        coalesce_max_batch: int = 64,
+        coalesce_max_wait_us: int = 0,
     ):
         self.data_dir = data_dir
         self.host = host
@@ -82,6 +85,12 @@ class Server:
         # cold-mirror prefetcher toggle.
         self.hbm_budget_bytes = hbm_budget_bytes
         self.device_prefetch = device_prefetch
+        # Cross-query coalescing ([exec] config): concurrent queries
+        # sharing a compile key ride one fused launch (exec/coalesce.py).
+        self.coalesce = coalesce
+        self.coalesce_max_batch = coalesce_max_batch
+        self.coalesce_max_wait_us = coalesce_max_wait_us
+        self.coalescer = None
 
         self.holder = Holder(data_dir)
         self.executor: Executor | None = None
@@ -146,8 +155,21 @@ class Server:
                     "from scratch on every process start"
                 )
         self.holder.open()
+        if self.coalesce:
+            from pilosa_tpu.exec.coalesce import CoalesceScheduler
+
+            self.coalescer = CoalesceScheduler(
+                max_batch=self.coalesce_max_batch,
+                max_wait_us=self.coalesce_max_wait_us,
+                stats=self.stats,
+            )
         if self.prewarm:
-            warmup.prewarm_async(logger=self.logger)
+            # With coalescing on, also compile the coalescer's
+            # power-of-two bucket shapes for the common Count trees so
+            # the first coalesced batch doesn't eat a cold compile.
+            warmup.prewarm_async(
+                logger=self.logger, coalesce=self.coalesce
+            )
             # After the programs, the DATA: stage fragment planes into
             # HBM in the background so first queries skip the
             # host->device transfer too (the dominant cold cost once
@@ -228,6 +250,7 @@ class Server:
             prefetcher=(
                 device_mod.prefetcher() if self.device_prefetch else None
             ),
+            coalescer=self.coalescer,
             **kwargs,
         )
         self.handler.executor = self.executor
@@ -262,6 +285,10 @@ class Server:
             self.broadcast_receiver.close()
         if self.executor is not None:
             self.executor.close()
+        if self.coalescer is not None:
+            # After the executor: in-flight queries fall back to the
+            # direct launch path when submit() raises CoalesceClosed.
+            self.coalescer.close()
         self.holder.close()
         # Release stats transports (the StatsD UDP socket) last: the
         # close path above may still observe.

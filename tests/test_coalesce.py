@@ -1,0 +1,286 @@
+"""Cross-query coalescing tests (exec/coalesce.py + executor wiring).
+
+The acceptance bar: coalesced execution is byte-identical to the
+uncoalesced path over the same query mix, concurrent storms ride fewer
+launches than queries (occupancy > 1), and a closed scheduler degrades
+to direct launches instead of failing queries.
+"""
+
+import concurrent.futures
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pilosa_tpu.cluster.topology import new_cluster
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.exec import Executor
+from pilosa_tpu.exec.coalesce import CoalesceClosed, CoalesceScheduler
+from pilosa_tpu.ops.bitplane import SLICE_WIDTH
+from pilosa_tpu.pql.parser import parse_string
+
+# A generous accumulation window makes the batching deterministic under
+# test: the dispatcher lingers for same-key company instead of racing
+# the submitting threads.
+WAIT_US = 200_000
+
+
+@pytest.fixture
+def holder(tmp_path):
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    yield h
+    h.close()
+
+
+def _scheduler(**kw):
+    kw.setdefault("max_wait_us", WAIT_US)
+    return CoalesceScheduler(**kw)
+
+
+# ---------------------------------------------------------------------------
+# scheduler unit (no executor): concat, dedup, padding, close
+# ---------------------------------------------------------------------------
+
+
+def test_concat_batches_one_launch_correct_scatter(rng):
+    co = _scheduler()
+    try:
+        words = 64
+        batches = [
+            jnp.asarray(
+                rng.integers(0, 2**32, size=(1, 1, words), dtype=np.uint32)
+            )
+            for _ in range(5)
+        ]
+        expr = ("leaf", 0)
+        futs = [co.submit(expr, "count", b) for b in batches]
+        results = [f.result(timeout=30) for f in futs]
+        for b, (res, info) in zip(batches, results):
+            want = int(np.bitwise_count(np.asarray(b)).sum())
+            assert res.shape == (1,)
+            assert int(res[0]) == want
+        # All five distinct 1-row batches accumulated into ONE launch,
+        # padded 5 -> 8 with zero rows that are never scattered back.
+        infos = {r[1]["launch"] for r in results}
+        assert len(infos) == 1
+        info = results[0][1]
+        assert info["batch_segments"] == 5
+        assert info["batch_rows"] == 5
+        assert info["pad_rows"] == 3
+        snap = co.snapshot()
+        assert snap["launches"] == 1 and snap["queries"] == 5
+        assert snap["pad_rows"] == 3
+    finally:
+        co.close()
+
+
+def test_identity_dedup_shares_one_segment(rng):
+    co = _scheduler()
+    try:
+        words = 32
+        batch = jnp.asarray(
+            rng.integers(0, 2**32, size=(4, 2, words), dtype=np.uint32)
+        )
+        expr = ("Intersect", ("leaf", 0), ("leaf", 1))
+        futs = [co.submit(expr, "row", batch) for _ in range(6)]
+        results = [f.result(timeout=30) for f in futs]
+        host = np.asarray(batch)
+        want = host[:, 0] & host[:, 1]
+        for res, info in results:
+            np.testing.assert_array_equal(res, want)
+            # One segment, no concatenation, no padding: the launch ran
+            # directly on the shared array.
+            assert info["batch_segments"] == 1
+            assert info["pad_rows"] == 0
+        assert co.snapshot()["launches"] < len(futs)
+        assert co.snapshot()["max_occupancy"] > 1
+    finally:
+        co.close()
+
+
+def test_immediate_dispatch_without_wait_window(rng):
+    """max_wait_us=0 (the default): a lone query launches immediately —
+    serial queries each get occupancy 1, no added latency."""
+    co = CoalesceScheduler(max_wait_us=0)
+    try:
+        b = jnp.asarray(rng.integers(0, 2**32, size=(1, 1, 16), dtype=np.uint32))
+        for _ in range(3):
+            res, info = co.submit(("leaf", 0), "count", b).result(timeout=30)
+            assert info["batch_queries"] == 1
+        assert co.snapshot()["launches"] == 3
+    finally:
+        co.close()
+
+
+def test_close_rejects_and_drains(rng):
+    co = _scheduler()
+    co.close()
+    b = jnp.asarray(np.zeros((1, 1, 16), dtype=np.uint32))
+    with pytest.raises(CoalesceClosed):
+        co.submit(("leaf", 0), "count", b)
+
+
+def test_launch_error_crosses_future():
+    co = CoalesceScheduler(max_wait_us=0)
+    try:
+        bad = jnp.asarray(np.zeros((1, 1, 16), dtype=np.uint32))
+        # A malformed expr reaches the launch and must fail THIS future,
+        # not wedge the dispatcher.
+        fut = co.submit(("Bogus",), "count", bad)
+        with pytest.raises(Exception):
+            fut.result(timeout=30)
+        # The dispatcher survives and serves the next submission.
+        ok = co.submit(("leaf", 0), "count", bad).result(timeout=30)
+        assert int(ok[0][0]) == 0
+    finally:
+        co.close()
+
+
+# ---------------------------------------------------------------------------
+# executor integration
+# ---------------------------------------------------------------------------
+
+
+def _seed(holder):
+    idx = holder.create_index("i")
+    f = idx.create_frame("f", cache_size=64)
+    bits = [
+        (1, 0), (1, 3), (1, SLICE_WIDTH + 1), (1, 2 * SLICE_WIDTH + 5),
+        (2, 3), (2, SLICE_WIDTH + 1), (2, SLICE_WIDTH + 9),
+        (3, 7), (3, 2 * SLICE_WIDTH + 5),
+    ]
+    for row, col in bits:
+        f.set_bit("standard", row, col)
+    return f
+
+
+MIX = [
+    "Count(Intersect(Bitmap(rowID=1, frame=f), Bitmap(rowID=2, frame=f)))",
+    "Count(Union(Bitmap(rowID=1, frame=f), Bitmap(rowID=3, frame=f)))",
+    "Bitmap(rowID=1, frame=f)",
+    "Union(Bitmap(rowID=2, frame=f), Bitmap(rowID=3, frame=f))",
+    "TopN(frame=f, n=2)",
+    "Count(Bitmap(rowID=3, frame=f))",
+]
+
+
+def _canon(result):
+    """Comparable form of one query result (ints, bit lists, pairs)."""
+    if hasattr(result, "bits"):
+        return ("bits", tuple(result.bits()))
+    if isinstance(result, list):
+        return ("pairs", tuple((p.id, p.count) for p in result))
+    return ("val", int(result))
+
+
+def test_coalesce_on_off_identical_results(holder):
+    _seed(holder)
+    c = new_cluster(1)
+    plain = Executor(holder, host=c.nodes[0].host, cluster=c)
+    expected = [
+        _canon(plain.execute("i", parse_string(q))[0]) for q in MIX
+    ]
+    plain.close()
+
+    co = _scheduler()
+    ex = Executor(holder, host=c.nodes[0].host, cluster=c, coalescer=co)
+    try:
+        # Serial pass.
+        got = [_canon(ex.execute("i", parse_string(q))[0]) for q in MIX]
+        assert got == expected
+        # Concurrent pass: every thread runs the whole mix; results must
+        # stay byte-identical under coalesced launches.
+        def run_mix(_):
+            return [_canon(ex.execute("i", parse_string(q))[0]) for q in MIX]
+
+        with concurrent.futures.ThreadPoolExecutor(8) as pool:
+            for got in pool.map(run_mix, range(8)):
+                assert got == expected
+    finally:
+        ex.close()
+        co.close()
+
+
+def test_concurrent_storm_occupancy_above_one(holder):
+    _seed(holder)
+    c = new_cluster(1)
+    co = _scheduler()
+    ex = Executor(holder, host=c.nodes[0].host, cluster=c, coalescer=co)
+    try:
+        pq = parse_string(
+            "Count(Intersect(Bitmap(rowID=1, frame=f), Bitmap(rowID=2, frame=f)))"
+        )
+        (want,) = ex.execute("i", pq)  # warm the batch cache
+        before = co.snapshot()
+        n = 24
+        barrier = threading.Barrier(12)
+
+        def one(_):
+            barrier.wait(timeout=30)
+            (got,) = ex.execute("i", pq)
+            assert int(got) == int(want)
+
+        with concurrent.futures.ThreadPoolExecutor(12) as pool:
+            list(pool.map(one, range(n)))
+        snap = co.snapshot()
+        launches = snap["launches"] - before["launches"]
+        queries = snap["queries"] - before["queries"]
+        assert queries == n
+        assert launches < queries
+        assert queries / launches > 1.0
+    finally:
+        ex.close()
+        co.close()
+
+
+def test_closed_coalescer_falls_back_to_direct_path(holder):
+    _seed(holder)
+    c = new_cluster(1)
+    co = CoalesceScheduler(max_wait_us=0)
+    co.close()
+    ex = Executor(holder, host=c.nodes[0].host, cluster=c, coalescer=co)
+    try:
+        (n,) = ex.execute(
+            "i",
+            parse_string(
+                "Count(Union(Bitmap(rowID=1, frame=f), Bitmap(rowID=2, frame=f)))"
+            ),
+        )
+        assert int(n) == 5  # {0, 3, S+1, S+9, 2S+5}
+    finally:
+        ex.close()
+
+
+def test_coalesced_single_slice_queries_share_launch(holder):
+    """Distinct single-slice entries with the same compile key take the
+    CONCAT path end to end through the executor."""
+    _seed(holder)
+    c = new_cluster(1)
+    co = _scheduler()
+    ex = Executor(holder, host=c.nodes[0].host, cluster=c, coalescer=co)
+    try:
+        queries = [
+            (parse_string(f"Count(Bitmap(rowID={r}, frame=f))"), [0])
+            for r in (1, 2, 3)
+        ]
+        # Warm each entry's batch cache serially (separate cache keys).
+        want = [int(ex.execute("i", q, slices=s)[0]) for q, s in queries]
+        before = co.snapshot()
+        barrier = threading.Barrier(len(queries))
+
+        def one(i):
+            q, s = queries[i]
+            barrier.wait(timeout=30)
+            return int(ex.execute("i", q, slices=s)[0])
+
+        with concurrent.futures.ThreadPoolExecutor(len(queries)) as pool:
+            got = list(pool.map(one, range(len(queries))))
+        assert got == want
+        snap = co.snapshot()
+        assert snap["queries"] - before["queries"] == len(queries)
+        assert snap["launches"] - before["launches"] < len(queries)
+    finally:
+        ex.close()
+        co.close()

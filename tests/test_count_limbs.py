@@ -1,0 +1,107 @@
+"""Count-limb math at the on-device reduce boundaries.
+
+TPUs have no int64, so Count() reduces run two-stage in 16-bit limbs of
+int32 per-slice-row partials (plan.compiled_total_count): exact for up
+to MAX_ONDEVICE_COUNT_PARTIALS (2^15) partials of up to 2^20 bits each.
+These tests pin the boundary cases — exactly 2^15 partials, partials at
+the 2^20-bit slice maximum, the int32 accumulator budget — plus the
+cross-slice merge's duplicate-id semantics.
+"""
+
+import numpy as np
+
+from pilosa_tpu.exec import plan
+from pilosa_tpu.exec.executor import merge_counts_by_id
+
+LEAF = ("leaf", 0)
+
+
+def test_recombine_scalar():
+    assert plan.recombine_count_limbs(np.array([0, 0])) == 0
+    assert plan.recombine_count_limbs(np.array([0, 123])) == 123
+    assert plan.recombine_count_limbs(np.array([3, 5])) == (3 << 16) + 5
+    out = plan.recombine_count_limbs(np.array([1, 0]))
+    assert isinstance(out, int) and out == 1 << 16
+
+
+def test_recombine_vector():
+    limbs = np.array([[0, 1, 16], [7, 0xFFFF, 0]])
+    out = plan.recombine_count_limbs(limbs)
+    assert out.dtype == np.int64
+    np.testing.assert_array_equal(
+        out, [7, (1 << 16) + 0xFFFF, 16 << 16]
+    )
+
+
+def test_total_count_exactly_max_partials():
+    """Exactly 2^15 partials — the documented budget edge — through the
+    real two-stage limb program (small word count keeps it cheap; the
+    limb math is word-count independent)."""
+    n = plan.MAX_ONDEVICE_COUNT_PARTIALS
+    words = 4
+    batch = np.full((n, 1, words), 0xFFFFFFFF, dtype=np.uint32)
+    limbs = plan.compiled_total_count(LEAF)(batch)
+    assert plan.recombine_count_limbs(np.asarray(limbs)) == n * words * 32
+
+
+def test_total_count_partials_at_slice_max():
+    """Partials at the 2^20-bit slice-row maximum: all-ones full-width
+    rows, where the lo limb of each partial is exactly 0 and the total
+    rides entirely on the hi limb."""
+    from pilosa_tpu.ops import bitplane as bp
+
+    n = 8
+    batch = np.full((n, 1, bp.WORDS_PER_SLICE), 0xFFFFFFFF, dtype=np.uint32)
+    limbs = np.asarray(plan.compiled_total_count(LEAF)(batch))
+    assert limbs[1] == 0  # (2^20 & 0xFFFF) == 0 per partial
+    assert plan.recombine_count_limbs(limbs) == n * (1 << 20)
+    # The batched per-slice fallback agrees (the path callers take past
+    # the partial budget).
+    per = np.asarray(plan.compiled_batched(LEAF, "count")(batch))
+    assert int(per.astype(np.int64).sum()) == n * (1 << 20)
+
+
+def test_limb_budget_int32_exact_at_boundary():
+    """The worst-case accumulator load inside the budget: 2^15 partials
+    of 2^20 - 1 bits (lo limb 0xFFFF each) must stay below the int32
+    ceiling in BOTH limb sums, and recombine exactly."""
+    n = plan.MAX_ONDEVICE_COUNT_PARTIALS
+    partials = np.full(n, (1 << 20) - 1, dtype=np.int64)
+    lo = int(np.sum(partials & 0xFFFF))
+    hi = int(np.sum(partials >> 16))
+    i32max = np.iinfo(np.int32).max
+    assert lo <= i32max and hi <= i32max
+    assert plan.recombine_count_limbs(np.array([hi, lo])) == int(
+        partials.sum()
+    )
+
+
+def test_two_stage_matches_flat_sum_random(rng):
+    """Random partial mix: limb-split + recombine == the flat int64 sum
+    (the invariant the device program relies on)."""
+    partials = rng.integers(0, 1 << 20, size=4096).astype(np.int64)
+    lo = int(np.sum(partials & 0xFFFF))
+    hi = int(np.sum(partials >> 16))
+    assert plan.recombine_count_limbs(np.array([hi, lo])) == int(
+        partials.sum()
+    )
+
+
+def test_merge_counts_by_id_duplicates_across_slices():
+    parts = [
+        (np.array([1, 2, 3], np.int64), np.array([10, 20, 30], np.int64)),
+        (np.array([2, 3, 4], np.int64), np.array([5, 5, 5], np.int64)),
+        (np.array([], np.int64), np.array([], np.int64)),
+        (np.array([1], np.int64), np.array([1], np.int64)),
+    ]
+    uids, sums = merge_counts_by_id(parts)
+    np.testing.assert_array_equal(uids, [1, 2, 3, 4])
+    np.testing.assert_array_equal(sums, [11, 25, 35, 5])
+
+
+def test_merge_counts_by_id_empty():
+    assert merge_counts_by_id([]) is None
+    assert (
+        merge_counts_by_id([(np.array([], np.int64), np.array([], np.int64))])
+        is None
+    )

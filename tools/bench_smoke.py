@@ -1,0 +1,82 @@
+"""CI smoke pass over bench.py: a tiny CPU-only run that asserts the
+JSON artifact parses and carries the coalescer's counters.
+
+Not a performance measurement — a wiring check: the bench's executor
+tiers must produce one valid JSON line on stdout with the coalesce
+section (launches / occupancy / dispatches-per-query per concurrent
+tier), so a refactor cannot silently break the artifact the perf
+trajectory is built from.  Run via ``make bench-smoke``; wired into CI
+as a non-blocking step.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    env = dict(
+        os.environ,
+        # CPU backend, trimmed iteration counts (bench.py's validated
+        # fallback mode), and a tiny column count so the whole pass is
+        # seconds, not hours.
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+        BENCH_CPU_FALLBACK="1",
+        BENCH_COLUMNS=str(4 * (1 << 20)),  # 4 slices
+        BENCH_SKIP_RESTART_PROBE="1",
+        BENCH_SKIP_CLUSTER_TIER="1",
+        BENCH_SKIP_HBM_TIER="1",
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=1200,
+    )
+    sys.stderr.write(proc.stderr[-4000:])
+    if proc.returncode != 0:
+        print(f"FAIL: bench.py exited {proc.returncode}", file=sys.stderr)
+        return 1
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+    if not lines:
+        print("FAIL: no stdout artifact", file=sys.stderr)
+        return 1
+    try:
+        out = json.loads(lines[-1])
+    except json.JSONDecodeError as e:
+        print(f"FAIL: artifact is not JSON ({e}): {lines[-1]!r}", file=sys.stderr)
+        return 1
+    for key in ("metric", "value", "unit"):
+        if key not in out:
+            print(f"FAIL: artifact missing {key!r}", file=sys.stderr)
+            return 1
+    co = out.get("coalesce")
+    if not isinstance(co, dict) or "total" not in co or "tiers" not in co:
+        print(f"FAIL: artifact missing coalesce counters: {out}", file=sys.stderr)
+        return 1
+    total = co["total"]
+    for key in ("launches", "queries", "mean_occupancy", "pad_rows"):
+        if key not in total:
+            print(f"FAIL: coalesce total missing {key!r}: {total}", file=sys.stderr)
+            return 1
+    if total["launches"] < 1 or total["queries"] < total["launches"]:
+        print(f"FAIL: implausible coalesce counters: {total}", file=sys.stderr)
+        return 1
+    print(
+        f"OK: metric={out['metric']} value={out['value']} {out['unit']};"
+        f" coalesce launches={total['launches']}"
+        f" queries={total['queries']}"
+        f" mean_occupancy={total['mean_occupancy']}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
