@@ -5,7 +5,7 @@
 
 PYTHON ?= python
 
-.PHONY: default test lint check bench bench-smoke install build docker clean generate
+.PHONY: default test lint check bench bench-smoke chaos-smoke install build docker clean generate
 
 default: build test
 
@@ -43,6 +43,12 @@ bench:
 # in CI (.github/workflows/check.yml).
 bench-smoke:
 	$(PYTHON) tools/bench_smoke.py
+
+# Tiny CPU chaos pass: two in-process nodes under PILOSA_FAULTS (one
+# erroring + one delayed RPC leg); a fan-out query must still answer
+# exactly.  Non-blocking in CI (.github/workflows/check.yml).
+chaos-smoke:
+	$(PYTHON) tools/chaos_smoke.py
 
 docker:
 	docker build -t pilosa-tpu .

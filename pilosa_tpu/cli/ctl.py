@@ -88,6 +88,8 @@ def build_server(cfg: config_mod.Config):
             seed=cfg.cluster.gossip_seed,
             logger=logger,
             stats=stats,
+            ack_timeout=cfg.gossip.ack_timeout_ms / 1000.0,
+            stream_timeout=cfg.gossip.stream_timeout_ms / 1000.0,
         )
         broadcaster = nodeset
         receiver = nodeset
@@ -114,6 +116,12 @@ def build_server(cfg: config_mod.Config):
         coalesce=cfg.exec.coalesce,
         coalesce_max_batch=cfg.exec.coalesce_max_batch,
         coalesce_max_wait_us=cfg.exec.coalesce_max_wait_us,
+        query_timeout_ms=cfg.net.query_timeout_ms,
+        broadcast_timeout_ms=cfg.net.broadcast_timeout_ms,
+        retry_attempts=cfg.net.retry_attempts,
+        retry_backoff_ms=cfg.net.retry_backoff_ms,
+        breaker_failure_threshold=cfg.net.breaker_failure_threshold,
+        breaker_open_ms=cfg.net.breaker_open_ms,
     )
 
 

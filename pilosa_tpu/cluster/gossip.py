@@ -102,6 +102,8 @@ class GossipNodeSet:
         state_fetcher=None,
         logger=None,
         stats=None,
+        ack_timeout: float = 0.25,
+        stream_timeout: float = _STREAM_TIMEOUT_S,
     ):
         self.host = host  # the node's HTTP host:port (cluster identity)
         if bind:
@@ -164,7 +166,11 @@ class GossipNodeSet:
         self._ack_events: dict[str, threading.Event] = {}
         self._seen_user: OrderedDict[str, float] = OrderedDict()
         self.sync_retries = 5
-        self.ack_timeout = 0.25  # doubles per retry
+        # First ACK wait (doubles per retry) and the HTTP state-stream
+        # fallback timeout — [gossip] ack-timeout-ms / stream-timeout-ms
+        # config keys (defaults preserve the former constants).
+        self.ack_timeout = ack_timeout
+        self.stream_timeout = stream_timeout
         # Chunked state transfer: digests already merged (content-keyed
         # LRU — a digest seen from any peer needs no re-fetch) and
         # in-progress chunk assemblies keyed by (sender, digest).
@@ -684,15 +690,14 @@ class GossipNodeSet:
             with self._mu:
                 self._streams_in_flight.discard(digest)
 
-    @staticmethod
-    def _http_state_fetch(peer_host: str) -> bytes:
+    def _http_state_fetch(self, peer_host: str) -> bytes:
         """GET the peer's full state blob from its HTTP listener
         (net/handler.py serves /state from the same provider that
         feeds gossip)."""
         import urllib.request
 
         with urllib.request.urlopen(
-            f"http://{peer_host}/state", timeout=_STREAM_TIMEOUT_S
+            f"http://{peer_host}/state", timeout=self.stream_timeout
         ) as resp:
             return resp.read()
 

@@ -153,6 +153,19 @@ class Cluster:
     def owns_fragment(self, host: str, index: str, slice_i: int) -> bool:
         return any(n.host == host for n in self.fragment_nodes(index, slice_i))
 
+    def split_by_owner(
+        self, index: str, slices, hosts: set[str]
+    ) -> tuple[list[int], list[int]]:
+        """Partition ``slices`` into (placeable, lost) against a
+        surviving host set — the failover planner's question: which of a
+        dead node's slices still have a replica, and which are gone."""
+        placeable: list[int] = []
+        lost: list[int] = []
+        for s in slices:
+            owners = {n.host for n in self.fragment_nodes(index, s)}
+            (placeable if owners & hosts else lost).append(s)
+        return placeable, lost
+
     def owns_slices(self, index: str, max_slice: int, host: str) -> list[int]:
         """Slices whose *primary* owner is ``host`` (reference:
         cluster.go:246-258)."""

@@ -10,6 +10,7 @@ from pilosa_tpu.exec.executor import (
     FrameNotFoundError,
     IndexNotFoundError,
     SliceUnavailableError,
+    SlicesUnavailableError,
     TooManyWritesError,
 )
 
@@ -21,4 +22,5 @@ __all__ = [
     "FrameNotFoundError",
     "TooManyWritesError",
     "SliceUnavailableError",
+    "SlicesUnavailableError",
 ]

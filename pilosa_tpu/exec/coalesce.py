@@ -72,8 +72,11 @@ DEFAULT_MAX_WAIT_US = 0
 # this bounds transient device memory (concatenation materializes a
 # copy), not correctness.
 MAX_CONCAT_ROWS = 4096
-# Waiters bound their Future wait: a wedged device call must surface as
-# a failed query, not a hung request thread.
+# Backstop bound on a waiter's Future wait: a wedged device call must
+# surface as a failed query, not a hung request thread.  Waiters with a
+# query deadline clamp this to their REMAINING budget and detach on
+# expiry without cancelling the shared launch (executor._coalesce_eval)
+# — an expired waiter never poisons the batch for the others.
 RESULT_TIMEOUT_S = 600.0
 
 

@@ -31,6 +31,7 @@ import time
 import queue
 from collections import OrderedDict
 from concurrent.futures import FIRST_COMPLETED, Future, wait
+from concurrent.futures import TimeoutError as FuturesTimeoutError
 from dataclasses import dataclass, field, replace
 from datetime import datetime
 
@@ -51,7 +52,9 @@ from pilosa_tpu.core.view import VIEW_INVERSE, VIEW_STANDARD
 from pilosa_tpu.exec import coalesce as coalesce_mod
 from pilosa_tpu.exec import plan
 from pilosa_tpu.exec import warmup
+from pilosa_tpu.net import resilience
 from pilosa_tpu.obs import trace
+from pilosa_tpu.testing import faults
 from pilosa_tpu.ops import bitplane as bp
 from pilosa_tpu.pql.parser import Call, Query
 
@@ -90,11 +93,32 @@ class SliceUnavailableError(ExecutorError):
         super().__init__("slice unavailable")
 
 
+class SlicesUnavailableError(ExecutorError):
+    """Every replica for ``slices`` is down or circuit-broken and the
+    query did not opt into partial results — fail fast WITH the slice
+    list, so the caller knows exactly what it would have lost."""
+
+    def __init__(self, slices, cause: Exception | None = None):
+        self.slices = sorted({int(s) for s in slices})
+        msg = f"slices unavailable: {self.slices}"
+        if cause is not None:
+            msg += f" (last error: {cause})"
+        super().__init__(msg)
+
+
 @dataclass
 class ExecOptions:
-    """reference: executor.go:1302-1304"""
+    """reference: executor.go:1302-1304 (+ resilience extensions)"""
 
     remote: bool = False
+    # Graceful degradation: when every replica for a slice is down or
+    # circuit-broken, reduce over the surviving slices and record the
+    # lost ones in ``missing_slices`` instead of failing the query.
+    allow_partial: bool = False
+    # OUT parameter — filled by _map_reduce when allow_partial dropped
+    # slices; the handler surfaces it as the partial/missing_slices
+    # response marker.  Sorted, deduplicated.
+    missing_slices: list[int] = field(default_factory=list)
 
 
 @dataclass
@@ -435,6 +459,10 @@ class Executor:
 
         results = []
         for call in q.calls:
+            # Per-call deadline gate: a multi-call query whose budget
+            # ran out mid-way fails with 504 rather than starting the
+            # next call's fan-out.
+            resilience.check_deadline(f"before call {call.name}")
             call_slices = slices
             if call.supports_inverse() and want_slices and computed_lists:
                 frame = call.args.get("frame") or DEFAULT_FRAME
@@ -1008,6 +1036,10 @@ class Executor:
         shape) program — a cold call bears XLA compilation unless the
         persistent compile cache (exec/warmup.py) serves it, which
         ``persistent_cache`` records."""
+        # Chaos hook: the device-launch boundary (testing/faults.py) —
+        # an injected fault here surfaces exactly like an XLA runtime
+        # error, exercising the map-error -> failover path.
+        faults.check("device.launch")
         shape = None if ent["batch"] is None else tuple(ent["batch"].shape)
         key = (ent["expr"], reduce, shape)
         warm = key in self._seen_programs
@@ -1030,6 +1062,7 @@ class Executor:
         padding) — the trace-level evidence that N queries rode one
         dispatch.  Compile-warmth bookkeeping matches _device_span so a
         coalesced first launch is as visible as a direct one."""
+        faults.check("device.launch")
         shape = tuple(ent["batch"].shape)
         pkey = (ent["expr"], reduce, shape)
         warm = pkey in self._seen_programs
@@ -1045,7 +1078,24 @@ class Executor:
             except coalesce_mod.CoalesceClosed:
                 sp.annotate(fallback="closed")
                 return None
-            res, info = fut.result(timeout=coalesce_mod.RESULT_TIMEOUT_S)
+            # The wait honors the query deadline: a flat RESULT_TIMEOUT_S
+            # here once made every waiter ride out 600 s regardless of
+            # its budget.  On expiry the waiter DETACHES — the shared
+            # launch is never cancelled, so the batch keeps serving its
+            # other waiters and the scheduler stays healthy.
+            timeout = coalesce_mod.RESULT_TIMEOUT_S
+            dl = resilience.current_deadline()
+            if dl is not None:
+                timeout = dl.clamp(timeout)
+            try:
+                res, info = fut.result(timeout=timeout)
+            except FuturesTimeoutError:
+                sp.annotate(deadline="expired")
+                if dl is not None and dl.expired:
+                    raise resilience.DeadlineExceeded(
+                        "deadline expired waiting for coalesced launch"
+                    ) from None
+                raise
             sp.annotate(**info)
         return res
 
@@ -2140,6 +2190,8 @@ class Executor:
         result = None
         # future -> node list the future's slices may still fail over to
         inflight: dict = {}
+        # Slices dropped under allow_partial (every replica down/open).
+        missing: list[int] = []
 
         def _submit(avail_nodes, want) -> None:
             m = self._slices_by_node(avail_nodes, index, want)
@@ -2150,12 +2202,30 @@ class Executor:
                 inflight[fut] = avail_nodes
 
         def _failover(resp, avail_nodes) -> None:
-            remaining = [n for n in avail_nodes if n.host != resp.node.host]
-            try:
-                self._slices_by_node(remaining, index, resp.slices)
-            except SliceUnavailableError:
+            """Re-place a failed mapper's slices on the remaining nodes.
+            An exhausted DEADLINE is never a node failure — it fails the
+            query (504), not the node.  Slices with no surviving replica
+            either fail fast with the slice list or, under
+            ``allow_partial``, drop into ``missing``.  A semantic error
+            (bad frame, parse-adjacent failures) re-raises rather than
+            masquerading as a dead node."""
+            if isinstance(resp.error, resilience.DeadlineExceeded):
                 raise resp.error
-            _submit(remaining, resp.slices)
+            if not resilience.is_node_failure(resp.error):
+                raise resp.error
+            remaining = [n for n in avail_nodes if n.host != resp.node.host]
+            placeable, lost = self.cluster.split_by_owner(
+                index, resp.slices, {n.host for n in remaining}
+            )
+            if lost:
+                if not opt.allow_partial:
+                    raise SlicesUnavailableError(lost, cause=resp.error)
+                missing.extend(lost)
+                self.holder.stats.count(
+                    "exec.partial.slicesDropped", len(lost)
+                )
+            if placeable:
+                _submit(remaining, placeable)
 
         m = self._slices_by_node(nodes, index, slices)
         if len(m) == 1:
@@ -2172,7 +2242,24 @@ class Executor:
             _submit(nodes, slices)
 
         while inflight:
-            done, _ = wait(list(inflight), return_when=FIRST_COMPLETED)
+            # Reduce-loop waits derive from the remaining deadline
+            # budget, not a flat constant: when it runs out, abandon the
+            # in-flight mappers (daemon pool) and 504.
+            dl = resilience.current_deadline()
+            timeout = None
+            if dl is not None:
+                timeout = dl.remaining()
+                if timeout <= 0:
+                    raise resilience.DeadlineExceeded(
+                        "deadline exceeded awaiting map responses"
+                    )
+            done, _ = wait(
+                list(inflight), timeout=timeout, return_when=FIRST_COMPLETED
+            )
+            if not done:
+                raise resilience.DeadlineExceeded(
+                    "deadline exceeded awaiting map responses"
+                )
             for fut in done:
                 avail_nodes = inflight.pop(fut)
                 resp = fut.result()
@@ -2180,11 +2267,21 @@ class Executor:
                     _failover(resp, avail_nodes)
                     continue
                 result = reduce_fn(result, resp.result)
+        if missing:
+            # Merge (a query may map/reduce more than once — TopN's two
+            # phases), keep sorted + deduplicated for the wire marker.
+            opt.missing_slices[:] = sorted(
+                set(opt.missing_slices) | set(missing)
+            )
         return result
 
     def _map_node(self, node, node_slices, index, c, opt, map_fn) -> _MapResponse:
         resp = _MapResponse(node=node, slices=node_slices)
         try:
+            # The deadline contextvar crossed into this worker with the
+            # submitter's context; an exhausted budget fails the QUERY
+            # (504 at the handler), never the node.
+            resilience.check_deadline("before map")
             if node.host == self.host:
                 with self.tracer.span(
                     "map.local", node=node.host, slices=len(node_slices)
@@ -2192,19 +2289,26 @@ class Executor:
                     resp.result = map_fn(node_slices)
             else:
                 results = self._exec_remote(
-                    node, index, Query(calls=[c]), node_slices, opt
+                    node, index, Query(calls=[c]), node_slices, opt,
+                    idempotent=True,
                 )
                 resp.result = results[0] if results else None
+        except resilience.DeadlineExceeded:
+            raise
         except Exception as e:  # noqa: BLE001 — failover boundary
             resp.error = e
         return resp
 
-    def _exec_remote(self, node, index, q, slices, opt) -> list:
+    def _exec_remote(self, node, index, q, slices, opt, idempotent=False) -> list:
         """Forward a query to a peer (reference: executor.go:1045-1129).
 
         The rpc span's ids travel as X-Trace-Id/X-Span-Id headers; the
         remote handler continues the trace under them and ships its
-        spans back, which the client absorbs into this node's trace."""
+        spans back, which the client absorbs into this node's trace.
+
+        ``idempotent`` marks the call safe to retry (read-only map
+        legs); write fan-out stays single-shot, matching the client's
+        retry contract."""
         if self.client_factory is None:
             raise ExecutorError(f"no client for remote node {node.host}")
         client = self.client_factory(node)
@@ -2212,6 +2316,9 @@ class Executor:
             "rpc.execute", node=node.host, slices=len(slices) if slices else 0
         ) as sp:
             headers = self.tracer.remote_headers(sp)
+            kwargs = {}
+            if getattr(client, "supports_resilience", False):
+                kwargs["idempotent"] = idempotent
             if headers and getattr(client, "supports_trace", False):
                 return client.execute_query(
                     index,
@@ -2220,8 +2327,11 @@ class Executor:
                     remote=True,
                     trace_headers=headers,
                     tracer=self.tracer,
+                    **kwargs,
                 )
-            return client.execute_query(index, str(q), slices, remote=True)
+            return client.execute_query(
+                index, str(q), slices, remote=True, **kwargs
+            )
 
 
 # ---------------------------------------------------------------------------

@@ -22,7 +22,9 @@ from typing import Any
 
 from pilosa_tpu import stream as stream_mod
 from pilosa_tpu.net import codec
+from pilosa_tpu.net import resilience
 from pilosa_tpu.net import wire_pb2 as wire
+from pilosa_tpu.testing import faults
 
 PROTOBUF = "application/x-protobuf"
 
@@ -41,18 +43,44 @@ class PreconditionFailedError(ClientError):
 class InternalClient:
     """HTTP client pinned to one host ("host:port")."""
 
-    # The executor checks this before passing trace kwargs, so injected
-    # test doubles with the bare execute_query signature keep working.
+    # The executor checks these before passing trace/resilience kwargs,
+    # so injected test doubles with the bare execute_query signature
+    # keep working.
     supports_trace = True
+    supports_resilience = True
 
-    def __init__(self, host: str, timeout: float = 30.0):
+    def __init__(
+        self,
+        host: str,
+        timeout: float = 30.0,
+        retry: "resilience.RetryPolicy | None" = None,
+        breakers: "resilience.BreakerRegistry | None" = None,
+    ):
         self.host = host
         self.timeout = timeout
+        # Resilience wiring (net/resilience.py), shared across every
+        # client a Server hands out: ``retry`` backs off over transport
+        # failures on IDEMPOTENT calls (GETs, and POSTs explicitly
+        # marked idempotent); ``breakers`` fast-fails hosts whose
+        # circuit is open and records every unary outcome.  Both are
+        # optional — a bare client keeps the original single-shot
+        # behavior.
+        self.retry = retry
+        self.breakers = breakers
         # Streamed-GET open retries (see stream/client.py); mid-stream
         # failures always propagate.
         self.stream_retries = 3
         self.stream_backoff = 0.1
         self.chunk_bytes = stream_mod.DEFAULT_CHUNK_BYTES
+
+    def _peer(self, host: str) -> "InternalClient":
+        """A client for another node carrying THIS client's resilience
+        wiring (replica fan-out, export redirects)."""
+        if host == self.host:
+            return self
+        return InternalClient(
+            host, self.timeout, retry=self.retry, breakers=self.breakers
+        )
 
     # ------------------------------------------------------------------
     # plumbing
@@ -65,9 +93,11 @@ class InternalClient:
         query: dict[str, Any] | None = None,
         body: bytes = b"",
         headers: dict[str, str] | None = None,
+        idempotent: bool | None = None,
     ) -> tuple[int, bytes]:
         status, data, _ = self._request_meta(
-            method, path, query=query, body=body, headers=headers
+            method, path, query=query, body=body, headers=headers,
+            idempotent=idempotent,
         )
         return status, data
 
@@ -78,20 +108,84 @@ class InternalClient:
         query: dict[str, Any] | None = None,
         body: bytes = b"",
         headers: dict[str, str] | None = None,
+        idempotent: bool | None = None,
     ) -> tuple[int, bytes, dict[str, str]]:
         """Like :meth:`_request` but also returns the response headers
-        (lower-cased keys) — the trace span export rides one."""
+        (lower-cased keys) — the trace span export rides one.
+
+        ``idempotent`` gates the retry policy: None infers it from the
+        method (GET retries, everything else is single-shot); callers
+        with better knowledge (e.g. the executor's read-only map legs)
+        pass it explicitly."""
+        bare = path
         if query:
             path = path + "?" + urllib.parse.urlencode(query)
-        conn = http.client.HTTPConnection(self.host, timeout=self.timeout)
+        if idempotent is None:
+            idempotent = method in ("GET", "HEAD")
+
+        def attempt():
+            return self._attempt(method, bare, path, body, headers)
+
+        if idempotent and self.retry is not None:
+            return self.retry.call(attempt)
+        return attempt()
+
+    def _attempt(
+        self, method: str, bare: str, path: str, body, headers
+    ) -> tuple[int, bytes, dict[str, str]]:
+        """One wire attempt: breaker gate, deadline-derived socket
+        timeout + X-Deadline-Ms export, fault-injection point, and
+        breaker outcome recording."""
+        timeout, hdrs = self._prepare(method, bare, headers)
+        conn = None
         try:
-            conn.request(method, path, body=body, headers=headers or {})
-            resp = conn.getresponse()
-            data = resp.read()
+            try:
+                # Inside the recorded region: an injected rpc.send
+                # fault counts against the breaker exactly like a real
+                # transport failure.
+                faults.check("rpc.send", host=self.host, path=bare)
+                conn = http.client.HTTPConnection(self.host, timeout=timeout)
+                conn.request(method, path, body=body, headers=hdrs)
+                resp = conn.getresponse()
+                data = resp.read()
+            except resilience.TRANSPORT_ERRORS:
+                self._record_breaker(False)
+                raise
             resp_headers = {k.lower(): v for k, v in resp.getheaders()}
+            # A 5xx means the node answered but is unhealthy — count it
+            # against the breaker like a transport failure.
+            self._record_breaker(resp.status < 500)
             return resp.status, data, resp_headers
         finally:
-            conn.close()
+            if conn is not None:
+                conn.close()
+
+    def _prepare(
+        self, method: str, bare: str, headers
+    ) -> tuple[float, dict[str, str]]:
+        """Shared per-attempt gating for every outbound request: raise
+        DeadlineExceeded on an exhausted budget (before spending any
+        socket work), fail fast on an open breaker, derive the socket
+        timeout from the remaining budget, and export the budget as
+        X-Deadline-Ms.  (The rpc.send fault hook fires in the caller's
+        breaker-recorded region, not here.)"""
+        dl = resilience.current_deadline()
+        if dl is not None and dl.expired:
+            raise resilience.DeadlineExceeded(
+                f"deadline exceeded before {method} {bare} to {self.host}"
+            )
+        if self.breakers is not None:
+            self.breakers.check(self.host)
+        hdrs = dict(headers or {})
+        timeout = self.timeout
+        if dl is not None:
+            timeout = min(timeout, max(dl.remaining(), 0.001))
+            hdrs[resilience.DEADLINE_HEADER] = dl.header_value()
+        return timeout, hdrs
+
+    def _record_breaker(self, ok: bool) -> None:
+        if self.breakers is not None:
+            self.breakers.record(self.host, ok)
 
     def _request_chunked(
         self,
@@ -103,7 +197,9 @@ class InternalClient:
     ) -> tuple[int, bytes]:
         """Issue a request whose body streams off ``reader`` with
         chunked transfer encoding — constant-size writes, no payload
-        materialization."""
+        materialization.  Single-shot (the reader can't be rewound), but
+        still rides the breaker/deadline gates."""
+        bare = path
         if query:
             path = path + "?" + urllib.parse.urlencode(query)
 
@@ -114,19 +210,29 @@ class InternalClient:
                     return
                 yield data
 
-        conn = http.client.HTTPConnection(self.host, timeout=self.timeout)
+        timeout, hdrs = self._prepare(method, bare, headers)
+        conn = None
         try:
-            conn.request(
-                method,
-                path,
-                body=chunks(),
-                headers={**(headers or {}), "Transfer-Encoding": "chunked"},
-                encode_chunked=True,
-            )
-            resp = conn.getresponse()
-            return resp.status, resp.read()
+            try:
+                faults.check("rpc.send", host=self.host, path=bare)
+                conn = http.client.HTTPConnection(self.host, timeout=timeout)
+                conn.request(
+                    method,
+                    path,
+                    body=chunks(),
+                    headers={**hdrs, "Transfer-Encoding": "chunked"},
+                    encode_chunked=True,
+                )
+                resp = conn.getresponse()
+                data = resp.read()
+            except resilience.TRANSPORT_ERRORS:
+                self._record_breaker(False)
+                raise
+            self._record_breaker(resp.status < 500)
+            return resp.status, data
         finally:
-            conn.close()
+            if conn is not None:
+                conn.close()
 
     def _open_stream(
         self,
@@ -138,13 +244,16 @@ class InternalClient:
         """Open an error-checked body stream; the connection dial (and
         the status-line read) retries with backoff, the returned stream
         does not.  Caller owns close()."""
+        bare = path
         if query:
             path = path + "?" + urllib.parse.urlencode(query)
 
         def _open():
-            conn = http.client.HTTPConnection(self.host, timeout=self.timeout)
+            timeout, hdrs = self._prepare(method, bare, headers)
+            faults.check("rpc.send", host=self.host, path=bare)
+            conn = http.client.HTTPConnection(self.host, timeout=timeout)
             try:
-                conn.request(method, path, headers=headers or {})
+                conn.request(method, path, headers=hdrs)
                 resp = conn.getresponse()
             except BaseException:
                 conn.close()
@@ -159,12 +268,19 @@ class InternalClient:
                 data = s.read()
             if s.status == 412:
                 raise PreconditionFailedError(_err_text(data))
+            if s.status == 504:
+                raise resilience.DeadlineExceeded(_err_text(data))
             raise ClientError(s.status, _err_text(data))
         return s
 
     def _check(self, status: int, data: bytes) -> bytes:
         if status == 412:
             raise PreconditionFailedError(_err_text(data))
+        if status == 504:
+            # The peer's deadline expired — surface it as a deadline
+            # failure so the coordinator 504s too instead of treating
+            # the exhausted budget as a node failure to fail over.
+            raise resilience.DeadlineExceeded(_err_text(data))
         if status >= 400:
             raise ClientError(status, _err_text(data))
         return data
@@ -182,10 +298,18 @@ class InternalClient:
         column_attrs: bool = False,
         trace_headers: dict[str, str] | None = None,
         tracer=None,
+        idempotent: bool = False,
+        allow_partial: bool = False,
     ) -> list:
         """``trace_headers`` (X-Trace-Id/X-Span-Id) continue the caller's
         trace on the peer; the peer's spans come back in an
-        X-Trace-Spans response header and are absorbed into ``tracer``."""
+        X-Trace-Spans response header and are absorbed into ``tracer``.
+
+        ``idempotent`` opts this call into the transport retry policy —
+        the executor sets it on read-only map legs; write fan-out stays
+        single-shot.  ``allow_partial`` asks the peer to answer with the
+        surviving slices (plus a missing-slice marker) instead of
+        failing the whole query when replicas are down."""
         pb = wire.QueryRequest(
             Query=query,
             Slices=slices or [],
@@ -198,8 +322,10 @@ class InternalClient:
         status, data, resp_headers = self._request_meta(
             "POST",
             f"/index/{index}/query",
+            query={"allowPartial": "true"} if allow_partial else None,
             body=pb.SerializeToString(),
             headers=headers,
+            idempotent=idempotent,
         )
         if tracer is not None:
             payload = resp_headers.get("x-trace-spans")
@@ -313,12 +439,13 @@ class InternalClient:
             raise ClientError(500, f"no nodes for slice {slice_i}")
         errs = []
         for node in nodes:
+            # One dead replica must not abort the fan-out: transport
+            # failures (and open breakers) collect alongside HTTP
+            # errors, each prefixed with the failing HOST, and every
+            # surviving replica still receives the import — a retry
+            # after the node recovers then converges all replicas.
             try:
-                client = (
-                    self
-                    if node["host"] == self.host
-                    else InternalClient(node["host"], self.timeout)
-                )
+                client = self._peer(node["host"])
                 status, data = client._request(
                     "POST",
                     "/import",
@@ -328,9 +455,12 @@ class InternalClient:
                 resp = wire.ImportResponse()
                 resp.ParseFromString(client._check(status, data))
                 if resp.Err:
-                    errs.append(resp.Err)
-            except ClientError as e:
-                errs.append(str(e))
+                    errs.append(f"{node['host']}: {resp.Err}")
+            except (
+                (ClientError, resilience.BreakerOpenError)
+                + resilience.TRANSPORT_ERRORS
+            ) as e:
+                errs.append(f"{node['host']}: {e}")
         if errs:
             raise ClientError(500, "; ".join(errs))
 
@@ -353,9 +483,9 @@ class InternalClient:
                 if node["host"] == self.host:
                     continue
                 try:
-                    src = InternalClient(
-                        node["host"], self.timeout
-                    )._export_stream(index, frame, view, slice_i)
+                    src = self._peer(node["host"])._export_stream(
+                        index, frame, view, slice_i
+                    )
                     break
                 except PreconditionFailedError:
                     continue
@@ -576,7 +706,9 @@ class InternalClient:
                 ]
             }
         ).encode()
-        status, data = self._request("POST", path, body=body)
+        # POST in shape, but a pure read (checksum diff) — idempotent,
+        # so the anti-entropy loop rides the retry policy.
+        status, data = self._request("POST", path, body=body, idempotent=True)
         if status == 404:
             raise ClientError(404, "frame not found")
         attrs = json.loads(self._check(status, data))["attrs"]

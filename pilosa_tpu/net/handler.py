@@ -56,9 +56,11 @@ from pilosa_tpu.core import timequantum as tq
 from pilosa_tpu.core.bitmap import RowBitmap
 from pilosa_tpu.exec.executor import ExecOptions, TooManyWritesError
 from pilosa_tpu.net import codec
+from pilosa_tpu.net import resilience as rz
 from pilosa_tpu.net import wire_pb2 as wire
 from pilosa_tpu.obs import prom, trace
 from pilosa_tpu.pql.parser import parse_string
+from pilosa_tpu.testing import faults
 
 PROTOBUF = "application/x-protobuf"
 JSON = "application/json"
@@ -152,6 +154,7 @@ class Handler:
         stream_chunk_bytes: int = 0,
         tracer=None,
         slow_query_ms: float = 0.0,
+        resilience=None,
     ):
         self.holder = holder
         self.executor = executor
@@ -168,6 +171,10 @@ class Handler:
         # 0 disables.  Distinct from cluster.long-query-time (the
         # reference-parity plain-text log below).
         self.slow_query_ms = slow_query_ms
+        # Resilience bundle (net/resilience.py): supplies the default
+        # query deadline and the breaker registry behind
+        # GET /debug/health.  None = no deadlines, no health detail.
+        self.resilience = resilience
         # Chunk size for streamed (chunked transfer encoding) bodies:
         # CSV export and fragment archives move in writes of this size.
         self.stream_chunk_bytes = stream_chunk_bytes or stream_mod.DEFAULT_CHUNK_BYTES
@@ -208,6 +215,7 @@ class Handler:
             ("POST", r"/fragment/import-view", self.handle_post_import_view),
             ("GET", r"/fragment/block/data", self.handle_get_fragment_block_data),
             ("GET", r"/debug/vars", self.handle_get_vars),
+            ("GET", r"/debug/health", self.handle_get_health),
             ("GET", r"/debug/hbm", self.handle_get_hbm),
             ("GET", r"/debug/traces", self.handle_get_traces),
             ("GET", r"/metrics", self.handle_get_metrics),
@@ -225,6 +233,14 @@ class Handler:
     def dispatch(self, req: Request) -> Response:
         t0 = time.monotonic()
         try:
+            # Chaos hook: the RPC-receive boundary (testing/faults.py).
+            # An injected error here answers 500 — the shape of a node
+            # that accepted the connection but is failing inside.
+            faults.check(
+                "rpc.recv",
+                host=getattr(self.executor, "host", "") or None,
+                path=req.path,
+            )
             for method, pattern, fn in self._compiled:
                 m = pattern.match(req.path.rstrip("/") or "/")
                 if m and method == req.method:
@@ -546,9 +562,20 @@ class Handler:
             index=index,
             node=getattr(self.executor, "host", ""),
         )
+        # Deadline: the request's X-Deadline-Ms (the remote leg of a
+        # fan-out, or an external per-request override) wins over the
+        # configured [net] query-timeout-ms default.  The scope rides
+        # a contextvar, so every remote leg, retry sleep, and coalesce
+        # wait under execute() derives its timeout from what's left.
+        dl = None
+        if self.resilience is not None:
+            dl = self.resilience.query_deadline(req.header(rz.DEADLINE_HEADER))
+        else:
+            dl = rz.Deadline.from_header(req.header(rz.DEADLINE_HEADER))
         token = root.activate()
         try:
-            resp = self._handle_post_query(req, index, root)
+            with rz.deadline_scope(dl):
+                resp = self._handle_post_query(req, index, root)
         finally:
             root.deactivate(token)
             record = self.tracer.finish_root(root)
@@ -599,12 +626,25 @@ class Handler:
                 q = parse_string(qreq["query"])
         except Exception as e:  # parser error
             return self._query_error(req, str(e), 400)
-        opt = ExecOptions(remote=qreq["remote"])
+        opt = ExecOptions(
+            remote=qreq["remote"],
+            allow_partial=(
+                req.query.get("allowPartial") == "true"
+                or req.header("X-Allow-Partial") in ("1", "true")
+            ),
+        )
         try:
+            rz.check_deadline("before execute")
             with self.tracer.span("execute"):
                 results = self.executor.execute(index, q, qreq["slices"], opt)
         except TooManyWritesError as e:
             return self._query_error(req, str(e), 413)
+        except rz.DeadlineExceeded as e:
+            # 504 carries the trace id: the retained trace shows where
+            # the budget went.
+            root.annotate(error="DeadlineExceeded")
+            trace_id = getattr(root, "trace_id", "") or "none"
+            return self._query_error(req, f"{e} [trace {trace_id}]", 504)
         except Exception as e:  # noqa: BLE001 — executor boundary
             return self._query_error(req, str(e), 500)
 
@@ -624,10 +664,22 @@ class Handler:
                         column_attr_sets.append((cid, attrs))
 
         if PROTOBUF in req.header("Accept"):
-            return Response.proto(
+            resp = Response.proto(
                 codec.response_to_proto(results, column_attr_sets)
             )
-        return Response.json(codec.response_to_json(results, column_attr_sets))
+            if opt.missing_slices:
+                # The wire protobuf has no partial field (reference
+                # parity); internal callers read the marker off this
+                # header instead.
+                resp.headers["X-Missing-Slices"] = ",".join(
+                    str(s) for s in opt.missing_slices
+                )
+            return resp
+        payload = codec.response_to_json(results, column_attr_sets)
+        if opt.missing_slices:
+            payload["partial"] = True
+            payload["missingSlices"] = opt.missing_slices
+        return Response.json(payload)
 
     def _read_query_request(self, req: Request) -> dict:
         """reference: handler.go:863-944.
@@ -651,7 +703,7 @@ class Handler:
                 "quantum": pb.Quantum or "YMDH",
                 "remote": pb.Remote,
             }
-        valid = {"slices", "columnAttrs", "time_granularity"}
+        valid = {"slices", "columnAttrs", "time_granularity", "allowPartial"}
         for key in req.query:
             if key not in valid:
                 raise ValueError("invalid query params")
@@ -879,6 +931,21 @@ class Handler:
         if self.stats is not None and hasattr(self.stats, "snapshot"):
             payload["stats"] = self.stats.snapshot()
         return Response.json(payload)
+
+    def handle_get_health(self, req: Request) -> Response:
+        """Cluster-resilience view of this node: per-host circuit
+        breaker states (closed/open/half-open, consecutive failures,
+        opens), the retry policy, the default query deadline, and the
+        membership-level node states."""
+        out: dict[str, Any] = {"node": getattr(self.executor, "host", "")}
+        if self.cluster is not None:
+            out["nodes"] = [
+                {"host": h, "state": s}
+                for h, s in sorted(self.cluster.node_states().items())
+            ]
+        if self.resilience is not None:
+            out.update(self.resilience.snapshot())
+        return Response.json(out)
 
     def handle_get_hbm(self, req: Request) -> Response:
         """HBM residency (device/pool.py): per-device budget / resident

@@ -19,10 +19,12 @@ from pilosa_tpu.cluster.topology import Cluster, Node
 from pilosa_tpu.core.holder import Holder
 from pilosa_tpu.exec.executor import Executor
 from pilosa_tpu.exec import warmup
+from pilosa_tpu.net import resilience as rz
 from pilosa_tpu.net import wire_pb2 as wire
-from pilosa_tpu.net.client import InternalClient, client_factory
+from pilosa_tpu.net.client import InternalClient
 from pilosa_tpu.net.handler import Handler, make_http_server
 from pilosa_tpu.obs.trace import Tracer
+from pilosa_tpu.testing import faults
 
 # reference: server.go:38-40
 DEFAULT_ANTI_ENTROPY_INTERVAL = 600.0
@@ -57,6 +59,12 @@ class Server:
         coalesce: bool = True,
         coalesce_max_batch: int = 64,
         coalesce_max_wait_us: int = 0,
+        query_timeout_ms: float = 60_000.0,
+        broadcast_timeout_ms: float = 5_000.0,
+        retry_attempts: int = 3,
+        retry_backoff_ms: float = 100.0,
+        breaker_failure_threshold: int = 5,
+        breaker_open_ms: float = 10_000.0,
     ):
         self.data_dir = data_dir
         self.host = host
@@ -91,6 +99,25 @@ class Server:
         self.coalesce_max_batch = coalesce_max_batch
         self.coalesce_max_wait_us = coalesce_max_wait_us
         self.coalescer = None
+        # Cluster resilience ([net] config, net/resilience.py): the
+        # retry policy and per-host circuit breakers every client this
+        # server hands out shares, plus the default query deadline.
+        # Deadlines flow per request (X-Deadline-Ms); breakers make a
+        # down host fail in microseconds instead of a socket timeout.
+        self.broadcast_timeout_ms = broadcast_timeout_ms
+        self.resilience = rz.Resilience(
+            retry=rz.RetryPolicy(
+                attempts=retry_attempts,
+                backoff=retry_backoff_ms / 1000.0,
+                stats=stats,
+            ),
+            breakers=rz.BreakerRegistry(
+                failure_threshold=breaker_failure_threshold,
+                open_s=breaker_open_ms / 1000.0,
+                stats=stats,
+            ),
+            query_timeout_ms=query_timeout_ms,
+        )
 
         self.holder = Holder(data_dir)
         self.executor: Executor | None = None
@@ -100,6 +127,17 @@ class Server:
         self._closing = threading.Event()
         self._loops: list[threading.Thread] = []
 
+    def _client_factory(self, node) -> InternalClient:
+        """Inter-node clients carrying this server's resilience wiring:
+        shared retry policy, shared per-host breakers, and (via the
+        deadline contextvar) the active query's remaining budget."""
+        host = node if isinstance(node, str) else node.host
+        return InternalClient(
+            host,
+            retry=self.resilience.retry,
+            breakers=self.resilience.breakers,
+        )
+
     # ------------------------------------------------------------------
     # lifecycle (reference: server.go:99-198)
     # ------------------------------------------------------------------
@@ -107,6 +145,16 @@ class Server:
     def open(self) -> None:
         bind_host, _, bind_port = self.host.partition(":")
         port = int(bind_port or 0)
+        # Chaos layer (testing/faults.py): announce an active
+        # PILOSA_FAULTS plan loudly — a soak run must be unmistakable.
+        plan = faults.active()
+        if plan is not None and plan.rules:
+            self.logger(
+                f"FAULT INJECTION ACTIVE: {len(plan.rules)} rule(s): "
+                + "; ".join(
+                    f"{r.stage}/{r.mode}" for r in plan.rules
+                )
+            )
 
         # Max-slice growth must reach peers before queries route there
         # (reference: view.go:236-241 broadcasts CreateSliceMessage).
@@ -192,13 +240,14 @@ class Server:
             holder=self.holder,
             cluster=self.cluster,
             broadcaster=self.broadcaster,
-            client_factory=client_factory,
+            client_factory=self._client_factory,
             version=__version__,
             logger=self.logger,
             stats=self.stats,
             stream_chunk_bytes=self.stream_chunk_bytes,
             tracer=self.tracer,
             slow_query_ms=self.slow_query_ms,
+            resilience=self.resilience,
         )
         # ONE provider feeds both /state (the stream fallback's pull
         # endpoint, any cluster type) and gossip's piggybacked state —
@@ -245,7 +294,7 @@ class Server:
             holder=self.holder,
             host=self.host,
             cluster=self.cluster,
-            client_factory=client_factory,
+            client_factory=self._client_factory,
             tracer=self.tracer,
             prefetcher=(
                 device_mod.prefetcher() if self.device_prefetch else None
@@ -327,12 +376,19 @@ class Server:
 
     def _tick_max_slices(self) -> None:
         """Poll peers' max slices so remote-only slices are queryable
-        (reference: server.go:238-274)."""
+        (reference: server.go:238-274).  The timeout is the configured
+        ``[net] broadcast-timeout-ms`` (once hardcoded 5.0 here), and
+        the GETs ride the shared retry policy + breakers."""
         for node in self.cluster.nodes:
             if node.host == self.host:
                 continue
             try:
-                client = InternalClient(node.host, timeout=5.0)
+                client = InternalClient(
+                    node.host,
+                    timeout=self.broadcast_timeout_ms / 1000.0,
+                    retry=self.resilience.retry,
+                    breakers=self.resilience.breakers,
+                )
                 for index_name, max_slice in client.max_slice_by_index().items():
                     idx = self.holder.index(index_name)
                     if idx is not None:

@@ -48,6 +48,13 @@ _current_span: "contextvars.ContextVar[Span | None]" = contextvars.ContextVar(
 )
 
 
+def current_span() -> "Span | None":
+    """The context-current span (None outside any trace) — lets layers
+    without a Tracer handle (retry policy, clients) annotate the span
+    they run under."""
+    return _current_span.get()
+
+
 def new_trace_id() -> str:
     return uuid.uuid4().hex  # 16 bytes hex
 

@@ -1,0 +1,3 @@
+"""Test-support subpackage — fault injection lives here so the chaos
+layer is importable by the server for soak runs without dragging test
+frameworks into the production tree."""

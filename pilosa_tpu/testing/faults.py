@@ -1,0 +1,246 @@
+"""Deterministic fault injection — the chaos layer behind the resilience
+tests and ``make chaos-smoke`` soak runs.
+
+A :class:`FaultPlan` is a list of match-and-fire rules injected at three
+boundaries (via :func:`check` calls compiled into the hot paths):
+
+* ``rpc.send`` — in :class:`~pilosa_tpu.net.client.InternalClient`,
+  after the breaker/deadline gates and before the socket dial;
+* ``rpc.recv`` — in ``Handler.dispatch``, as a request arrives at a
+  node (an injected error surfaces to the caller as HTTP 500);
+* ``device.launch`` — in the executor, before a fused device program
+  dispatches (direct and coalesced paths).
+
+The plan comes from the ``PILOSA_FAULTS`` environment variable (read
+lazily on first check) or from :func:`install` (tests, soak drivers).
+Spec grammar — semicolon-separated rules, each ``stage:key=value,...``::
+
+    PILOSA_FAULTS='rpc.send:host=127.0.0.1:5001,path=/index/*/query,nth=1,mode=error;
+                   rpc.recv:path=/index/*/query,mode=delay,delay-ms=100,times=1'
+
+Match keys (all optional; a rule with none matches every call at its
+stage):
+
+* ``path``  — fnmatch glob against the request path (no query string)
+* ``host``  — exact ``host:port`` (the TARGET host for rpc.send, the
+  SERVING node for rpc.recv)
+* ``nth``   — fire only on the Nth statically-matching call (1-based)
+* ``times`` — stop firing after this many hits
+* ``prob``  — fire with this probability, drawn from a per-rule RNG
+  seeded by ``seed`` (default 0) — a seeded run is fully deterministic
+
+Actions: ``mode=delay`` sleeps ``delay-ms`` and continues; ``mode=error``
+raises :class:`FaultError` (a ``ConnectionError``, so the retry policy
+sees a transport failure); ``mode=drop`` sleeps ``delay-ms`` then raises
+``socket.timeout`` — a request that vanished into a dead network.
+
+When no plan is installed, :func:`check` is one module-global read.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import os
+import random
+import socket
+import threading
+import time
+
+STAGES = ("rpc.send", "rpc.recv", "device.launch")
+MODES = ("delay", "error", "drop")
+
+
+class FaultError(ConnectionError):
+    """An injected transport error."""
+
+
+class FaultSpecError(ValueError):
+    pass
+
+
+class FaultRule:
+    def __init__(
+        self,
+        stage: str,
+        path: str | None = None,
+        host: str | None = None,
+        nth: int | None = None,
+        times: int | None = None,
+        prob: float | None = None,
+        seed: int | None = None,
+        mode: str = "error",
+        delay_ms: float = 0.0,
+    ):
+        if mode not in MODES:
+            raise FaultSpecError(f"unknown fault mode: {mode!r}")
+        self.stage = stage
+        self.path = path
+        self.host = host
+        self.nth = int(nth) if nth is not None else None
+        self.times = int(times) if times is not None else None
+        self.prob = float(prob) if prob is not None else None
+        self.mode = mode
+        self.delay_ms = float(delay_ms)
+        self._rng = random.Random(seed if seed is not None else 0)
+        self._mu = threading.Lock()
+        # calls: invocations passing the STATIC filters (stage/host/
+        # path) — the counter ``nth`` indexes; hits: times fired.
+        self.calls = 0
+        self.hits = 0
+
+    def _static_match(self, stage: str, host: str | None, path: str | None) -> bool:
+        if stage != self.stage:
+            return False
+        if self.host is not None and host != self.host:
+            return False
+        if self.path is not None and not fnmatch.fnmatchcase(
+            path or "", self.path
+        ):
+            return False
+        return True
+
+    def consider(self, stage: str, host: str | None, path: str | None) -> bool:
+        """Count the call against the rule and decide whether to fire."""
+        if not self._static_match(stage, host, path):
+            return False
+        with self._mu:
+            self.calls += 1
+            if self.nth is not None and self.calls != self.nth:
+                return False
+            if self.times is not None and self.hits >= self.times:
+                return False
+            if self.prob is not None and self._rng.random() >= self.prob:
+                return False
+            self.hits += 1
+            return True
+
+    def fire(self) -> None:
+        if self.delay_ms > 0:
+            time.sleep(self.delay_ms / 1000.0)
+        if self.mode == "delay":
+            return
+        if self.mode == "drop":
+            raise socket.timeout(f"injected drop ({self.stage})")
+        raise FaultError(f"injected error ({self.stage})")
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            out = {
+                "stage": self.stage,
+                "mode": self.mode,
+                "calls": self.calls,
+                "hits": self.hits,
+            }
+        for k in ("path", "host", "nth", "times", "prob"):
+            v = getattr(self, k)
+            if v is not None:
+                out[k] = v
+        if self.delay_ms:
+            out["delayMs"] = self.delay_ms
+        return out
+
+
+class FaultPlan:
+    def __init__(self, rules):
+        self.rules = list(rules)
+
+    def check(self, stage: str, host: str | None = None, path: str | None = None) -> None:
+        for rule in self.rules:
+            if rule.consider(stage, host, path):
+                rule.fire()
+
+    def snapshot(self) -> list[dict]:
+        return [r.snapshot() for r in self.rules]
+
+
+_INT_KEYS = {"nth", "times", "seed"}
+_FLOAT_KEYS = {"prob", "delay_ms"}
+_STR_KEYS = {"path", "host", "mode"}
+
+
+def parse(spec: str) -> FaultPlan:
+    """Parse a ``PILOSA_FAULTS`` spec string into a plan.  Raises
+    :class:`FaultSpecError` on malformed input — a chaos run with a
+    typo'd spec must fail loudly, not silently inject nothing."""
+    rules = []
+    for part in (p.strip() for p in spec.split(";")):
+        if not part:
+            continue
+        stage, sep, opts = part.partition(":")
+        stage = stage.strip()
+        if not sep or not stage:
+            raise FaultSpecError(f"fault rule needs 'stage:opts': {part!r}")
+        kwargs: dict = {}
+        for opt in (o.strip() for o in opts.split(",")):
+            if not opt:
+                continue
+            key, sep, value = opt.partition("=")
+            if not sep:
+                raise FaultSpecError(f"fault option needs key=value: {opt!r}")
+            key = key.strip().replace("-", "_")
+            value = value.strip()
+            try:
+                if key in _INT_KEYS:
+                    kwargs[key] = int(value)
+                elif key in _FLOAT_KEYS:
+                    kwargs[key] = float(value)
+                elif key in _STR_KEYS:
+                    kwargs[key] = value
+                else:
+                    raise FaultSpecError(f"unknown fault option: {key!r}")
+            except ValueError as e:
+                raise FaultSpecError(f"bad fault option {opt!r}: {e}") from e
+        rules.append(FaultRule(stage, **kwargs))
+    return FaultPlan(rules)
+
+
+# ---------------------------------------------------------------------------
+# process-global plan
+# ---------------------------------------------------------------------------
+
+_UNSET = object()  # env not consulted yet
+_plan = _UNSET
+_mu = threading.Lock()
+
+
+def install(plan: "FaultPlan | str") -> FaultPlan:
+    """Install a plan (or spec string) process-wide; returns it so tests
+    can assert on per-rule hit counts."""
+    global _plan
+    if isinstance(plan, str):
+        plan = parse(plan)
+    _plan = plan
+    return plan
+
+
+def clear() -> None:
+    """Deactivate fault injection (and stop consulting the env)."""
+    global _plan
+    _plan = None
+
+
+def reset() -> None:
+    """Forget any installed plan AND re-arm the lazy env read — the
+    fresh-process state."""
+    global _plan
+    _plan = _UNSET
+
+
+def active() -> FaultPlan | None:
+    global _plan
+    if _plan is _UNSET:
+        with _mu:
+            if _plan is _UNSET:
+                spec = os.environ.get("PILOSA_FAULTS", "")
+                _plan = parse(spec) if spec else None
+    return _plan
+
+
+def check(stage: str, host: str | None = None, path: str | None = None) -> None:
+    """The injection point: no-op (one global read) unless a plan with
+    matching rules is installed."""
+    plan = _plan
+    if plan is _UNSET:
+        plan = active()
+    if plan is not None:
+        plan.check(stage, host=host, path=path)

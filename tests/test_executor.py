@@ -8,7 +8,13 @@ import pytest
 from pilosa_tpu.cluster.topology import new_cluster
 from pilosa_tpu.core.holder import Holder
 from pilosa_tpu.core.view import VIEW_INVERSE, VIEW_STANDARD
-from pilosa_tpu.exec import ExecOptions, Executor, ExecutorError, TooManyWritesError
+from pilosa_tpu.exec import (
+    ExecOptions,
+    Executor,
+    ExecutorError,
+    SlicesUnavailableError,
+    TooManyWritesError,
+)
 from pilosa_tpu.ops.bitplane import SLICE_WIDTH
 from pilosa_tpu.pql.parser import parse_string
 
@@ -430,8 +436,13 @@ def test_remote_unavailable_without_replica(holder):
         holder, host=c.nodes[0].host, cluster=c,
         client_factory=lambda node: MockClient(fail),
     )
-    with pytest.raises(ConnectionError):
+    # Fail-fast contract: with no surviving replica the query errors
+    # naming exactly the unreachable slices (and the causing error).
+    remote = c.owns_slices("i", 4, c.nodes[1].host)
+    with pytest.raises(SlicesUnavailableError) as ei:
         e.execute("i", parse_string("Count(Bitmap(rowID=10, frame=f))"))
+    assert ei.value.slices == sorted(remote)
+    assert "remote down" in str(ei.value)
 
 
 def test_remote_opt_executes_local_only(holder):

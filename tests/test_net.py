@@ -650,6 +650,68 @@ class TestMultiNode:
             c0.import_bits("i", "f", sl, [(2, sl * SLICE_WIDTH + 1)])
         assert c0.execute_pql("i", 'Count(Bitmap(frame="f", rowID=2))') == 6
 
+    def test_import_fanout_dead_replica_names_node_and_converges(
+        self, tmp_path
+    ):
+        """Import fan-out with one replica hard-down: the error names
+        the FAILED node (and only it), the surviving replica holds the
+        bits consistently, and re-running the import after the node
+        recovers converges every replica (set-bit imports are
+        idempotent)."""
+        def make(name, host="127.0.0.1:0"):
+            cluster = Cluster(replica_n=2)
+            s = Server(
+                data_dir=str(tmp_path / name), host=host, cluster=cluster,
+                anti_entropy_interval=3600, polling_interval=3600,
+                cache_flush_interval=3600,
+            )
+            s.open()
+            return s
+
+        def join(*servers):
+            for s in servers:
+                for host in sorted(x.host for x in servers):
+                    if s.cluster.node_by_host(host) is None:
+                        s.cluster.add_node(host)
+                s.cluster.nodes.sort(key=lambda n: n.host)
+                s.holder.create_index_if_not_exists("i")
+                s.holder.index("i").create_frame_if_not_exists("f")
+
+        s0 = make("r0")
+        s1 = make("r1")
+        s1b = None
+        try:
+            join(s0, s1)
+            c0 = InternalClient(s0.host, timeout=5.0)
+            bits = [(3, 1), (3, SLICE_WIDTH - 2)]
+            dead_host = s1.host
+            s1.close()  # replica_n=2: slice 0 still has a live owner
+
+            with pytest.raises(ClientError) as ei:
+                c0.import_bits("i", "f", 0, bits)
+            # The error names the failed node and ONLY the failed node.
+            assert dead_host in str(ei.value)
+            assert s0.host not in str(ei.value)
+            # The surviving replica applied the import consistently.
+            frag = s0.holder.fragment("i", "f", "standard", 0)
+            assert frag is not None
+            assert frag.contains(3, 1) and frag.contains(3, SLICE_WIDTH - 2)
+
+            # Recovery: the node comes back on the same host/data_dir;
+            # a retried import converges all replicas.
+            s1b = make("r1", host=dead_host)
+            join(s0, s1b)
+            c0.import_bits("i", "f", 0, bits)
+            for s in (s0, s1b):
+                frag = s.holder.fragment("i", "f", "standard", 0)
+                assert frag is not None, s.host
+                assert frag.contains(3, 1), s.host
+                assert frag.contains(3, SLICE_WIDTH - 2), s.host
+        finally:
+            s0.close()
+            if s1b is not None:
+                s1b.close()
+
     def test_topn_two_phase_across_nodes(self, two_servers):
         """Distributed two-phase TopN: phase 1 trims to each slice's
         local top-n, so a row that ranks 3rd on every slice but 2nd
