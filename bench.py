@@ -506,6 +506,18 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — the artifact must survive
             log(f"hbm-pressure tier FAILED ({e!r:.300})")
 
+    # --- tier 6: BSI Range/Sum over integer bit-planes -----------------
+    bsi_tier = None
+    if os.environ.get("BENCH_SKIP_BSI_TIER") != "1":
+        try:
+            bsi_tier = with_retries(
+                "bsi tier",
+                lambda: run_bsi_tier(rng, n_slices, cpu_fallback),
+                attempts=2,
+            )
+        except Exception as e:  # noqa: BLE001 — the artifact must survive
+            log(f"bsi tier FAILED ({e!r:.300})")
+
     if cpu_fallback:
         metric += "_cpu_fallback"
 
@@ -563,6 +575,8 @@ def main() -> None:
         out["coalesce"] = coalesce_stats
     if hbm_pressure is not None:
         out["hbm_pressure"] = hbm_pressure
+    if bsi_tier is not None:
+        out["bsi"] = bsi_tier
     print(json.dumps(out))
 
 
@@ -718,6 +732,121 @@ def run_hbm_pressure_tier(rng, cpu_fb=False) -> dict:
                 f" (budget {out['budget_mib_per_device']} MiB/device)"
             )
         holder.close()
+        return out
+
+
+def run_bsi_tier(rng, n_slices, cpu_fb=False) -> dict:
+    """``bsi`` tier: BSI Range + Sum over the standard corpus slice
+    count.  A depth-8 integer field (every column valued, uniform
+    0..255) plane-injected into a range-enabled frame; measures
+    ``Count(Range(v > 100))`` and ``Sum(field=v)`` end to end through
+    the executor with the coalescer on (the production path), reporting
+    Gcols/s + ms/query.  Expected results come from an independent host
+    computation over the injected planes, so the tier is also a
+    bit-exactness anchor at corpus scale."""
+    from pilosa_tpu import bsi
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.exec.coalesce import CoalesceScheduler
+    from pilosa_tpu.exec.executor import Executor
+    from pilosa_tpu.ops import bitplane as bpl
+    from pilosa_tpu.pql.parser import parse_string
+
+    depth = 8
+    pred = 100
+    trim = dict(n_serial=2, trials=1) if cpu_fb else dict(n_serial=8, trials=3)
+    total_columns = n_slices * bpl.SLICE_WIDTH
+    with tempfile.TemporaryDirectory() as d:
+        holder = Holder(d)
+        holder.open()
+        idx = holder.create_index("b")
+        fr = idx.create_frame("fb")
+        fr.set_options(range_enabled=True)
+        fr.create_field("v", 0, (1 << depth) - 1)
+        view = fr.create_view_if_not_exists(bsi.field_view_name("v"))
+        planes = rng.integers(
+            0, 2**32, size=(n_slices, depth, bpl.WORDS_PER_SLICE),
+            dtype=np.uint32,
+        )
+        ones = np.full((1, bpl.WORDS_PER_SLICE), 0xFFFFFFFF, np.uint32)
+        zeros = np.zeros((1, bpl.WORDS_PER_SLICE), np.uint32)
+        for s in range(n_slices):
+            prime_fragment(
+                view.create_fragment_if_not_exists(s),
+                np.concatenate([ones, zeros, planes[s]]),
+                bpl.pad_rows,
+            )
+
+        # Host reference, straight from the planes: Sum is the weighted
+        # plane dot; the Range count rides the gt ripple in numpy.
+        plane_pops = np.bitwise_count(planes).sum(axis=-1, dtype=np.int64)
+        want_sum = int(sum((1 << k) * int(plane_pops[:, k].sum()) for k in range(depth)))
+        gt = np.zeros((n_slices, bpl.WORDS_PER_SLICE), np.uint32)
+        eq = np.full((n_slices, bpl.WORDS_PER_SLICE), 0xFFFFFFFF, np.uint32)
+        for k in reversed(range(depth)):
+            b = planes[:, k]
+            if (pred >> k) & 1:
+                eq_new = eq & b
+            else:
+                gt = gt | (eq & b)
+                eq_new = eq & ~b
+            eq = eq_new
+        want_gt = int(np.bitwise_count(gt).sum())
+
+        co = CoalesceScheduler()
+        ex = Executor(holder, host="localhost:0", coalescer=co)
+        out = {
+            "depth": depth,
+            "bucket": bsi.pad_depth(depth),
+            "columns": total_columns,
+        }
+        try:
+            rq = parse_string(f"Count(Range(frame=fb, v > {pred}))")
+            sq = parse_string("Sum(frame=fb, field=v)")
+
+            def check_range(res):
+                assert int(res[0]) == want_gt, f"bsi Range exactness: {res[0]}"
+
+            def check_sum(res):
+                vc = res[0]
+                assert (int(vc.value), int(vc.count)) == (
+                    want_sum,
+                    total_columns,
+                ), f"bsi Sum exactness: {vc}"
+
+            for label, pq, check in (
+                ("range", rq, check_range),
+                ("sum", sq, check_sum),
+            ):
+                t0 = time.perf_counter()
+                (got,) = ex.execute("b", pq)
+                check([got])
+                cold_s = time.perf_counter() - t0
+                p50, per_q, conc_p50 = measure_query(
+                    ex, "b", pq, check, n_conc=8 if cpu_fb else 32, **trim
+                )
+                tier = {
+                    "cold_ms": round(cold_s * 1e3, 2),
+                    "ms_per_query": round(p50 * 1e3, 3),
+                    "concurrent_ms_per_query": round(per_q * 1e3, 3),
+                    "p50_under_load_ms": round(conc_p50 * 1e3, 3),
+                    "gcols_s": round(total_columns / per_q / 1e9, 3),
+                    "sync_gcols_s": round(total_columns / p50 / 1e9, 3),
+                }
+                out[label] = tier
+                log(
+                    f"bsi {label} (depth {depth}): cold {tier['cold_ms']:.1f} ms;"
+                    f" sync p50 {tier['ms_per_query']:.2f} ms/query"
+                    f" ({tier['sync_gcols_s']:.2f} Gcols/s); concurrent"
+                    f" {tier['concurrent_ms_per_query']:.2f} ms/query"
+                    f" ({tier['gcols_s']:.2f} Gcols/s)"
+                )
+            snap = co.snapshot()
+            out["coalesce_launches"] = snap["launches"]
+            out["coalesced_queries"] = snap["queries"]
+        finally:
+            ex.close()
+            co.close()
+            holder.close()
         return out
 
 

@@ -1,0 +1,164 @@
+"""The BSI ripple — comparison, Sum, and Min/Max over bit-planes.
+
+One implementation, two array backends: the fused device kernels
+(exec/plan.py embeds these into jitted XLA programs, ``xp=jax.numpy``)
+and the host reference path (plan.eval_expr_np, ``xp=numpy``) share
+these functions verbatim, so the device programs can never drift from
+the host semantics.
+
+Everything here is an and/andnot/or cascade over limb planes plus
+popcount reductions — exactly the op mix ``ops/bitplane.py`` already
+executes as one fused bitwise+popcount pass.  Predicates arrive as
+DATA (a packed :func:`pilosa_tpu.bsi.pred_row`), so a compiled program
+serves every predicate value of its (op kind, depth bucket).
+"""
+
+from __future__ import annotations
+
+_FULL = 0xFFFFFFFF
+
+
+def _bit_mask(word, xp):
+    """uint32 scalar word (0/1) -> all-ones/all-zeros uint32 mask,
+    without overflow-warning-prone unsigned negation."""
+    return (word & xp.uint32(1)) * xp.uint32(_FULL)
+
+
+def magnitude_cmp(exists, planes, pred_bits, xp):
+    """Range-encoded ripple: partition the ``exists`` columns into
+    (lt, eq, gt) against the unsigned magnitude whose bit ``k`` is
+    ``pred_bits[k] & 1``.  High plane to low: columns still equal on
+    every higher bit split on the current one."""
+    eq = exists
+    lt = xp.zeros_like(exists)
+    gt = xp.zeros_like(exists)
+    for k in reversed(range(len(planes))):
+        b = planes[k]
+        m = _bit_mask(pred_bits[k], xp)
+        lt = lt | (eq & ~b & m)
+        gt = gt | (eq & b & ~m)
+        eq = eq & (b ^ ~m)
+    return lt, eq, gt
+
+
+def signed_cmp(op, exists, sign, planes, pred, xp):
+    """One signed comparison row.  ``pred`` is a packed predicate row
+    (bit ``k`` of the magnitude at word ``k``, sign flag at word
+    ``len(planes)``); ``op`` is a static tag (lt/le/eq/ne/ge/gt).
+
+    Sign-magnitude composition: the magnitude partition applies to the
+    matching sign group, with ordering inverted among negatives; the
+    predicate's own sign selects between the two composition cases via
+    a data mask, so positive and negative predicates share one
+    compiled program."""
+    depth = len(planes)
+    lt, eq, gt = magnitude_cmp(exists, planes, pred[:depth], xp)
+    nm = _bit_mask(pred[depth], xp)  # all-ones iff the predicate is negative
+    pos = exists & ~sign
+    neg = exists & sign
+
+    eq_row = (~nm & pos & eq) | (nm & neg & eq)
+    if op == "eq":
+        return eq_row
+    if op == "ne":
+        return exists & ~eq_row
+    lt_row = (~nm & (neg | (pos & lt))) | (nm & neg & gt)
+    if op == "lt":
+        return lt_row
+    if op == "le":
+        return lt_row | eq_row
+    gt_row = (~nm & pos & gt) | (nm & (pos | (neg & lt)))
+    if op == "gt":
+        return gt_row
+    if op == "ge":
+        return gt_row | eq_row
+    raise ValueError(f"unknown BSI comparison op {op!r}")
+
+
+def between_row(exists, sign, planes, pred_lo, pred_hi, xp):
+    """``lo <= v <= hi`` as two fused ripples sharing the plane reads."""
+    return signed_cmp("ge", exists, sign, planes, pred_lo, xp) & signed_cmp(
+        "le", exists, sign, planes, pred_hi, xp
+    )
+
+
+def sum_vec(exists, sign, planes, filt, xp, popcount):
+    """Per-slice Sum partials: int vector
+    ``[pos_0..pos_{D-1}, neg_0..neg_{D-1}, n]`` where ``pos_k`` /
+    ``neg_k`` count set bits of plane ``k`` among non-negative /
+    negative valued columns and ``n`` counts valued columns — the
+    popcount-weighted plane dot finishes on the host in unbounded
+    Python ints: ``sum = Σ 2^k (pos_k - neg_k)``.  Each partial covers
+    one slice-row (<= 2^20 bits), so int32 is exact."""
+    base = exists if filt is None else exists & filt
+    pos = base & ~sign
+    neg = base & sign
+    parts = [popcount(p & pos) for p in planes]
+    parts += [popcount(p & neg) for p in planes]
+    parts.append(popcount(base))
+    return xp.stack(parts)
+
+
+def minmax_vec(which, exists, sign, planes, filt, xp, popcount, where):
+    """Per-slice Min/Max partials via greedy plane descent: int vector
+    ``[bit_0..bit_{D-1}, negative, count]`` — the chosen magnitude
+    bits, whether the extreme is negative, and how many columns hold
+    it (count 0 = no valued columns in the slice).
+
+    Min prefers the negative group (where the LARGEST magnitude wins);
+    Max prefers the non-negative group (largest magnitude wins too) —
+    so both run ONE descent whose direction is maximize-within-group,
+    falling back to the opposite group with a minimizing descent.  The
+    group choice and both descents are data-dependent selects inside
+    the fused program, never separate compiles."""
+    base = exists if filt is None else exists & filt
+    pos = base & ~sign
+    neg = base & sign
+    if which == "min":
+        prefer, other = neg, pos
+    else:
+        prefer, other = pos, neg
+    use_prefer = xp.asarray(popcount(prefer) > 0)
+    cand = where(use_prefer, prefer, other)
+    # maximize magnitude within the preferred group, minimize in the
+    # fallback group (see docstring) — identical rule for min and max.
+    maximize = use_prefer
+
+    bits = [None] * len(planes)
+    for k in reversed(range(len(planes))):
+        b = planes[k]
+        with_one = cand & b
+        n1 = popcount(with_one)
+        ntot = popcount(cand)
+        # maximize: take bit 1 iff any candidate has it;
+        # minimize: take bit 1 only when every candidate has it.
+        choose1 = where(maximize, xp.asarray(n1 > 0), xp.asarray(n1 == ntot))
+        cand = where(choose1, with_one, cand & ~b)
+        bits[k] = xp.asarray(choose1).astype(xp.int32)
+    negative = (
+        use_prefer if which == "min" else xp.logical_not(use_prefer)
+    ).astype(xp.int32)
+    return xp.stack(bits + [negative, xp.asarray(popcount(cand), dtype=xp.int32)])
+
+
+def decode_minmax(vec, depth: int) -> tuple[int, int] | None:
+    """One slice's ``minmax_vec`` output -> ``(value, count)`` in
+    Python ints, or None when the slice holds no valued column."""
+    count = int(vec[depth + 1])
+    if count <= 0:
+        return None
+    mag = 0
+    for k in range(depth):
+        if int(vec[k]):
+            mag |= 1 << k
+    return (-mag if int(vec[depth]) else mag), count
+
+
+def decode_sum(vec, depth: int) -> tuple[int, int]:
+    """One slice's ``sum_vec`` output -> ``(sum, count)`` in Python
+    ints (exact at any depth — the weights never touch device
+    arithmetic)."""
+    total = 0
+    for k in range(depth):
+        total += (1 << k) * (int(vec[k]) - int(vec[depth + k]))
+    return total, int(vec[2 * depth])

@@ -277,8 +277,65 @@ def run_warm(args) -> int:
 def run_import(args) -> int:
     client = _client(args.host)
     for path in args.paths:
-        _import_path(client, args, path)
+        if getattr(args, "value", ""):
+            _import_value_path(client, args, path)
+        else:
+            _import_path(client, args, path)
     return 0
+
+
+def _import_value_path(client, args, path: str) -> None:
+    """``--value FIELD``: CSV records are ``column,value`` (signed
+    integers), imported columnar into a BSI field via /import-value."""
+    if path == "-":
+        _import_value_reader(client, args, sys.stdin)
+        return
+    with open(path, newline="") as f:
+        _import_value_reader(client, args, f)
+
+
+def _import_value_reader(client, args, f) -> None:
+    buf: list[tuple[int, int]] = []
+    for rnum, record in enumerate(csv.reader(f), start=1):
+        if not record or record[0] == "":
+            continue
+        if len(record) < 2:
+            raise CommandError(f"bad column count on row {rnum}")
+        try:
+            col_id = int(record[0])
+        except ValueError:
+            raise CommandError(f"invalid column id on row {rnum}: {record[0]!r}")
+        try:
+            value = int(record[1])
+        except ValueError:
+            raise CommandError(f"invalid value on row {rnum}: {record[1]!r}")
+        buf.append((col_id, value))
+        if len(buf) >= args.buffer_size:
+            _flush_values(client, args, buf)
+            buf.clear()
+    _flush_values(client, args, buf)
+
+
+def _flush_values(client, args, pairs: list[tuple[int, int]]) -> None:
+    if not pairs:
+        return
+    by_slice: dict[int, list] = {}
+    for col, val in pairs:
+        by_slice.setdefault(col // SLICE_WIDTH, []).append((col, val))
+    for slice_i in sorted(by_slice):
+        group = by_slice[slice_i]
+        print(
+            f"importing values: slice={slice_i}, n={len(group)}",
+            file=sys.stderr,
+        )
+        client.import_value(
+            args.index,
+            args.frame,
+            args.value,
+            slice_i,
+            [c for c, _ in group],
+            [v for _, v in group],
+        )
 
 
 # Native CSV fast path reads the file in blocks of this many bytes, so

@@ -53,7 +53,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-c", "--config", default="", help="TOML config file")
     p.set_defaults(fn=ctl.run_warm)
 
-    p = sub.add_parser("import", help="bulk-import CSV bits (row,col[,ts])")
+    p = sub.add_parser(
+        "import",
+        help="bulk-import CSV bits (row,col[,ts]);"
+        " with --value FIELD, integer values (col,value)",
+    )
+    p.add_argument(
+        "--value",
+        default="",
+        metavar="FIELD",
+        help="import integer values (col,value CSV) into this BSI field",
+    )
     _add_host(p)
     p.add_argument("-i", "--index", required=True)
     p.add_argument("-f", "--frame", required=True)

@@ -52,6 +52,16 @@ class AttrStore:
         self._lock = threading.RLock()
         self._cache: dict[int, dict[str, Any]] = {}
         self._db: sqlite3.Connection | None = None
+        # Per-block checksums, maintained INCREMENTALLY at write time:
+        # a block's digest is the XOR of sha1(id || json) over its
+        # non-empty rows (order-independent, so a write updates it in
+        # O(1) by xoring out the row's old term and xoring in the new
+        # one) plus a non-empty-row count to detect emptied blocks.
+        # blocks() then costs O(#blocks) dict reads instead of
+        # SELECT+JSON-parsing the whole table per sync tick per peer.
+        self._block_sums: dict[int, bytes] = {}
+        self._block_counts: dict[int, int] = {}
+        self._scanned = False  # digests cover the whole table
 
     # --- lifecycle ---
 
@@ -62,12 +72,23 @@ class AttrStore:
             "CREATE TABLE IF NOT EXISTS attrs (id INTEGER PRIMARY KEY, data TEXT)"
         )
         self._db.commit()
+        self._block_sums = {}
+        self._block_counts = {}
+        # A fresh (empty) store's digests are trivially complete, and
+        # every subsequent write maintains them — the common path never
+        # scans.  A store reopened over existing rows digests lazily on
+        # the first blocks() call (one streaming pass, once per open).
+        row = self._db.execute("SELECT 1 FROM attrs LIMIT 1").fetchone()
+        self._scanned = row is None
 
     def close(self) -> None:
         if self._db is not None:
             self._db.close()
             self._db = None
         self._cache.clear()
+        self._block_sums = {}
+        self._block_counts = {}
+        self._scanned = False
 
     def _conn(self) -> sqlite3.Connection:
         if self._db is None:
@@ -94,7 +115,8 @@ class AttrStore:
         (reference: attr.go:120-155, 268-303)."""
         validate_attrs(attrs)
         with self._lock:
-            cur = self.attrs(id_)
+            old = self.attrs(id_)
+            cur = dict(old)
             for k, v in attrs.items():
                 if v is None:
                     cur.pop(k, None)
@@ -106,6 +128,7 @@ class AttrStore:
             )
             self._conn().commit()
             self._cache[id_] = cur
+            self._digest_update_locked(id_, old, cur)
 
     # SQLite's bound-parameter ceiling is 999 before 3.32; stay under it.
     _SELECT_BATCH = 500
@@ -136,8 +159,10 @@ class AttrStore:
                     self._cache[_from_db_id(db_id)] = json.loads(data)
             params: list[tuple[int, str]] = []
             merged: dict[int, dict[str, Any]] = {}
+            olds: dict[int, dict[str, Any]] = {}
             for id_ in ids:
-                cur = dict(self._cache.get(id_, {}))
+                old = self._cache.get(id_, {})
+                cur = dict(old)
                 for k, v in attr_sets[id_].items():
                     if v is None:
                         cur.pop(k, None)
@@ -145,6 +170,7 @@ class AttrStore:
                         cur[k] = v
                 params.append((_to_db_id(id_), json.dumps(cur, sort_keys=True)))
                 merged[id_] = cur
+                olds[id_] = old
             try:
                 conn.executemany(
                     "INSERT OR REPLACE INTO attrs (id, data) VALUES (?, ?)",
@@ -157,56 +183,109 @@ class AttrStore:
             # Cache updates only after the transaction commits — a
             # rolled-back batch must not leave phantom attrs in memory.
             self._cache.update(merged)
+            for id_ in ids:
+                self._digest_update_locked(id_, olds[id_], merged[id_])
 
     # --- anti-entropy (reference: attr.go:193-254, 411-441) ---
 
-    def blocks(self) -> list[tuple[int, bytes]]:
-        """[(block_id, sha1)] over all ids, blocked per 100 ids."""
-        with self._lock:
-            rows = self._conn().execute(
-                "SELECT id, data FROM attrs"
-            ).fetchall()
-        # Sort by the *unsigned* id so block order matches the
-        # reference's big-endian key order.
-        rows = sorted((_from_db_id(i), d) for i, d in rows)
-        out: list[tuple[int, bytes]] = []
-        h = None
-        cur_block = None
-        for id_, data in rows:
-            if json.loads(data) == {}:
-                continue
-            b = id_ // ATTR_BLOCK_SIZE
-            if b != cur_block:
-                if h is not None:
-                    out.append((cur_block, h.digest()))
-                cur_block, h = b, hashlib.sha1()
-            h.update(id_.to_bytes(8, "big"))
-            h.update(data.encode())
-        if h is not None:
-            out.append((cur_block, h.digest()))
-        return out
+    @staticmethod
+    def _row_term(id_: int, data: str) -> int:
+        """One non-empty row's digest term: sha1 over the unsigned id
+        and the row's canonical json text (writes always store
+        sort_keys=True, so text identity == value identity)."""
+        h = hashlib.sha1()
+        h.update(id_.to_bytes(8, "big"))
+        h.update(data.encode())
+        return int.from_bytes(h.digest(), "big")
 
-    def block_data(self, block_id: int) -> dict[int, dict[str, Any]]:
-        """All attrs in one block (reference: BlockData, attr.go:226-254)."""
+    def _digest_update_locked(
+        self, id_: int, old: dict[str, Any], new: dict[str, Any]
+    ) -> None:
+        """O(1) block-digest maintenance for one row write: xor out the
+        old term, xor in the new one.  Skipped while the store hasn't
+        digested its pre-existing rows yet (the lazy first scan reads
+        this write's committed value from the table anyway)."""
+        if not self._scanned or old == new:
+            return
+        b = id_ // ATTR_BLOCK_SIZE
+        acc = int.from_bytes(self._block_sums.get(b, b"\0" * 20), "big")
+        n = self._block_counts.get(b, 0)
+        if old:
+            acc ^= self._row_term(id_, json.dumps(old, sort_keys=True))
+            n -= 1
+        if new:
+            acc ^= self._row_term(id_, json.dumps(new, sort_keys=True))
+            n += 1
+        if n <= 0:
+            self._block_sums.pop(b, None)
+            self._block_counts.pop(b, None)
+        else:
+            self._block_sums[b] = acc.to_bytes(20, "big")
+            self._block_counts[b] = n
+
+    def blocks(self) -> list[tuple[int, bytes]]:
+        """[(block_id, digest)] over all ids, blocked per 100 ids.
+
+        A block's digest is the XOR of its rows' sha1 terms —
+        order-independent, so writes keep it current in O(1)
+        (_digest_update_locked) and this call is a dict copy, not the
+        full SELECT+JSON-parse of every row the sync loop used to pay
+        per tick per peer.  Only a store reopened over existing rows
+        pays one streaming digest pass, on its first blocks() call."""
+        with self._lock:
+            if not self._scanned:
+                self._scan_all_blocks_locked()
+            return sorted(self._block_sums.items())
+
+    def _scan_all_blocks_locked(self) -> None:
+        """One streaming pass over the whole table — only on the first
+        blocks() after an open() that found existing rows."""
+        sums: dict[int, int] = {}
+        counts: dict[int, int] = {}
+        cur = self._conn().execute("SELECT id, data FROM attrs")
+        for db_id, data in cur:
+            if data == "{}" or json.loads(data) == {}:
+                continue
+            id_ = _from_db_id(db_id)
+            b = id_ // ATTR_BLOCK_SIZE
+            sums[b] = sums.get(b, 0) ^ self._row_term(id_, data)
+            counts[b] = counts.get(b, 0) + 1
+        self._block_sums = {b: v.to_bytes(20, "big") for b, v in sums.items()}
+        self._block_counts = counts
+        self._scanned = True
+
+    def _block_rows_locked(self, block_id: int):
+        """One block's rows as ``(unsigned id, raw json text)`` in
+        unsigned-id order, streamed by cursor.  "ORDER BY (id < 0), id"
+        is unsigned order under the two's-complement id mapping."""
         lo = block_id * ATTR_BLOCK_SIZE
         hi = lo + ATTR_BLOCK_SIZE
         dlo, dhi = _to_db_id(lo), _to_db_id(hi - 1)
+        if dlo <= dhi:
+            cur = self._conn().execute(
+                "SELECT id, data FROM attrs WHERE id >= ? AND id <= ?"
+                " ORDER BY (id < 0), id",
+                (dlo, dhi),
+            )
+        else:  # block straddles the uint63 sign boundary
+            cur = self._conn().execute(
+                "SELECT id, data FROM attrs WHERE id >= ? OR id <= ?"
+                " ORDER BY (id < 0), id",
+                (dlo, dhi),
+            )
+        for db_id, data in cur:
+            yield _from_db_id(db_id), data
+
+    def block_data(self, block_id: int) -> dict[int, dict[str, Any]]:
+        """All attrs in one block (reference: BlockData, attr.go:226-254),
+        streamed straight off the range cursor."""
         with self._lock:
-            if dlo <= dhi:
-                rows = self._conn().execute(
-                    "SELECT id, data FROM attrs WHERE id >= ? AND id <= ?",
-                    (dlo, dhi),
-                ).fetchall()
-            else:  # block straddles the uint63 sign boundary
-                rows = self._conn().execute(
-                    "SELECT id, data FROM attrs WHERE id >= ? OR id <= ?",
-                    (dlo, dhi),
-                ).fetchall()
-        return {
-            _from_db_id(id_): json.loads(data)
-            for id_, data in sorted(rows)
-            if json.loads(data)
-        }
+            out: dict[int, dict[str, Any]] = {}
+            for id_, data in self._block_rows_locked(block_id):
+                m = json.loads(data)
+                if m:
+                    out[id_] = m
+            return out
 
 
 def diff_blocks(

@@ -1333,12 +1333,29 @@ class Fragment:
         with self._mu:
             self._flush_ops_locked()
 
-    def import_bulk(self, row_ids: Sequence[int], column_ids: Sequence[int]) -> None:
+    def import_bulk(
+        self,
+        row_ids: Sequence[int],
+        column_ids: Sequence[int],
+        clear_row_ids: Sequence[int] | None = None,
+        clear_column_ids: Sequence[int] | None = None,
+    ) -> None:
         """Bulk load: op-log off, vectorized scatter, cache recount per
-        touched row, snapshot (reference: fragment.go:936-1004)."""
-        if len(row_ids) != len(column_ids):
+        touched row, snapshot (reference: fragment.go:936-1004).
+
+        ``clear_row_ids``/``clear_column_ids`` optionally clear bits in
+        the same pass (one snapshot, one recount) — the overwrite half
+        of a BSI value import.  Clears never create rows; a clear on an
+        absent row is a no-op.  A bit must not appear in both lists."""
+        clear_row_ids = clear_row_ids if clear_row_ids is not None else []
+        clear_column_ids = (
+            clear_column_ids if clear_column_ids is not None else []
+        )
+        if len(row_ids) != len(column_ids) or len(clear_row_ids) != len(
+            clear_column_ids
+        ):
             raise FragmentError("mismatch of row/column len")
-        if len(row_ids) == 0:
+        if len(row_ids) == 0 and len(clear_row_ids) == 0:
             return
         with self._mu:
             rows = np.asarray(row_ids, dtype=np.int64)
@@ -1392,6 +1409,46 @@ class Fragment:
                     else:
                         merged = np.union1d(cur, seg).astype(np.uint32)
                     self._sparse[int(r)] = merged
+
+            # ---- clears (the BSI overwrite path): clears only touch
+            # rows that EXIST; dense rows take one vectorized andnot
+            # scatter, sparse rows a per-row sorted difference.
+            if len(clear_row_ids):
+                c_rows = np.asarray(clear_row_ids, dtype=np.int64)
+                c_cols = np.asarray(clear_column_ids, dtype=np.int64)
+                if ((c_cols < min_col) | (c_cols >= min_col + SLICE_WIDTH)).any():
+                    raise FragmentError("column out of bounds for slice")
+                c_offs = c_cols % SLICE_WIDTH
+                for r in np.unique(c_rows):
+                    r = int(r)
+                    if r in slot_of:
+                        continue
+                    slot = self._slot_of.get(r)
+                    if slot is None and r not in self._sparse:
+                        continue  # clears never create rows
+                    slot_of[r] = slot
+                c_keep = np.asarray(
+                    [int(r) in slot_of for r in c_rows], dtype=bool
+                )
+                c_rows, c_offs = c_rows[c_keep], c_offs[c_keep]
+                c_slots = np.asarray(
+                    [
+                        -1 if slot_of[int(r)] is None else slot_of[int(r)]
+                        for r in c_rows
+                    ],
+                    dtype=np.int64,
+                )
+                dm = c_slots >= 0
+                if dm.any():
+                    bp.np_clear_bulk(self._plane, c_slots[dm], c_offs[dm])
+                if (~dm).any():
+                    s_rows = c_rows[~dm]
+                    s_offs = c_offs[~dm].astype(np.uint32)
+                    for r in np.unique(s_rows):
+                        self._sparse[int(r)] = np.setdiff1d(
+                            self._sparse[int(r)], s_offs[s_rows == r]
+                        ).astype(np.uint32)
+                uniq = np.union1d(uniq, np.unique(c_rows)).astype(np.int64)
 
             self._version += 1
             _bump_write_epoch()

@@ -21,6 +21,7 @@ from datetime import datetime
 
 import numpy as np
 
+from pilosa_tpu import bsi
 from pilosa_tpu.core import cache as cache_mod
 from pilosa_tpu.core import timequantum as tq
 from pilosa_tpu.core.attr import AttrStore
@@ -59,6 +60,10 @@ class Frame:
         self.cache_size = DEFAULT_CACHE_SIZE
         self.inverse_enabled = False
         self.time_quantum = ""
+        # BSI integer fields (pilosa_tpu/bsi): declared per frame when
+        # range_enabled, each stored in its own ``field_<name>`` view.
+        self.range_enabled = False
+        self._fields: dict[str, bsi.BSIField] = {}
         self.row_attr_store = AttrStore(os.path.join(path, ".data"))
         self.on_create_slice = None  # wired by Index/Holder
         self.stats = NopStatsClient()  # re-tagged by Index._new_frame
@@ -100,6 +105,13 @@ class Frame:
         self.cache_size = meta.get("cacheSize", DEFAULT_CACHE_SIZE)
         self.inverse_enabled = meta.get("inverseEnabled", False)
         self.time_quantum = meta.get("timeQuantum", "")
+        self.range_enabled = meta.get("rangeEnabled", False)
+        self._fields = {
+            f["name"]: bsi.BSIField(
+                name=f["name"], min=int(f["min"]), max=int(f["max"])
+            )
+            for f in meta.get("fields", [])
+        }
 
     def save_meta(self) -> None:
         with self._mu:
@@ -113,6 +125,11 @@ class Frame:
                         "cacheSize": self.cache_size,
                         "inverseEnabled": self.inverse_enabled,
                         "timeQuantum": self.time_quantum,
+                        "rangeEnabled": self.range_enabled,
+                        "fields": [
+                            self._fields[n].to_dict()
+                            for n in sorted(self._fields)
+                        ],
                     },
                     fh,
                 )
@@ -125,6 +142,7 @@ class Frame:
         cache_size: int | None = None,
         inverse_enabled: bool | None = None,
         time_quantum: str | None = None,
+        range_enabled: bool | None = None,
     ) -> None:
         with self._mu:
             if row_label is not None:
@@ -140,6 +158,8 @@ class Frame:
                 self.inverse_enabled = inverse_enabled
             if time_quantum is not None:
                 self.time_quantum = tq.parse_time_quantum(time_quantum)
+            if range_enabled is not None:
+                self.range_enabled = range_enabled
             self.save_meta()
 
     def set_time_quantum(self, q: str) -> None:
@@ -151,6 +171,79 @@ class Frame:
         # invalidate epoch-validated read caches (executor leaf batches)
         # exactly like a data write would.
         fragment_mod._bump_write_epoch()
+
+    # --- BSI integer fields (pilosa_tpu/bsi) ---
+
+    def bsi_field(self, name: str) -> bsi.BSIField | None:
+        with self._mu:
+            return self._fields.get(name)
+
+    def bsi_fields(self) -> list[bsi.BSIField]:
+        with self._mu:
+            return [self._fields[n] for n in sorted(self._fields)]
+
+    def create_field(self, name: str, min: int, max: int) -> bsi.BSIField:
+        """Declare an integer field.  Requires ``rangeEnabled``; the
+        ``field_<name>`` view (and its fragments) materialize lazily on
+        the first value import."""
+        with self._mu:
+            if not self.range_enabled:
+                raise FrameError("frame does not support range queries")
+            if name in self._fields:
+                raise FrameError(f"field already exists: {name!r}")
+            bsi.validate_field(name, min, max)
+            fld = bsi.BSIField(name=name, min=int(min), max=int(max))
+            self._fields[name] = fld
+            self.save_meta()
+        # A new field changes how Range()/Sum() calls over this frame
+        # plan (depth, view set) — invalidate epoch-validated caches.
+        fragment_mod._bump_write_epoch()
+        return fld
+
+    def delete_field(self, name: str) -> None:
+        with self._mu:
+            fld = self._fields.pop(name, None)
+            if fld is None:
+                raise FrameError(f"field not found: {name!r}")
+            self.save_meta()
+        self.delete_view(bsi.field_view_name(name))
+        fragment_mod._bump_write_epoch()
+
+    def import_value(self, field: str, column_ids, values) -> None:
+        """Columnar integer import: one value per column, grouped by
+        slice, each slice written as ONE vectorized set+clear pass over
+        the field view's bit-planes (a re-imported column's previous
+        value is fully overwritten)."""
+        with self._mu:
+            fld = self._fields.get(field)
+        if fld is None:
+            raise FrameError(f"field not found: {field!r}")
+        cols = np.asarray(column_ids, dtype=np.int64)
+        if len(cols) == 0:
+            return
+        set_r, set_c, clr_r, clr_c = bsi.value_bit_rows(fld, cols, values)
+        view = self.create_view_if_not_exists(fld.view)
+        # Group both halves by slice in one pass: tag set bits 0 and
+        # clear bits 1, then split per slice group.
+        all_c = np.concatenate([set_c, clr_c])
+        all_r = np.concatenate([set_r, clr_r])
+        tags = np.concatenate(
+            [np.zeros(len(set_c), np.int64), np.ones(len(clr_c), np.int64)]
+        )
+        from pilosa_tpu.ops.bitplane import np_group_by
+
+        for s, (r_s, c_s, t_s) in np_group_by(
+            all_c // SLICE_WIDTH, all_r, all_c, tags
+        ):
+            frag = view.create_fragment_if_not_exists(s)
+            sm = t_s == 0
+            frag.import_bulk(
+                r_s[sm], c_s[sm],
+                clear_row_ids=r_s[~sm], clear_column_ids=c_s[~sm],
+            )
+
+    def set_value(self, field: str, column_id: int, value: int) -> None:
+        self.import_value(field, [column_id], [value])
 
     # --- views (reference: frame.go:336-395) ---
 
@@ -298,11 +391,16 @@ class Frame:
             frag.import_bulk(r_s, c_s)
 
     def schema_dict(self) -> dict:
-        return {
-            "name": self.name,
-            "rowLabel": self.row_label,
-            "cacheType": self.cache_type,
-            "cacheSize": self.cache_size,
-            "inverseEnabled": self.inverse_enabled,
-            "timeQuantum": self.time_quantum,
-        }
+        with self._mu:
+            return {
+                "name": self.name,
+                "rowLabel": self.row_label,
+                "cacheType": self.cache_type,
+                "cacheSize": self.cache_size,
+                "inverseEnabled": self.inverse_enabled,
+                "timeQuantum": self.time_quantum,
+                "rangeEnabled": self.range_enabled,
+                "fields": [
+                    self._fields[n].to_dict() for n in sorted(self._fields)
+                ],
+            }

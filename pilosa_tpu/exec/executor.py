@@ -39,7 +39,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pilosa_tpu import bsi
 from pilosa_tpu import device as device_mod
+from pilosa_tpu.bsi import ripple
 from pilosa_tpu.cluster.topology import Cluster, Node
 from pilosa_tpu.parallel import mesh as pmesh
 from pilosa_tpu.core import cache as cache_mod
@@ -317,6 +319,8 @@ class Executor:
             max_workers=16, stats=getattr(holder, "stats", None)
         )
         self._zero_rows: dict = {}  # device -> cached all-zero leaf row
+        # (value, bucket, device) -> packed BSI predicate row on device.
+        self._pred_rows: dict = {}
         # Assembled leaf-batch LRU (see _cached_batch); executors serve
         # concurrent HTTP request threads, so access is lock-guarded.
         self._batch_cache: "OrderedDict[tuple, dict]" = OrderedDict()
@@ -517,6 +521,12 @@ class Executor:
                 if call.name in WRITE_CALLS:
                     continue
                 for leaf in plan.collect_leaf_calls(call):
+                    if leaf.name == "Range" and leaf.conditions():
+                        # BSI Range: warm the field view's plane mirrors.
+                        frame = leaf.args.get("frame") or DEFAULT_FRAME
+                        for field_name in leaf.conditions():
+                            add_view(frame, bsi.field_view_name(field_name))
+                        continue
                     if leaf.name != "Bitmap":
                         continue
                     frame = leaf.args.get("frame") or DEFAULT_FRAME
@@ -526,6 +536,13 @@ class Executor:
                     )
                 if call.name == "TopN":
                     add_view(*self._topn_frame_view(call))
+                if call.name in ("Sum", "Min", "Max") and isinstance(
+                    call.args.get("field"), str
+                ):
+                    add_view(
+                        call.args.get("frame") or DEFAULT_FRAME,
+                        bsi.field_view_name(call.args["field"]),
+                    )
         except Exception:  # noqa: BLE001 — prefetch must never fail a query
             return
         if frags:
@@ -556,6 +573,8 @@ class Executor:
             return self._execute_count(index, c, slices, opt)
         if name == "TopN":
             return self._execute_topn(index, c, slices, opt)
+        if name in ("Sum", "Min", "Max"):
+            return self._execute_bsi_agg(index, c, slices, opt)
         return self._execute_bitmap_call(index, c, slices, opt)
 
     # ------------------------------------------------------------------
@@ -571,7 +590,37 @@ class Executor:
             return frag.device_row(row_id)
         if c.name == "Range":
             return self._range_row_device(index, c, slice_i)
+        if c.name == "BsiPlane":
+            frag = self._bsi_plane_fragment(index, c, slice_i)
+            if frag is None:
+                return None
+            return frag.device_row(c.args["row"])
+        if c.name == "BsiPred":
+            return self._pred_row_device(c, slice_i)
+        if c.name == "BsiZero":
+            return None
         raise plan.PlanError(f"unknown call: {c.name}")
+
+    def _bsi_plane_fragment(self, index: str, c: Call, slice_i: int):
+        return self.holder.fragment(
+            index, c.args["frame"], bsi.field_view_name(c.args["field"]), slice_i
+        )
+
+    def _pred_row_device(self, c: Call, slice_i: int):
+        """A packed predicate row on a slice's home device, cached per
+        (value, bucket, device) — predicates repeat across slices and
+        across queries, so the upload happens once."""
+        import jax
+
+        dev = bp.home_device(slice_i)
+        key = (c.args["v"], c.args["d"], dev)
+        row = self._pred_rows.get(key)
+        if row is None:
+            row = jax.device_put(bsi.pred_row(c.args["v"], c.args["d"]), dev)
+            if len(self._pred_rows) >= 256:
+                self._pred_rows.clear()
+            self._pred_rows[key] = row
+        return row
 
     def _resolve_bitmap_leaf(self, index: str, c: Call, slice_i: int):
         """Frame/row/orientation resolution for a Bitmap() leaf
@@ -648,6 +697,120 @@ class Executor:
             acc = row if acc is None else (acc | row)
         return acc
 
+    # ------------------------------------------------------------------
+    # BSI rewrite — Range(field > x) / Sum / Min / Max expansion
+    # ------------------------------------------------------------------
+
+    def _bsi_resolve_field(self, index: str, c: Call):
+        """(frame name, BSIField) for a BSI call — schema errors surface
+        here, before any leaf machinery runs."""
+        frame = c.args.get("frame") or DEFAULT_FRAME
+        f = self.holder.frame(index, frame)
+        if f is None:
+            raise FrameNotFoundError()
+        if not f.range_enabled:
+            raise ExecutorError(
+                f"frame {frame!r} does not support range queries"
+            )
+        return frame, f
+
+    def _bsi_field_leaves(self, frame: str, fld) -> tuple[list[Call], int]:
+        """The plane leaves of one field, padded to its depth bucket:
+        exists, sign, ``depth`` magnitude planes, then all-zero pads —
+        so every field in a bucket shares one compile shape (and one
+        coalescer compile key) per op kind."""
+        depth = fld.bit_depth
+        bucket = bsi.pad_depth(depth)
+        leaves = [
+            Call("BsiPlane", {"frame": frame, "field": fld.name, "row": r})
+            for r in (bsi.ROW_EXISTS, bsi.ROW_SIGN)
+        ]
+        leaves += [
+            Call(
+                "BsiPlane",
+                {"frame": frame, "field": fld.name, "row": bsi.ROW_BIT_BASE + k},
+            )
+            for k in range(depth)
+        ]
+        leaves += [Call("BsiZero") for _ in range(bucket - depth)]
+        return leaves, bucket
+
+    def _rewrite_bsi(self, index: str, c: Call) -> Call:
+        """Expand BSI Range calls (a comparison arg present) anywhere in
+        a call tree into synthetic ``BsiCmp`` nodes over plane/predicate
+        leaves; returns the ORIGINAL object when nothing changed, so
+        non-BSI queries keep their cache keys byte-identical.  Runs on
+        the node that executes the slices (map_fn side) — remote
+        forwarding ships the un-rewritten PQL text, and each node
+        re-expands against its own schema."""
+        if c.name == "Range" and c.conditions():
+            return self._rewrite_bsi_range(index, c)
+        new_children = [self._rewrite_bsi(index, ch) for ch in c.children]
+        if all(nc is oc for nc, oc in zip(new_children, c.children)):
+            return c
+        return Call(name=c.name, args=dict(c.args), children=new_children)
+
+    def _rewrite_bsi_range(self, index: str, c: Call) -> Call:
+        conds = c.conditions()
+        if len(conds) != 1:
+            raise ExecutorError(
+                "Range() supports exactly one field comparison"
+                " (use >< for between)"
+            )
+        (field_name, cond), = conds.items()
+        frame, f = self._bsi_resolve_field(index, c)
+        fld = f.bsi_field(field_name)
+        if fld is None:
+            raise ExecutorError(f"unknown field: {field_name!r}")
+        op = bsi.OPS.get(cond.op)
+        if op is None:
+            raise ExecutorError(f"unknown comparison: {cond.op!r}")
+        depth = fld.bit_depth
+        leaves, bucket = self._bsi_field_leaves(frame, fld)
+        if op == "between":
+            v = cond.value
+            if (
+                not isinstance(v, list)
+                or len(v) != 2
+                or any(isinstance(x, bool) or not isinstance(x, int) for x in v)
+            ):
+                raise ExecutorError("between (><) requires a two-int list")
+            lo, hi = bsi.clamp_between(v[0], v[1], depth)
+            leaves.append(Call("BsiPred", {"v": lo, "d": bucket}))
+            leaves.append(Call("BsiPred", {"v": hi, "d": bucket}))
+        else:
+            v = cond.value
+            if isinstance(v, bool) or not isinstance(v, int):
+                raise ExecutorError(
+                    f"Range() comparison value must be an integer, got {v!r}"
+                )
+            op, v = bsi.clamp_predicate(op, v, depth)
+            leaves.append(Call("BsiPred", {"v": v, "d": bucket}))
+        return Call("BsiCmp", {"op": op}, children=leaves)
+
+    def _rewrite_bsi_agg(self, index: str, c: Call) -> Call:
+        """Expand Sum/Min/Max(frame=, field=, [filter child]) into the
+        synthetic aggregate node the plan layer compiles (one fused
+        program per (kind, depth bucket, filter-present))."""
+        if len(c.children) > 1:
+            raise ExecutorError(f"{c.name}() can only have one input bitmap")
+        field_name = c.args.get("field")
+        if not isinstance(field_name, str):
+            raise ExecutorError(f"{c.name}() field required")
+        frame, f = self._bsi_resolve_field(index, c)
+        fld = f.bsi_field(field_name)
+        if fld is None:
+            raise ExecutorError(f"unknown field: {field_name!r}")
+        leaves, bucket = self._bsi_field_leaves(frame, fld)
+        has_filter = bool(c.children)
+        if has_filter:
+            leaves.append(self._rewrite_bsi(index, c.children[0]))
+        return Call(
+            "Bsi" + c.name,
+            {"filter": has_filter, "nplanes": bucket},
+            children=leaves,
+        )
+
     def _leaf_row_host(self, index: str, c: Call, slice_i: int):
         """Host-side (numpy) variant of _leaf_row_device: one leaf row's
         words, or None when the row has no bits."""
@@ -658,6 +821,15 @@ class Executor:
             return frag._row_words_host(row_id)
         if c.name == "Range":
             return self._range_row_host(index, c, slice_i)
+        if c.name == "BsiPlane":
+            frag = self._bsi_plane_fragment(index, c, slice_i)
+            if frag is None:
+                return None
+            return frag._row_words_host(c.args["row"])
+        if c.name == "BsiPred":
+            return bsi.pred_row(c.args["v"], c.args["d"])
+        if c.name == "BsiZero":
+            return None
         raise plan.PlanError(f"unknown call: {c.name}")
 
     def _range_row_host(self, index: str, c: Call, slice_i: int):
@@ -702,7 +874,8 @@ class Executor:
                 w = self._leaf_row_host(index, leaf, s)
                 if w is not None:
                     rows_buf[i, j] = w
-                    any_set = True
+                    if leaf.name not in plan.NEUTRAL_LEAVES:
+                        any_set = True
             if not leaves or not any_set:
                 # an empty slice writes nothing, so position i stays
                 # zero-initialized for the next kept slice
@@ -741,7 +914,7 @@ class Executor:
                 r = self._leaf_row_device(index, leaf, s)
                 if r is None:
                     r = self._zero_row(s)
-                else:
+                elif leaf.name not in plan.NEUTRAL_LEAVES:
                     any_set = True
                 rows.append(r)
             if not leaves or not any_set:
@@ -784,8 +957,9 @@ class Executor:
         quantum and every time-view fragment's version (the view set
         depends on the quantum; set_time_quantum bumps the write epoch
         so the O(1) fast path stays sound)."""
+        c = self._rewrite_bsi(index, c)
         expr, leaves = plan.decompose(c)
-        cacheable = all(leaf.name in ("Bitmap", "Range") for leaf in leaves)
+        cacheable = all(leaf.name in plan.LEAF_CALLS for leaf in leaves)
         key = (index, str(c), tuple(slices))
         if cacheable:
             with self._batch_mu:
@@ -905,6 +1079,7 @@ class Executor:
         empties: list[int] = []
         for s in slices:
             buf = None
+            any_set = False
             for j, leaf in enumerate(leaves):
                 w = self._leaf_row_host(index, leaf, s)
                 if w is not None:
@@ -913,7 +1088,9 @@ class Executor:
                             (n_leaves, bp.WORDS_PER_SLICE), dtype=np.uint32
                         )
                     buf[j] = w
-            if buf is None:
+                    if leaf.name not in plan.NEUTRAL_LEAVES:
+                        any_set = True
+            if not any_set:
                 empties.append(s)
             else:
                 kept.append(s)
@@ -1003,7 +1180,15 @@ class Executor:
                                 n_cold += 1
                     out.append(("range", quantum, tuple(vers)))
                     continue
-                frag, _ = self._resolve_bitmap_leaf(index, leaf, s)
+                if leaf.name in plan.NEUTRAL_LEAVES:
+                    # Slice-invariant data rows: identity is fully
+                    # captured by the canonical call string in the key.
+                    out.append(("const",))
+                    continue
+                if leaf.name == "BsiPlane":
+                    frag = self._bsi_plane_fragment(index, leaf, s)
+                else:
+                    frag, _ = self._resolve_bitmap_leaf(index, leaf, s)
                 if frag is None:
                     out.append(None)
                 else:
@@ -1155,6 +1340,7 @@ class Executor:
         """HOST (numpy) evaluation of a bitmap tree per slice — for
         consumers that need host words (TopN src).  Authoritative planes
         are host-resident, so this touches no device state."""
+        c = self._rewrite_bsi(index, c)
         expr, leaves = plan.decompose(c)
         out: dict[int, object] = {}
         for s in slices:
@@ -1328,6 +1514,107 @@ class Executor:
 
         n = self._map_reduce(index, slices, c, opt, map_fn, reduce_fn)
         return int(n or 0)
+
+    # ------------------------------------------------------------------
+    # BSI aggregates — Sum / Min / Max over integer fields
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _normalize_valcount(v):
+        """Map a local (ValCount | None) or remote-decoded ([Pair] | 0)
+        partial to ValCount | None.  A remote node with no valued
+        columns answers an empty result that decodes to 0 — legitimate
+        partials are ALWAYS Pair lists (even all-zero ones survive the
+        protobuf round trip), so bare ints mean "no data"."""
+        if isinstance(v, bsi.ValCount):
+            return v
+        if isinstance(v, list) and v:
+            p = v[0]
+            val = int(p.id) & 0xFFFFFFFFFFFFFFFF
+            if val >= 1 << 63:  # sign-extend the u64 wire wrap
+                val -= 1 << 64
+            return bsi.ValCount(value=val, count=int(p.count))
+        return None
+
+    def _execute_bsi_agg(self, index: str, c: Call, slices: list[int], opt):
+        """Sum/Min/Max(…, frame=f, field=q): per-slice int32 partial
+        vectors from ONE fused program over the field's planes (plus an
+        optional filter bitmap tree), weighted/combined in Python ints,
+        reduced across nodes through the ordinary map/reduce — exactly
+        like Count.  Cross-node partials ride the Pairs wire shape
+        (value u64-wrapped, count), so negatives survive protobuf."""
+        name = c.name
+
+        def map_fn(local_slices: list[int]):
+            return self._bsi_agg_slices(index, c, local_slices)
+
+        def reduce_fn(prev, v):
+            v = self._normalize_valcount(v)
+            if v is None:
+                return prev
+            prev = self._normalize_valcount(prev)
+            if prev is None:
+                return v
+            if name == "Sum":
+                return bsi.ValCount(prev.value + v.value, prev.count + v.count)
+            if v.value == prev.value:
+                return bsi.ValCount(prev.value, prev.count + v.count)
+            if name == "Min":
+                return v if v.value < prev.value else prev
+            return v if v.value > prev.value else prev
+
+        res = self._map_reduce(index, slices, c, opt, map_fn, reduce_fn)
+        res = self._normalize_valcount(res)
+        if res is None and name == "Sum":
+            res = bsi.ValCount(0, 0)
+        return res
+
+    def _bsi_agg_slices(self, index: str, c: Call, slices: list[int]):
+        """One node's aggregate partial over its local slices:
+        ValCount, or None when no slice holds a valued column."""
+        if not slices:
+            return None
+        rc = self._rewrite_bsi_agg(index, c)
+        bucket = int(rc.args["nplanes"])
+        ent = self._cached_batch(index, rc, slices)
+        if ent["batch"] is None:
+            return None
+
+        res = None
+        if self.coalescer is not None:
+            res = self._coalesce_eval(ent, "agg")
+        if res is None:
+            with device_mod.pool().pinned(
+                ent.get("pool_key")
+            ), self._device_span(ent, "agg"):
+                res = np.asarray(
+                    jax.device_get(
+                        plan.compiled_batched(ent["expr"], "agg")(ent["batch"])
+                    )
+                )
+        res = np.asarray(res)
+
+        if c.name == "Sum":
+            total = 0
+            count = 0
+            for p in ent["pos_of"].values():
+                part, n = ripple.decode_sum(res[p], bucket)
+                total += part
+                count += n
+            return bsi.ValCount(total, count) if count else None
+        best = None
+        for p in ent["pos_of"].values():
+            decoded = ripple.decode_minmax(res[p], bucket)
+            if decoded is None:
+                continue
+            val, n = decoded
+            if best is None:
+                best = (val, n)
+            elif val == best[0]:
+                best = (val, best[1] + n)
+            elif (c.name == "Min") == (val < best[0]):
+                best = (val, n)
+        return bsi.ValCount(*best) if best is not None else None
 
     # ------------------------------------------------------------------
     # TopN (reference: executor.go:281-415) — two-phase
@@ -1517,8 +1804,10 @@ class Executor:
             )
         if len(c.children) == 1:
             try:
-                _, leaves = plan.decompose(c.children[0])
-            except plan.PlanError:
+                _, leaves = plan.decompose(
+                    self._rewrite_bsi(index, c.children[0])
+                )
+            except (plan.PlanError, ExecutorError):
                 leaves = []
             out.append(tuple(self._leaf_versions(index, leaves, slices)))
         return tuple(out)

@@ -28,12 +28,24 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from pilosa_tpu.bsi import ripple
 from pilosa_tpu.pql.parser import Call
 
-# Calls that fetch rows (leaves of a bitmap expression).
-LEAF_CALLS = frozenset({"Bitmap", "Range"})
+# Calls that fetch rows (leaves of a bitmap expression).  The Bsi*
+# leaves are synthetic calls the executor's BSI rewrite produces:
+# BsiPlane fetches one field-view plane row, BsiPred is a packed
+# predicate row (slice-invariant data), BsiZero an all-zero pad plane
+# (depth bucketing).
+LEAF_CALLS = frozenset({"Bitmap", "Range", "BsiPlane", "BsiPred", "BsiZero"})
 # Interior set-algebra calls and their fold ops.
 FOLD_CALLS = frozenset({"Intersect", "Union", "Difference", "Xor"})
+# Synthetic BSI interior calls (executor._rewrite_bsi / _rewrite_bsi_agg):
+# BsiCmp produces a result row (composable inside bitmap trees); the
+# aggregates produce per-slice int32 partial vectors (reduce "agg").
+BSI_CALLS = frozenset({"BsiCmp", "BsiSum", "BsiMin", "BsiMax"})
+# Leaves that carry slice-invariant data rather than fragment content:
+# they never make a slice non-empty on their own.
+NEUTRAL_LEAVES = frozenset({"BsiPred", "BsiZero"})
 
 
 class PlanError(ValueError):
@@ -54,6 +66,16 @@ def decompose(call: Call) -> tuple[tuple, list[Call]]:
             idx = len(leaves)
             leaves.append(c)
             return ("leaf", idx)
+        if c.name in BSI_CALLS:
+            # Statics come from the synthetic call's args; depth is
+            # implied by the child arity, so fields sharing a depth
+            # bucket share one expr (and one compiled program) per op.
+            if c.name == "BsiCmp":
+                head = ("bsiCmp", c.args["op"])
+            else:
+                tag = {"BsiSum": "bsiSum", "BsiMin": "bsiMin", "BsiMax": "bsiMax"}
+                head = (tag[c.name], bool(c.args.get("filter")))
+            return head + tuple(rec(ch) for ch in c.children)
         if c.name not in FOLD_CALLS:
             raise PlanError(f"unknown call: {c.name}")
         if c.name in ("Intersect", "Difference") and not c.children:
@@ -84,10 +106,44 @@ def collect_leaf_calls(call: Call) -> list[Call]:
     return out
 
 
+def _popcount32(row):
+    return jnp.sum(jax.lax.population_count(row).astype(jnp.int32))
+
+
+def _split_bsi_rows(rows, tail: int):
+    """(exists, sign, planes, tail_rows) from a BSI node's evaluated
+    children — ``tail`` trailing rows are predicate/filter rows."""
+    body = rows[: len(rows) - tail] if tail else rows
+    return body[0], body[1], body[2:], rows[len(rows) - tail :]
+
+
 def _eval_expr(expr: tuple, leaves):
     if expr[0] == "leaf":
         return leaves[expr[1]]
     name = expr[0]
+    if name == "bsiCmp":
+        op = expr[1]
+        rows = [_eval_expr(e, leaves) for e in expr[2:]]
+        npred = 2 if op == "between" else 1
+        exists, sign, planes, preds = _split_bsi_rows(rows, npred)
+        if op == "between":
+            return ripple.between_row(
+                exists, sign, planes, preds[0], preds[1], jnp
+            )
+        return ripple.signed_cmp(op, exists, sign, planes, preds[0], jnp)
+    if name in ("bsiSum", "bsiMin", "bsiMax"):
+        has_filter = expr[1]
+        rows = [_eval_expr(e, leaves) for e in expr[2:]]
+        exists, sign, planes, tail = _split_bsi_rows(
+            rows, 1 if has_filter else 0
+        )
+        filt = tail[0] if has_filter else None
+        if name == "bsiSum":
+            return ripple.sum_vec(exists, sign, planes, filt, jnp, _popcount32)
+        return ripple.minmax_vec(
+            "min" if name == "bsiMin" else "max",
+            exists, sign, planes, filt, jnp, _popcount32, jnp.where,
+        )
     children = [_eval_expr(e, leaves) for e in expr[1:]]
     if name == "Union" and not children:
         return jnp.zeros(leaves.shape[1:], dtype=leaves.dtype)
@@ -121,6 +177,35 @@ def eval_expr_np(expr: tuple, leaf_rows, words: int):
             r = leaf_rows[e[1]]
             return None if r is None else np.asarray(r, dtype=np.uint32)
         name = e[0]
+        if name in ("bsiCmp", "bsiSum", "bsiMin", "bsiMax"):
+            rows = [rec(c) for c in e[2:]]
+            rows = [
+                np.zeros(words, dtype=np.uint32) if r is None else r
+                for r in rows
+            ]
+            pops = lambda r: int(np.bitwise_count(r).sum()) if hasattr(  # noqa: E731
+                np, "bitwise_count"
+            ) else int(np.unpackbits(r.view(np.uint8)).sum())
+            if name == "bsiCmp":
+                op = e[1]
+                npred = 2 if op == "between" else 1
+                exists, sign, planes, preds = _split_bsi_rows(rows, npred)
+                if op == "between":
+                    return ripple.between_row(
+                        exists, sign, planes, preds[0], preds[1], np
+                    )
+                return ripple.signed_cmp(op, exists, sign, planes, preds[0], np)
+            has_filter = e[1]
+            exists, sign, planes, tail = _split_bsi_rows(
+                rows, 1 if has_filter else 0
+            )
+            filt = tail[0] if has_filter else None
+            if name == "bsiSum":
+                return ripple.sum_vec(exists, sign, planes, filt, np, pops)
+            return ripple.minmax_vec(
+                "min" if name == "bsiMin" else "max",
+                exists, sign, planes, filt, np, pops, np.where,
+            )
         children = [rec(c) for c in e[1:]]
         zeros = lambda: np.zeros(words, dtype=np.uint32)  # noqa: E731
         if name == "Union":
@@ -154,7 +239,9 @@ def eval_expr_np(expr: tuple, leaf_rows, words: int):
 def _make_fn(expr: tuple, reduce: str):
     """``reduce``: ``"row"`` returns the uint32[32768] result row;
     ``"count"`` returns the int32 popcount of the result (never
-    materializing it)."""
+    materializing it); ``"agg"`` passes the expression's own int32
+    partial vector through unchanged (the BSI aggregate nodes reduce
+    inside the expression)."""
 
     def fn(leaf_stack):
         out = _eval_expr(expr, leaf_stack)
@@ -225,14 +312,39 @@ def recombine_count_limbs(limbs):
     return int(total) if total.ndim == 0 else total
 
 
+def expr_has_bsi(expr: tuple) -> bool:
+    """Whether a decomposed expr contains a BSI node.  BSI nodes index
+    WORDS of their predicate row and reduce internally, so they must
+    evaluate per slice (vmap) — the leaf-major broadcast trick the pure
+    bitwise total-count uses would hand them whole slice axes."""
+    if expr[0] == "leaf":
+        return False
+    if expr[0] in ("bsiCmp", "bsiSum", "bsiMin", "bsiMax"):
+        return True
+    return any(expr_has_bsi(e) for e in expr[1:])
+
+
 @functools.lru_cache(maxsize=512)
 def _compiled_total_count(expr: tuple, mesh):
+    per_slice = expr_has_bsi(expr)
+
     def fn(batch):
-        out = _eval_expr(expr, batch.swapaxes(0, 1))
-        # Word axis first: each partial <= 2^20 bits, int32-exact.
-        partials = jnp.sum(
-            jax.lax.population_count(out).astype(jnp.int32), axis=-1
-        )
+        if per_slice:
+            # Per-slice evaluation (vmapped): each partial covers one
+            # slice-row result (<= 2^20 bits), int32-exact.
+            partials = jax.vmap(
+                lambda stack: jnp.sum(
+                    jax.lax.population_count(
+                        _eval_expr(expr, stack)
+                    ).astype(jnp.int32)
+                )
+            )(batch)
+        else:
+            out = _eval_expr(expr, batch.swapaxes(0, 1))
+            # Word axis first: each partial <= 2^20 bits, int32-exact.
+            partials = jnp.sum(
+                jax.lax.population_count(out).astype(jnp.int32), axis=-1
+            )
         lo = jnp.sum(partials & 0xFFFF)
         hi = jnp.sum(partials >> 16)
         return jnp.stack([hi, lo])
@@ -247,3 +359,46 @@ def _compiled_total_count(expr: tuple, mesh):
 @functools.lru_cache(maxsize=512)
 def _compiled_batched(expr: tuple, reduce: str):
     return jax.jit(jax.vmap(_make_fn(expr, reduce)))
+
+
+# ---------------------------------------------------------------------------
+# compiled-program cardinality (observability for ROADMAP 2a's cap)
+# ---------------------------------------------------------------------------
+
+
+def _jit_cache_size(fn) -> int:
+    """Entry count of one jax.jit wrapper's compile cache (0 when the
+    running jax version doesn't expose it)."""
+    try:
+        return int(fn._cache_size())
+    except Exception:  # noqa: BLE001 — observability must never raise
+        return 0
+
+
+def program_cache_stats() -> dict[str, int]:
+    """Compiled-program cache entry counts per jit wrapper family —
+    the ``exec.programCache.entries`` gauge on /metrics.  ``plan.*``
+    counts distinct (tree shape, reduce)/(tree shape, mesh) wrapper
+    FUNCTIONS (each then compiles per batch-shape bucket);
+    ``bitplane.*`` counts compiled entries inside the module-level jit
+    wrappers (the TopN scorer keys on per-fragment plane shapes — the
+    cardinality ROADMAP 2a wants capped)."""
+    from pilosa_tpu.ops import bitplane as bp
+
+    out = {
+        "plan.batched": _compiled_batched.cache_info().currsize,
+        "plan.totalCount": _compiled_total_count.cache_info().currsize,
+        "bitplane.scorePlanes": (
+            _jit_cache_size(bp._score_planes_self_src)
+            + _jit_cache_size(bp._score_planes_host_src)
+        ),
+        "bitplane.fusedCount": _jit_cache_size(bp._fused_count_xla),
+        "bitplane.topCounts": _jit_cache_size(bp._top_counts_xla),
+    }
+    out["total"] = sum(out.values())
+    return out
+
+
+def program_cache_entries() -> int:
+    """Total compiled-program cache entries (the headline gauge)."""
+    return program_cache_stats()["total"]

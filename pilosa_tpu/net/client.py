@@ -385,6 +385,29 @@ class InternalClient:
         )
         return json.loads(self._check(status, data))["views"]
 
+    # --- BSI integer fields (pilosa_tpu extension, JSON endpoints) ---
+
+    def create_field(
+        self, index: str, frame: str, field: str, min: int, max: int
+    ) -> None:
+        body = json.dumps({"min": int(min), "max": int(max)}).encode()
+        status, data = self._request(
+            "POST", f"/index/{index}/frame/{frame}/field/{field}", body=body
+        )
+        self._check(status, data)
+
+    def delete_field(self, index: str, frame: str, field: str) -> None:
+        status, data = self._request(
+            "DELETE", f"/index/{index}/frame/{frame}/field/{field}"
+        )
+        self._check(status, data)
+
+    def frame_fields(self, index: str, frame: str) -> list[dict]:
+        status, data = self._request(
+            "GET", f"/index/{index}/frame/{frame}/fields"
+        )
+        return json.loads(self._check(status, data))["fields"]
+
     def fragment_nodes(self, index: str, slice_i: int) -> list[dict]:
         status, data = self._request(
             "GET", "/fragment/nodes", query={"index": index, "slice": slice_i}
@@ -456,6 +479,48 @@ class InternalClient:
                 resp.ParseFromString(client._check(status, data))
                 if resp.Err:
                     errs.append(f"{node['host']}: {resp.Err}")
+            except (
+                (ClientError, resilience.BreakerOpenError)
+                + resilience.TRANSPORT_ERRORS
+            ) as e:
+                errs.append(f"{node['host']}: {e}")
+        if errs:
+            raise ClientError(500, "; ".join(errs))
+
+    def import_value(
+        self,
+        index: str,
+        frame: str,
+        field: str,
+        slice_i: int,
+        columns,
+        values,
+    ) -> None:
+        """POST one slice's field values to every replica node —
+        the columnar BSI import leg (mirrors :meth:`import_bits`'s
+        per-host error collection so one dead replica never aborts the
+        fan-out)."""
+        payload = json.dumps(
+            {
+                "index": index,
+                "frame": frame,
+                "field": field,
+                "slice": int(slice_i),
+                "columnIDs": np.asarray(columns, dtype=np.int64).tolist(),
+                "values": np.asarray(values, dtype=np.int64).tolist(),
+            }
+        ).encode()
+        nodes = self.fragment_nodes(index, slice_i)
+        if not nodes:
+            raise ClientError(500, f"no nodes for slice {slice_i}")
+        errs = []
+        for node in nodes:
+            try:
+                client = self._peer(node["host"])
+                status, data = client._request(
+                    "POST", "/import-value", body=payload
+                )
+                client._check(status, data)
             except (
                 (ClientError, resilience.BreakerOpenError)
                 + resilience.TRANSPORT_ERRORS

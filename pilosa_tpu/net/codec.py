@@ -12,6 +12,7 @@ from typing import Any
 
 import numpy as np
 
+from pilosa_tpu.bsi import ValCount
 from pilosa_tpu.core.bitmap import RowBitmap
 from pilosa_tpu.core.cache import Pair
 from pilosa_tpu.net import wire_pb2 as wire
@@ -124,6 +125,14 @@ def result_to_proto(result: Any) -> wire.QueryResult:
     pb = wire.QueryResult()
     if isinstance(result, RowBitmap):
         pb.Bitmap.CopyFrom(bitmap_to_proto(result))
+    elif isinstance(result, ValCount):
+        # BSI aggregate (Sum/Min/Max): rides the Pairs message — value
+        # u64-wrapped in Key (negatives sign-extend on decode), count
+        # in Count.  The coordinator's reduce interprets it; external
+        # protobuf clients see one Pair.
+        pb.Pairs.append(
+            wire.Pair(Key=_u64(result.value), Count=_u64(result.count))
+        )
     elif isinstance(result, bool):
         pb.Changed = result
     elif isinstance(result, (int, np.integer)):
@@ -154,6 +163,8 @@ def result_from_proto(pb: wire.QueryResult) -> Any:
 def result_to_json(result: Any) -> Any:
     if isinstance(result, RowBitmap):
         return bitmap_to_json(result)
+    if isinstance(result, ValCount):
+        return {"value": int(result.value), "count": int(result.count)}
     if isinstance(result, list):
         return [{"id": _u64(p.id), "count": _u64(p.count)} for p in result]
     if isinstance(result, (int, np.integer)) and not isinstance(result, bool):

@@ -206,7 +206,11 @@ class Handler:
             ("GET", r"/index/(?P<index>[^/]+)/frame/(?P<frame>[^/]+)/views", self.handle_get_frame_views),
             ("POST", r"/index/(?P<index>[^/]+)/frame/(?P<frame>[^/]+)/attr/diff", self.handle_post_frame_attr_diff),
             ("POST", r"/index/(?P<index>[^/]+)/frame/(?P<frame>[^/]+)/restore", self.handle_post_frame_restore),
+            ("GET", r"/index/(?P<index>[^/]+)/frame/(?P<frame>[^/]+)/fields", self.handle_get_frame_fields),
+            ("POST", r"/index/(?P<index>[^/]+)/frame/(?P<frame>[^/]+)/field/(?P<fld>[^/]+)", self.handle_post_frame_field),
+            ("DELETE", r"/index/(?P<index>[^/]+)/frame/(?P<frame>[^/]+)/field/(?P<fld>[^/]+)", self.handle_delete_frame_field),
             ("POST", r"/import", self.handle_post_import),
+            ("POST", r"/import-value", self.handle_post_import_value),
             ("GET", r"/export", self.handle_get_export),
             ("GET", r"/fragment/nodes", self.handle_get_fragment_nodes),
             ("GET", r"/fragment/data", self.handle_get_fragment_data),
@@ -454,6 +458,7 @@ class Handler:
             ("cacheType", "cache_type"),
             ("cacheSize", "cache_size"),
             ("timeQuantum", "time_quantum"),
+            ("rangeEnabled", "range_enabled"),
         ):
             if json_key in options:
                 kwargs[py_key] = options[json_key]
@@ -543,6 +548,139 @@ class Handler:
                     continue
                 with src:
                     frag.read_from(src)
+        return Response.json({})
+
+    # ------------------------------------------------------------------
+    # BSI integer fields (pilosa_tpu/bsi)
+    # ------------------------------------------------------------------
+    #
+    # Field schema rides JSON endpoints (a pilosa_tpu extension): the
+    # protobuf FrameMeta broadcast reproduces the reference wire
+    # contract exactly, which predates BSI — so field create/delete fan
+    # out as plain HTTP to every peer instead (``?remote=true`` marks
+    # the relayed leg).  Field metadata persists in each node's frame
+    # .meta and is served by /schema, so restarts recover it locally.
+
+    def handle_get_frame_fields(self, req: Request, index: str, frame: str) -> Response:
+        f = self.holder.frame(index, frame)
+        if f is None:
+            return Response.error("frame not found", 404)
+        return Response.json(
+            {"fields": [fld.to_dict() for fld in f.bsi_fields()]}
+        )
+
+    def handle_post_frame_field(
+        self, req: Request, index: str, frame: str, fld: str
+    ) -> Response:
+        from pilosa_tpu import bsi
+
+        f = self.holder.frame(index, frame)
+        if f is None:
+            return Response.error("frame not found", 404)
+        try:
+            payload = json.loads(req.body) if req.body else {}
+        except json.JSONDecodeError as e:
+            return Response.error(str(e), 400)
+        try:
+            lo = int(payload.get("min", 0))
+            hi = int(payload.get("max", 0))
+        except (TypeError, ValueError):
+            return Response.error("min/max must be integers", 400)
+        remote = req.query.get("remote") == "true"
+        if remote and not f.range_enabled:
+            # The relayed leg implies range support: the coordinator
+            # validated the operator-facing schema rules.
+            f.set_options(range_enabled=True)
+        try:
+            f.create_field(fld, lo, hi)
+        except bsi.BSIError as e:
+            return Response.error(str(e), 400)
+        except Exception as e:  # noqa: BLE001 — duplicate / not range-enabled
+            return Response.error(str(e), 409)
+        if not remote:
+            self._fanout_field(
+                "POST",
+                f"/index/{index}/frame/{frame}/field/{fld}",
+                json.dumps({"min": lo, "max": hi}).encode(),
+            )
+        return Response.json({})
+
+    def handle_delete_frame_field(
+        self, req: Request, index: str, frame: str, fld: str
+    ) -> Response:
+        f = self.holder.frame(index, frame)
+        if f is None:
+            return Response.error("frame not found", 404)
+        try:
+            f.delete_field(fld)
+        except Exception as e:  # noqa: BLE001 — unknown field
+            return Response.error(str(e), 404)
+        if req.query.get("remote") != "true":
+            self._fanout_field(
+                "DELETE", f"/index/{index}/frame/{frame}/field/{fld}", b""
+            )
+        return Response.json({})
+
+    def _fanout_field(self, method: str, path: str, body: bytes) -> None:
+        """Relay a field schema change to every other node.  Collected
+        errors surface as one exception AFTER every reachable peer got
+        the change — a dead peer re-converges via its own retry, not by
+        aborting the survivors."""
+        if self.cluster is None or self.client_factory is None:
+            return
+        me = getattr(self.executor, "host", None)
+        errs = []
+        for node in self.cluster.nodes:
+            if node.host == me:
+                continue
+            try:
+                client = self.client_factory(node.host)
+                status, data = client._request(
+                    method, path, query={"remote": "true"}, body=body
+                )
+                client._check(status, data)
+            except Exception as e:  # noqa: BLE001 — collect per-host
+                errs.append(f"{node.host}: {e}")
+        if errs:
+            raise RuntimeError("field fanout: " + "; ".join(errs))
+
+    def handle_post_import_value(self, req: Request) -> Response:
+        """Columnar integer import (JSON, a pilosa_tpu extension):
+        ``{"index","frame","field","slice","columnIDs":[],"values":[]}``
+        — one value per column, written as vectorized plane set+clear
+        passes through Frame.import_value.  Ownership-guarded like
+        /import; the client fans a slice's payload to every replica."""
+        try:
+            payload = json.loads(req.body)
+        except json.JSONDecodeError as e:
+            return Response.error(str(e), 400)
+        index = payload.get("index", "")
+        frame = payload.get("frame", "")
+        field_name = payload.get("field", "")
+        slice_i = payload.get("slice", 0)
+        cols = payload.get("columnIDs", [])
+        vals = payload.get("values", [])
+        if not isinstance(cols, list) or not isinstance(vals, list) or len(
+            cols
+        ) != len(vals):
+            return Response.error("columnIDs/values must be equal-length lists", 400)
+        if self.cluster is not None and self.executor is not None:
+            owners = {
+                n.host for n in self.cluster.fragment_nodes(index, slice_i)
+            }
+            if self.executor.host not in owners:
+                return Response.error(
+                    f"host does not own slice {self.executor.host}"
+                    f" slice={slice_i}",
+                    412,
+                )
+        f = self.holder.frame(index, frame)
+        if f is None:
+            return Response.error("frame not found", 404)
+        try:
+            f.import_value(field_name, cols, vals)
+        except Exception as e:  # noqa: BLE001 — unknown field / out of range
+            return Response.error(str(e), 400)
         return Response.json({})
 
     # ------------------------------------------------------------------
@@ -974,6 +1112,7 @@ class Handler:
                 snap = self.stats.snapshot()
             except Exception:  # noqa: BLE001 — stats must not fail the scrape
                 snap = {}
+        self._inject_program_cache_gauges(snap)
         body = prom.render(
             snap,
             extra_gauges={
@@ -982,6 +1121,27 @@ class Handler:
             },
         )
         return Response(body=body.encode(), content_type=prom.CONTENT_TYPE)
+
+    @staticmethod
+    def _inject_program_cache_gauges(snap: dict) -> None:
+        """Scrape-time ``exec.programCache.entries`` gauge — total plus
+        one ``cache:<family>`` label per jit wrapper family (exec/plan.py
+        program_cache_stats): the observability prerequisite for capping
+        compiled-program cardinality (ROADMAP 2a).  Injected into the
+        snapshot (not the stats store), so it renders on every scrape
+        even when the node runs without a stats backend.  Same-depth-
+        bucket BSI queries sharing one program per op kind is asserted
+        against exactly this gauge."""
+        try:
+            from pilosa_tpu.exec import plan as plan_mod
+
+            stats = plan_mod.program_cache_stats()
+            gauges = snap.setdefault("gauges", {})
+            gauges["exec.programCache.entries"] = stats.pop("total")
+            for family, n in stats.items():
+                gauges[f"exec.programCache.entries[cache:{family}]"] = n
+        except Exception:  # noqa: BLE001 — stats must not fail the scrape
+            pass
 
     def handle_get_pprof(self, req: Request, rest: str | None = None) -> Response:
         """Profiling endpoints — the Python analog of the reference's

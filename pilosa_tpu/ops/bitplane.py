@@ -107,6 +107,15 @@ def np_set_bulk(plane: np.ndarray, rows: np.ndarray, offsets: np.ndarray) -> Non
     np.bitwise_or.at(plane, (rows, words), masks)
 
 
+def np_clear_bulk(plane: np.ndarray, rows: np.ndarray, offsets: np.ndarray) -> None:
+    """Bulk clear: vectorized scatter-ANDNOT — the overwrite half of a
+    columnar BSI value import (a re-imported column must drop the stale
+    bits of its previous value)."""
+    words = offsets // WORD_BITS
+    masks = (np.uint32(1) << (offsets % WORD_BITS).astype(np.uint32)).astype(np.uint32)
+    np.bitwise_and.at(plane, (rows, words), ~masks)
+
+
 def np_row_to_columns(row_words: np.ndarray) -> np.ndarray:
     """Expand one slice-row's set bits into sorted uint64 column offsets
     within the slice (0 .. SLICE_WIDTH)."""

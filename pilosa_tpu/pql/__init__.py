@@ -10,10 +10,11 @@ reference so remote call forwarding and test fixtures interoperate.
 
 from pilosa_tpu.pql.parser import (
     Call,
+    Cond,
     ParseError,
     Query,
     TIME_FORMAT,
     parse_string,
 )
 
-__all__ = ["Call", "ParseError", "Query", "TIME_FORMAT", "parse_string"]
+__all__ = ["Call", "Cond", "ParseError", "Query", "TIME_FORMAT", "parse_string"]

@@ -41,12 +41,20 @@ class ParseError(ValueError):
 IDENT, STRING, INTEGER, FLOAT, LPAREN, RPAREN, LBRACK, RBRACK, COMMA, EQ, EOF = (
     "IDENT", "STRING", "INTEGER", "FLOAT", "(", ")", "[", "]", ",", "=", "EOF",
 )
+# Comparison token (BSI range predicates): lit holds the operator text.
+CMP = "CMP"
+
+# Comparison operators accepted between an argument key and its value
+# (``Range(field > 100)``); ``><`` is the inclusive between operator.
+# Longest-first so ``>=`` never lexes as ``>`` ``=``.
+COMPARISON_OPS = ("><", ">=", "<=", "==", "!=", ">", "<")
 
 _TOKEN_RE = re.compile(
     r"""
     (?P<ws>\s+)
   | (?P<ident>[A-Za-z][A-Za-z0-9_.\-]*)
   | (?P<number>-?(?:\d+(?:\.\d*)?|\.\d+))
+  | (?P<cmp>><|>=|<=|==|!=|>|<)
   | (?P<punct>[()\[\],=])
   | (?P<quote>["'])
     """,
@@ -93,6 +101,8 @@ def _tokenize(s: str) -> list[_Token]:
             lit = m.group()
             kind = FLOAT if "." in lit else INTEGER
             tokens.append(_Token(kind, lit, start_line, start_char))
+        elif m.lastgroup == "cmp":
+            tokens.append(_Token(CMP, m.group(), start_line, start_char))
         elif m.lastgroup == "punct":
             tokens.append(_Token(m.group(), m.group(), start_line, start_char))
         else:  # quoted string
@@ -165,6 +175,21 @@ def _go_value(v: Any) -> str:
     return str(v)
 
 
+@dataclass(frozen=True)
+class Cond:
+    """A comparison-argument value: ``Range(field > 100)`` parses the
+    ``field`` arg to ``Cond(op=">", value=100)``; ``field >< [a, b]``
+    (inclusive between) carries a two-int list.  Canonical ``str()``
+    renders ``key op value`` so BSI queries survive the remote-
+    forwarding round trip (str -> parse) byte-identically."""
+
+    op: str
+    value: Any
+
+    def render(self, key: str) -> str:
+        return f"{key} {self.op} {_go_value(self.value)}"
+
+
 @dataclass
 class Call:
     """One function call node (reference: pql/ast.go:52-57)."""
@@ -223,10 +248,15 @@ class Call:
             return False
         return row is None and col is not None
 
+    def conditions(self) -> dict[str, "Cond"]:
+        """The comparison-valued args (BSI range predicates)."""
+        return {k: v for k, v in self.args.items() if isinstance(v, Cond)}
+
     def __str__(self) -> str:
         parts = [str(c) for c in self.children]
         parts += [
-            f"{k}={_go_value(self.args[k])}" for k in sorted(self.args.keys())
+            v.render(k) if isinstance(v, Cond) else f"{k}={_go_value(v)}"
+            for k, v in sorted(self.args.items(), key=lambda kv: kv[0])
         ]
         return f"{self.name or '!UNNAMED'}({', '.join(parts)})"
 
@@ -305,11 +335,14 @@ class _Parser:
                 )
             key = t.lit
             eq = self.next()
-            if eq.kind != EQ:
+            if eq.kind == CMP:
+                value = Cond(op=eq.lit, value=self.parse_value())
+            elif eq.kind == EQ:
+                value = self.parse_value()
+            else:
                 raise ParseError(
                     f"expected equals sign, found {eq.lit!r}", eq.line, eq.char
                 )
-            value = self.parse_value()
             if key in call.args:
                 raise ParseError(f"argument key already used: {key}", t.line, t.char)
             call.args[key] = value

@@ -64,3 +64,84 @@ def test_blocks_and_diff(tmp_path):
     assert a.block_data(5) == {5 * ATTR_BLOCK_SIZE: {"z": 1}}
     a.close()
     b.close()
+
+
+# ---------------------------------------------------------------------------
+# incremental block checksums (anti-entropy cost, ROADMAP 5a)
+# ---------------------------------------------------------------------------
+
+
+def test_incremental_digests_match_cold_rescan(tmp_path):
+    """Write-maintained digests must equal the digests a fresh open's
+    full scan computes — including deletes that empty a row/block and
+    ids straddling the uint63 sign boundary."""
+    s = AttrStore(str(tmp_path / "a"))
+    s.open()
+    s.set_bulk_attrs({i: {"v": i % 9, "s": str(i)} for i in range(0, 500, 3)})
+    s.set_attrs(7, {"v": None, "s": None})  # row 7 -> {}
+    for i in range(120, 180, 3):
+        s.set_attrs(i, {"v": None, "s": None})  # empty most of block 1
+    s.set_attrs((1 << 63) - 1, {"edge": 1})
+    s.set_attrs((1 << 63) + 2, {"edge": 2})
+    s.set_attrs(2**64 - 1, {"edge": 3})
+    warm = s.blocks()
+    s.close()
+    s.open()  # non-empty table -> lazy full rescan on first blocks()
+    assert s.blocks() == warm
+    # and the rescanned store keeps maintaining incrementally
+    s.set_attrs(11, {"new": True})
+    warm2 = s.blocks()
+    s.close()
+    s.open()
+    assert s.blocks() == warm2
+    s.close()
+
+
+def test_blocks_fast_after_bulk_population(tmp_path):
+    """The anti-entropy tick cost: blocks() over a store populated
+    through writes is O(#blocks), not a full-table SELECT+JSON pass."""
+    import time
+
+    s = AttrStore(str(tmp_path / "a"))
+    s.open()
+    n = 100_000
+    for lo in range(0, n, 20_000):
+        s.set_bulk_attrs({i: {"v": i} for i in range(lo, lo + 20_000)})
+    t0 = time.perf_counter()
+    blocks = s.blocks()
+    dt_ms = (time.perf_counter() - t0) * 1e3
+    assert len(blocks) == n // ATTR_BLOCK_SIZE
+    assert dt_ms < 100, f"blocks() took {dt_ms:.1f} ms"
+    s.close()
+
+
+@pytest.mark.slow
+def test_blocks_under_100ms_at_1m_attrs(tmp_path):
+    """The ROADMAP 5a acceptance number, at full scale."""
+    import time
+
+    s = AttrStore(str(tmp_path / "a"))
+    s.open()
+    n = 1_000_000
+    for lo in range(0, n, 50_000):
+        s.set_bulk_attrs({i: {"v": i} for i in range(lo, lo + 50_000)})
+    t0 = time.perf_counter()
+    blocks = s.blocks()
+    dt_ms = (time.perf_counter() - t0) * 1e3
+    assert len(blocks) == n // ATTR_BLOCK_SIZE
+    assert dt_ms < 100, f"blocks() took {dt_ms:.1f} ms"
+    s.close()
+
+
+def test_block_data_streams_by_cursor(tmp_path):
+    s = AttrStore(str(tmp_path / "a"))
+    s.open()
+    s.set_bulk_attrs(
+        {i: {"v": i} for i in range(ATTR_BLOCK_SIZE, 2 * ATTR_BLOCK_SIZE)}
+    )
+    s.set_attrs(ATTR_BLOCK_SIZE + 1, {"v": None})  # emptied row excluded
+    data = s.block_data(1)
+    assert len(data) == ATTR_BLOCK_SIZE - 1
+    assert ATTR_BLOCK_SIZE + 1 not in data
+    assert data[ATTR_BLOCK_SIZE] == {"v": ATTR_BLOCK_SIZE}
+    s.close()

@@ -134,3 +134,76 @@ def test_parse_errors(bad):
 def test_ident_chars():
     c = parse1("Range(frame=my-frame.v2_x, start=1)")
     assert c.args["frame"] == "my-frame.v2_x"
+
+
+# ---------------------------------------------------------------------------
+# BSI comparison arguments (Range(field > 100), Sum/Min/Max)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("op", ["<", "<=", "==", "!=", ">=", ">"])
+def test_comparison_ops(op):
+    c = parse1(f"Range(frame=f, v {op} 100)")
+    assert c.name == "Range"
+    conds = c.conditions()
+    assert set(conds) == {"v"}
+    assert conds["v"].op == op
+    assert conds["v"].value == 100
+    assert c.args["frame"] == "f"
+
+
+@pytest.mark.parametrize("value", [-1, -1000, -(2**40)])
+def test_comparison_negative_values(value):
+    c = parse1(f"Range(frame=f, v >= {value})")
+    assert c.conditions()["v"].value == value
+
+
+def test_between_two_int_list():
+    c = parse1("Range(frame=f, v >< [-10, 42])")
+    cond = c.conditions()["v"]
+    assert cond.op == "><"
+    assert cond.value == [-10, 42]
+
+
+def test_comparison_longest_first_lexing():
+    # ">=" must not lex as ">" "="; "><" must not lex as ">" "<".
+    assert parse1("F(a >= 1)").conditions()["a"].op == ">="
+    assert parse1("F(a >< [1, 2])").conditions()["a"].op == "><"
+
+
+@pytest.mark.parametrize(
+    "q",
+    [
+        "Range(frame=f, v > 100)",
+        "Range(frame=f, v <= -5)",
+        "Range(frame=f, v != 0)",
+        "Range(frame=f, v >< [-10, 42])",
+        'Count(Intersect(Range(frame=f, v > 0), Bitmap(frame="f", rowID=1)))',
+        'Sum(Range(frame=f, v < 0), field="v", frame="f")',
+    ],
+)
+def test_comparison_roundtrip(q):
+    """Canonical str() of a comparison call re-parses to an equal tree —
+    the property remote query forwarding depends on."""
+    c1 = parse1(q)
+    c2 = parse1(str(c1))
+    assert str(c1) == str(c2)
+    assert c2.conditions() == c1.conditions() or not c1.conditions()
+
+
+def test_comparison_mixed_with_eq_args():
+    c = parse1("Range(frame=f, v > 3)")
+    # ordinary args and comparison args coexist; only Cond values are
+    # conditions
+    assert c.args["frame"] == "f"
+    assert list(c.conditions()) == ["v"]
+
+
+def test_comparison_duplicate_key_rejected():
+    with pytest.raises(pql.ParseError):
+        pql.parse_string("Range(frame=f, v > 1, v < 5)")
+
+
+def test_comparison_missing_value_rejected():
+    with pytest.raises(pql.ParseError):
+        pql.parse_string("Range(frame=f, v >)")

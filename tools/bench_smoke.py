@@ -1,12 +1,13 @@
 """CI smoke pass over bench.py: a tiny CPU-only run that asserts the
-JSON artifact parses and carries the coalescer's counters.
+JSON artifact parses and carries the coalescer's counters plus the
+``bsi`` tier (Range/Sum over integer bit-planes).
 
 Not a performance measurement — a wiring check: the bench's executor
 tiers must produce one valid JSON line on stdout with the coalesce
 section (launches / occupancy / dispatches-per-query per concurrent
-tier), so a refactor cannot silently break the artifact the perf
-trajectory is built from.  Run via ``make bench-smoke``; wired into CI
-as a non-blocking step.
+tier) and the bsi tier's Gcols/s + ms/query figures, so a refactor
+cannot silently break the artifact the perf trajectory is built from.
+Run via ``make bench-smoke``; wired into CI as a non-blocking step.
 """
 
 from __future__ import annotations
@@ -69,11 +70,29 @@ def main() -> int:
     if total["launches"] < 1 or total["queries"] < total["launches"]:
         print(f"FAIL: implausible coalesce counters: {total}", file=sys.stderr)
         return 1
+    bsi = out.get("bsi")
+    if not isinstance(bsi, dict):
+        print(f"FAIL: artifact missing bsi tier: {out}", file=sys.stderr)
+        return 1
+    for section in ("range", "sum"):
+        sec = bsi.get(section)
+        if not isinstance(sec, dict):
+            print(f"FAIL: bsi tier missing {section!r}: {bsi}", file=sys.stderr)
+            return 1
+        for key in ("gcols_s", "ms_per_query"):
+            if not isinstance(sec.get(key), (int, float)) or sec[key] <= 0:
+                print(
+                    f"FAIL: bsi {section} missing/implausible {key!r}: {sec}",
+                    file=sys.stderr,
+                )
+                return 1
     print(
         f"OK: metric={out['metric']} value={out['value']} {out['unit']};"
         f" coalesce launches={total['launches']}"
         f" queries={total['queries']}"
-        f" mean_occupancy={total['mean_occupancy']}"
+        f" mean_occupancy={total['mean_occupancy']};"
+        f" bsi range {bsi['range']['gcols_s']} Gcols/s"
+        f" / sum {bsi['sum']['gcols_s']} Gcols/s"
     )
     return 0
 
