@@ -39,8 +39,9 @@ bench:
 	$(PYTHON) bench.py
 
 # Tiny CPU-only bench pass (seconds, few slices): asserts the JSON
-# artifact parses and the coalesce counters are present.  Non-blocking
-# in CI (.github/workflows/check.yml).
+# artifact parses with the coalesce counters, the cold_restart tier,
+# and the program-cache bounds invariant.  BLOCKING in CI
+# (.github/workflows/check.yml).
 bench-smoke:
 	$(PYTHON) tools/bench_smoke.py
 

@@ -258,6 +258,7 @@ def main() -> None:
     # cache (true cold compile), second measures a process restart
     # loading it.  Run BEFORE this process touches the backend so the
     # TPU tunnel only ever has one client.
+    restart_probe: dict = {}
     if os.environ.get("BENCH_SKIP_RESTART_PROBE") != "1":
         import subprocess
 
@@ -266,7 +267,6 @@ def main() -> None:
             "tools",
             "compile_probe_restart.py",
         )
-        times = []
         for label in ("cold" if not had_cache else "warm-disk", "restart"):
             try:
                 out = subprocess.run(
@@ -279,9 +279,12 @@ def main() -> None:
                     log(f"restart probe failed ({label}): rc={out.returncode} "
                         f"stderr={out.stderr.strip()[-300:]!r}")
                     break
-                times.append(float(out.stdout.strip().splitlines()[-1]))
+                t = float(out.stdout.strip().splitlines()[-1])
+                restart_probe[label.replace("-", "_") + "_compile_s"] = round(
+                    t, 3
+                )
                 log(f"headline-program compile, fresh process ({label}): "
-                    f"{times[-1]*1e3:.0f} ms")
+                    f"{t*1e3:.0f} ms")
             except Exception as e:
                 log(f"restart probe failed ({label}): {e}")
                 break
@@ -479,8 +482,9 @@ def main() -> None:
     # runs the full dispatch: parse -> leaf resolution -> batch assembly
     # (cached across queries) -> fused program -> reduce.
     coalesce_stats = None
+    topn_breakdown = None
     try:
-        e2e_s, coalesce_stats = with_retries(
+        e2e_s, coalesce_stats, topn_breakdown = with_retries(
             "e2e executor tier",
             lambda: run_executor_tiers(
                 leaves, host_count, rng, dev_s, cpu_fallback
@@ -517,6 +521,19 @@ def main() -> None:
             )
         except Exception as e:  # noqa: BLE001 — the artifact must survive
             log(f"bsi tier FAILED ({e!r:.300})")
+
+    # --- tier 7: cold restart (time-to-first-answer while staging) -----
+    cold_restart = None
+    if os.environ.get("BENCH_SKIP_COLD_TIER") != "1":
+        try:
+            cold_restart = with_retries(
+                "cold-restart tier",
+                lambda: run_cold_restart_tier(rng, cpu_fallback),
+                attempts=2,
+            )
+            cold_restart.update(restart_probe)
+        except Exception as e:  # noqa: BLE001 — the artifact must survive
+            log(f"cold-restart tier FAILED ({e!r:.300})")
 
     if cpu_fallback:
         metric += "_cpu_fallback"
@@ -573,10 +590,18 @@ def main() -> None:
             )
     if coalesce_stats is not None:
         out["coalesce"] = coalesce_stats
+    if topn_breakdown:
+        out["topn_src_breakdown_p50_ms"] = topn_breakdown
     if hbm_pressure is not None:
         out["hbm_pressure"] = hbm_pressure
     if bsi_tier is not None:
         out["bsi"] = bsi_tier
+    if cold_restart is not None:
+        out["cold_restart"] = cold_restart
+    out["program_cache"] = {
+        "entries": plan.program_cache_stats(),
+        "bounds": plan.program_cache_bounds(),
+    }
     print(json.dumps(out))
 
 
@@ -850,6 +875,86 @@ def run_bsi_tier(rng, n_slices, cpu_fb=False) -> dict:
         return out
 
 
+def run_cold_restart_tier(rng, cpu_fb=False) -> dict:
+    """``cold_restart`` tier: the rolling-restart fast path.  Builds a
+    node's data dir, warms its mirrors (the pre-restart incarnation,
+    residency table persisted at close), then "restarts" — fresh
+    residency pool, holder reopened from disk — and measures
+    time-to-first-answer while the lazy background staging lane
+    (device/prefetch.py, ordered by the persisted residency table)
+    streams the mirrors up, plus staging-complete time and programs
+    compiled in the window.  Tracks the 4.79 s eager-staging cold e2e
+    this path replaces (VERDICT item 4); the fresh-process compile
+    numbers ride in from tools/compile_probe_restart.py."""
+    from pilosa_tpu import device as device_mod
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.exec import plan
+    from pilosa_tpu.exec.executor import Executor
+    from pilosa_tpu.ops import bitplane as bpl
+    from pilosa_tpu.pql.parser import parse_string
+
+    n_slices = 8 if cpu_fb else 64
+    bits_per_row = 256
+    with tempfile.TemporaryDirectory() as d:
+        holder = Holder(d)
+        holder.open()
+        idx = holder.create_index("i")
+        f = idx.create_frame("f")
+        view = f.create_view_if_not_exists("standard")
+        for s in range(n_slices):
+            frag = view.create_fragment_if_not_exists(s)
+            base = s * bpl.SLICE_WIDTH
+            for r in (1, 2):
+                for c in rng.integers(0, bpl.SLICE_WIDTH, size=bits_per_row):
+                    frag.set_bit(r, base + int(c))
+            frag.flush_ops()
+        holder.warm_device_mirrors()
+        holder.close()  # persists the residency table
+
+        # "Restart": device state gone (fresh pool), data re-opened
+        # from disk, serving starts immediately, staging drains behind.
+        prev_pool = device_mod._set_pool(device_mod.PlanePool())
+        try:
+            progs_before = plan.program_cache_entries()
+            t0 = time.perf_counter()
+            h2 = Holder(d)
+            h2.open()
+            pf = device_mod.Prefetcher()
+            job = h2.stage_device_mirrors(pf)
+            ex = Executor(h2, prefetcher=pf)
+            pq = parse_string(
+                "Count(Intersect(Bitmap(rowID=1, frame=f),"
+                " Bitmap(rowID=2, frame=f)))"
+            )
+            (got,) = ex.execute("i", pq)
+            t_first = time.perf_counter() - t0
+            in_flight = not job.done()
+            job.wait()
+            t_staged = time.perf_counter() - t0
+            progs = plan.program_cache_entries() - progs_before
+            tier = {
+                "slices": n_slices,
+                "first_answer_ms": round(t_first * 1e3, 2),
+                "staging_in_flight_at_first_answer": in_flight,
+                "staging_complete_ms": round(t_staged * 1e3, 2),
+                "staging": job.snapshot(),
+                "programs_compiled": progs,
+                "count": int(got),
+            }
+            log(
+                f"cold restart ({n_slices} slices): first answer"
+                f" {tier['first_answer_ms']:.0f} ms (staging in flight:"
+                f" {in_flight}); staging complete"
+                f" {tier['staging_complete_ms']:.0f} ms;"
+                f" {progs} programs compiled in the window"
+            )
+            ex.close()
+            h2.close()
+        finally:
+            device_mod._set_pool(prev_pool)
+        return tier
+
+
 def run_executor_tiers(leaves, host_count, rng, dev_s, cpu_fb=False):
     """Executor tiers; returns ``(e2e_s, coalesce_stats)`` — the e2e
     per-query seconds under concurrent load (the throughput the
@@ -898,9 +1003,12 @@ def run_executor_tiers(leaves, host_count, rng, dev_s, cpu_fb=False):
         )
         return snap
 
+    from pilosa_tpu.obs.trace import Tracer
+
+    tr = Tracer(capacity=64)
     with tempfile.TemporaryDirectory() as d:
         holder = build_holder(leaves, d)
-        ex = Executor(holder, host="localhost:0", coalescer=co)
+        ex = Executor(holder, host="localhost:0", coalescer=co, tracer=tr)
         pq = parse_string("Count(Intersect(Bitmap(rowID=1, frame=f), Bitmap(rowID=2, frame=f)))")
         t0 = time.perf_counter()
         (got,) = ex.execute("i", pq)
@@ -1055,6 +1163,35 @@ def run_executor_tiers(leaves, host_count, rng, dev_s, cpu_fb=False):
                 f"e2e executor TopN(src) CONCURRENT(32): {m_32*1e3:.2f}"
                 f" ms/query throughput"
             )
+
+        # Per-stage TopN(src) breakdown (prep / dispatch / plane fetch /
+        # host winner-selection): the measurement groundwork for the
+        # 5-7 ms warm residual (ROADMAP 5) — each warm query runs under
+        # its own root trace and the topn.* span means land in the
+        # artifact.
+        stage_ms: dict[str, list] = {}
+        for _ in range(5 if cpu_fb else 20):
+            root = tr.start_trace("bench.topn")
+            with root:
+                ex.execute("i", mq)
+            rec = tr.finish_root(root)
+            for sp in (rec or {}).get("spans", []):
+                if sp["name"].startswith("topn."):
+                    stage_ms.setdefault(sp["name"], []).append(
+                        sp["duration_ms"]
+                    )
+        topn_breakdown = {
+            name: round(sorted(v)[len(v) // 2], 3)
+            for name, v in sorted(stage_ms.items())
+        }
+        if topn_breakdown:
+            log(
+                "TopN(src) per-stage p50 ms: "
+                + ", ".join(
+                    f"{k.split('.', 1)[1]} {v}"
+                    for k, v in topn_breakdown.items()
+                )
+            )
         ex.close()
         co.close()
         holder.close()
@@ -1066,7 +1203,7 @@ def run_executor_tiers(leaves, host_count, rng, dev_s, cpu_fb=False):
         f" max {coalesce_stats['total']['max_occupancy']},"
         f" pad rows {coalesce_stats['total']['pad_rows']})"
     )
-    return e2e_s, coalesce_stats
+    return e2e_s, coalesce_stats, topn_breakdown
 
 
 if __name__ == "__main__":

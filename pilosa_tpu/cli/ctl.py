@@ -113,6 +113,8 @@ def build_server(cfg: config_mod.Config):
         trace_ring=cfg.obs.trace_ring,
         hbm_budget_bytes=cfg.device.hbm_budget_bytes,
         device_prefetch=cfg.device.prefetch,
+        device_stage=cfg.device.stage,
+        stage_throttle_ms=cfg.device.stage_throttle_ms,
         coalesce=cfg.exec.coalesce,
         coalesce_max_batch=cfg.exec.coalesce_max_batch,
         coalesce_max_wait_us=cfg.exec.coalesce_max_wait_us,

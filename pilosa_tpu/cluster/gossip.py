@@ -63,6 +63,10 @@ INLINE_STATE_MAX = 16 * 1024
 STATE_CHUNK_SIZE = 32 * 1024
 # Partial chunk assemblies are dropped after this long.
 _ASSEMBLY_TTL = 30.0
+# Hot-slice piggyback caps: slices announced per index per datagram and
+# how long a peer's announcement stays fresh enough to steer staging.
+HOT_SLICES_MAX = 32
+HOT_TTL_S = 120.0
 # Blobs larger than this many chunks skip UDP and stream over the
 # peer's HTTP listener (the analog of memberlist's TCP push/pull,
 # reference: gossip/gossip.go:191-222): a large schema under sustained
@@ -100,6 +104,7 @@ class GossipNodeSet:
         state_provider=None,
         state_merger=None,
         state_fetcher=None,
+        hot_provider=None,
         logger=None,
         stats=None,
         ack_timeout: float = 0.25,
@@ -124,6 +129,14 @@ class GossipNodeSet:
         self.gossip_fanout = gossip_fanout
         self.state_provider = state_provider
         self.state_merger = state_merger
+        # Hot-slice piggyback: ``hot_provider() -> {index: [slice,...]}``
+        # rides every PING/ACK (capped, see HOT_SLICES_MAX), announcing
+        # which slices this node is actually serving queries over right
+        # now.  Receivers keep the per-peer sets; a restarting node
+        # reads the union (``remote_hot_slices``) to stage its hottest
+        # fragments FIRST (core/holder.stage_device_mirrors).
+        self.hot_provider = hot_provider
+        self._hot_remote: dict[str, tuple[float, dict]] = {}
         # Stream fallback: fetch a peer's whole state blob over its
         # HTTP listener (GET /state) when UDP chunking is the wrong
         # tool — injectable for tests.
@@ -437,6 +450,7 @@ class GossipNodeSet:
             self._register(sender, _parse_addr(obj["gaddr"]))
             self._merge_members(obj.get("members", []))
             self._merge_state(obj)
+            self._merge_hot(sender, obj)
             self._send_logged(
                 _parse_addr(obj["gaddr"]),
                 {
@@ -445,12 +459,14 @@ class GossipNodeSet:
                     "gaddr": _fmt_addr(self.advertise),
                     "members": self._member_list(),
                     **self._state_field(),
+                    **self._hot_field(),
                 },
             )
         elif typ == "ack":
             self._register(sender, _parse_addr(obj["gaddr"]))
             self._merge_members(obj.get("members", []))
             self._merge_state(obj)
+            self._merge_hot(sender, obj)
             # SWIM relay leg 3: if someone asked us to probe this
             # sender, tell them it answered.
             with self._mu:
@@ -487,6 +503,7 @@ class GossipNodeSet:
                     "gaddr": _fmt_addr(self.advertise),
                     "members": self._member_list(),
                     **self._state_field(),
+                    **self._hot_field(),
                 },
             )
         elif typ == "ind-ack":
@@ -541,6 +558,54 @@ class GossipNodeSet:
             self._seen_user[mid] = time.monotonic()
             while len(self._seen_user) > 4096:
                 self._seen_user.popitem(last=False)
+
+    def _hot_field(self) -> dict:
+        if self.hot_provider is None:
+            return {}
+        try:
+            hot = self.hot_provider()
+        except Exception as e:  # noqa: BLE001
+            self.logger(f"hot provider error: {e}")
+            return {}
+        if not hot:
+            return {}
+        return {
+            "hot": {
+                str(idx): [int(s) for s in slices[:HOT_SLICES_MAX]]
+                for idx, slices in hot.items()
+                if slices
+            }
+        }
+
+    def _merge_hot(self, sender: str, obj: dict) -> None:
+        hot = obj.get("hot")
+        if not sender or not isinstance(hot, dict):
+            return
+        clean: dict[str, list[int]] = {}
+        for idx, slices in hot.items():
+            if isinstance(slices, list):
+                clean[str(idx)] = [
+                    int(s) for s in slices[:HOT_SLICES_MAX]
+                    if isinstance(s, int)
+                ]
+        with self._mu:
+            self._hot_remote[sender] = (time.monotonic(), clean)
+
+    def remote_hot_slices(self) -> dict[str, list[int]]:
+        """Union of peers' fresh hot-slice announcements:
+        ``{index: [slice,...]}`` — the gossip-informed head of the
+        cold-staging priority queue."""
+        now = time.monotonic()
+        out: dict[str, dict[int, None]] = {}
+        with self._mu:
+            for _host, (t, hot) in self._hot_remote.items():
+                if now - t > HOT_TTL_S:
+                    continue
+                for idx, slices in hot.items():
+                    d = out.setdefault(idx, {})
+                    for s in slices:
+                        d.setdefault(s, None)
+        return {idx: list(d) for idx, d in out.items()}
 
     def _state_field(self) -> dict:
         if self.state_provider is None:
@@ -805,6 +870,7 @@ class GossipNodeSet:
                         "gaddr": _fmt_addr(self.advertise),
                         "members": self._member_list(),
                         **self._state_field(),
+                        **self._hot_field(),
                     },
                 )
             # SWIM suspect machinery: silence past suspect_after marks a
@@ -863,6 +929,7 @@ class GossipNodeSet:
                         "gaddr": _fmt_addr(self.advertise),
                         "members": self._member_list(),
                         **self._state_field(),
+                        **self._hot_field(),
                     },
                 )
                 pool = [r for r in relays if r[0] != h]

@@ -1140,6 +1140,14 @@ class Fragment:
                 {bp.home_device(self.slice): n * ROW_NBYTES},
             )
 
+    @property
+    def plane_nbytes(self) -> int:
+        """Host dense-plane byte size — what a staged device mirror
+        costs in HBM (pad_rows keeps the plane in pow2 row classes, so
+        this is also the mirror's compile-shape bucket x 128 KiB).
+        The staging/warming paths order and account by it."""
+        return int(self._plane.nbytes)
+
     def device_plane(self):
         """The HBM mirror of the plane, pinned to the slice's home device
         (slice mod n_devices) so multi-device query batches assemble

@@ -96,6 +96,16 @@ class PlanePool:
         self._over_budget = 0
         self._prefetch_hits = 0
         self._prefetch_misses = 0
+        # Cold-staging progress (core/holder.stage_device_mirrors +
+        # device/prefetch.py): scheduled/done/error counts, total bytes
+        # staged, and the LAST staging error — warm_device_mirrors once
+        # swallowed failures with only a log line; now every failure
+        # counts and the latest surfaces in /debug/hbm.
+        self._stage_scheduled = 0
+        self._stage_done = 0
+        self._stage_errors = 0
+        self._stage_bytes = 0
+        self._stage_last_error: str | None = None
         # 0 = auto (env -> detect -> unbounded); > 0 = explicit bytes.
         self._budget = int(budget_bytes or 0)
         self._detected: int | None = None
@@ -371,6 +381,32 @@ class PlanePool:
         if miss:
             self.stats.count("device.prefetch.miss", miss)
 
+    def count_stage(
+        self,
+        scheduled: int = 0,
+        done: int = 0,
+        errors: int = 0,
+        nbytes: int = 0,
+        last_error: str | None = None,
+    ) -> None:
+        """Cold-staging bookkeeping (``device.stage.*`` counters) — fed
+        by the holder's background stager and warm_device_mirrors."""
+        with self._mu:
+            self._stage_scheduled += scheduled
+            self._stage_done += done
+            self._stage_errors += errors
+            self._stage_bytes += nbytes
+            if last_error is not None:
+                self._stage_last_error = str(last_error)
+        if scheduled:
+            self.stats.count("device.stage.scheduled", scheduled)
+        if done:
+            self.stats.count("device.stage.done", done)
+        if errors:
+            self.stats.count("device.stage.errors", errors)
+        if nbytes:
+            self.stats.count("device.stage.bytes", nbytes)
+
     # ------------------------------------------------------------------
     # introspection
     # ------------------------------------------------------------------
@@ -442,5 +478,21 @@ class PlanePool:
                     "overBudget": self._over_budget,
                     "prefetchHit": self._prefetch_hits,
                     "prefetchMiss": self._prefetch_misses,
+                },
+                # Cold-staging progress for rolling restarts: a
+                # restarted node serves while this drains toward
+                # scheduled == done + errors.
+                "staging": {
+                    "scheduled": self._stage_scheduled,
+                    "done": self._stage_done,
+                    "errors": self._stage_errors,
+                    "pending": max(
+                        0,
+                        self._stage_scheduled
+                        - self._stage_done
+                        - self._stage_errors,
+                    ),
+                    "bytes": self._stage_bytes,
+                    "last_error": self._stage_last_error,
                 },
             }

@@ -15,6 +15,18 @@ that reaches a fragment mid-upload simply blocks on that fragment's
 lock until its mirror is ready (the overlap is across fragments, not
 within one).
 
+Two priority lanes share the workers:
+
+* **query lane** (:meth:`prefetch`) — the per-query cold-mirror warm;
+  always drains first.
+* **staging lane** (:meth:`stage`) — the post-restart background
+  re-materialization of the whole residency set
+  (core/holder.stage_device_mirrors).  A restarted node answers its
+  first queries while this lane drains; a query prefetch arriving
+  mid-staging jumps the entire backlog, so serving latency never
+  queues behind bulk staging.  ``throttle_s`` rate-limits the lane
+  (and is the knob the slowed-staging tests turn).
+
 Threads are daemons for the same reason the executor's pool uses them:
 a worker wedged inside a device call must degrade to a lost prefetch,
 never a process that cannot exit.
@@ -22,26 +34,76 @@ never a process that cannot exit.
 
 from __future__ import annotations
 
-import queue
 import threading
+import time
+from collections import deque
 
 DEFAULT_WORKERS = 8
+
+
+class StageJob:
+    """Progress handle for one :meth:`Prefetcher.stage` call."""
+
+    def __init__(self, total: int):
+        self.total = total
+        self.staged = 0
+        self.skipped = 0  # already resident at upload time
+        self.errors = 0
+        self._mu = threading.Lock()
+        self._done = threading.Event()
+        if total == 0:
+            self._done.set()
+
+    def _finish_one(self, *, staged: bool = False, skipped: bool = False,
+                    error: bool = False) -> None:
+        with self._mu:
+            self.staged += 1 if staged else 0
+            self.skipped += 1 if skipped else 0
+            self.errors += 1 if error else 0
+            if self.staged + self.skipped + self.errors >= self.total:
+                self._done.set()
+
+    @property
+    def remaining(self) -> int:
+        with self._mu:
+            return max(0, self.total - self.staged - self.skipped - self.errors)
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def wait(self, timeout: float | None = None) -> bool:
+        return self._done.wait(timeout)
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            return {
+                "total": self.total,
+                "staged": self.staged,
+                "skipped": self.skipped,
+                "errors": self.errors,
+                "remaining": max(
+                    0, self.total - self.staged - self.skipped - self.errors
+                ),
+            }
 
 
 class Prefetcher:
     """Re-materialize cold fragment mirrors in background threads.
 
-    ``pool`` supplies the hit/miss counters and is usually the global
-    ``pilosa_tpu.device.pool()`` (the default when None).
+    ``pool`` supplies the hit/miss/stage counters and is usually the
+    global ``pilosa_tpu.device.pool()`` (the default when None).
     """
 
     def __init__(self, pool=None, max_workers: int = DEFAULT_WORKERS):
         self._pool = pool
         self._max_workers = max_workers
-        self._work: "queue.SimpleQueue" = queue.SimpleQueue()
+        # Two-lane work queue: query prefetches (high) always pop
+        # before background staging (low).
+        self._high: deque = deque()
+        self._low: deque = deque()
+        self._cv = threading.Condition(threading.Lock())
         self._threads: list[threading.Thread] = []
         self._idle = 0
-        self._mu = threading.Lock()
 
     def pool(self):
         if self._pool is not None:
@@ -50,24 +112,29 @@ class Prefetcher:
 
         return device_mod.pool()
 
+    @staticmethod
+    def _is_cold(f) -> bool:
+        # Advisory peek (no lock): a racing writer only flips a
+        # fragment cold, and the worker re-checks under the lock.
+        return f._device is None or f._device_version != f._version
+
     def prefetch(self, frags, wait: bool = False) -> int:
-        """Schedule uploads for every COLD fragment in ``frags``;
-        already-resident mirrors count as prefetch hits.  Returns the
-        number scheduled.  ``wait=True`` blocks until every scheduled
-        upload finished (tests and the bench use it; the executor fires
-        and forgets — per-fragment locks provide the synchronization)."""
+        """Schedule QUERY-lane uploads for every COLD fragment in
+        ``frags``; already-resident mirrors count as prefetch hits.
+        Returns the number scheduled.  ``wait=True`` blocks until every
+        scheduled upload finished (tests and the bench use it; the
+        executor fires and forgets — per-fragment locks provide the
+        synchronization)."""
         pool = self.pool()
         cold = []
         hits = 0
         for f in frags:
             if f is None:
                 continue
-            # Advisory peek (no lock): a racing writer only flips a
-            # fragment cold, and the worker re-checks under the lock.
-            if f._device is not None and f._device_version == f._version:
-                hits += 1
-            else:
+            if self._is_cold(f):
                 cold.append(f)
+            else:
+                hits += 1
         if hits:
             pool.count_prefetch(hit=hits)
         if not cold:
@@ -76,44 +143,90 @@ class Prefetcher:
         remaining = [len(cold)]
         rlock = threading.Lock()
         for f in cold:
-            self._submit(f, pool, remaining, rlock, done)
+            self._submit(
+                ("prefetch", f, pool, remaining, rlock, done), low=False
+            )
         if wait:
             done.wait()
         return len(cold)
 
+    def stage(self, frags, throttle_s: float = 0.0) -> StageJob:
+        """Schedule STAGING-lane uploads for every cold fragment in
+        ``frags`` (order preserved — the holder submits them in
+        priority order) and return the job's progress handle.  Query
+        prefetches always jump this backlog.  ``throttle_s`` sleeps
+        between uploads — an operator knob to keep bulk staging from
+        saturating the host->device link while serving (and the hook
+        the deliberately-slowed restart tests use)."""
+        pool = self.pool()
+        cold = [f for f in frags if f is not None and self._is_cold(f)]
+        job = StageJob(len(cold))
+        if cold:
+            pool.count_stage(scheduled=len(cold))
+            for f in cold:
+                self._submit(("stage", f, pool, job, throttle_s), low=True)
+        return job
+
     # ------------------------------------------------------------------
 
-    def _submit(self, frag, pool, remaining, rlock, done) -> None:
-        with self._mu:
-            self._work.put((frag, pool, remaining, rlock, done))
+    def _submit(self, item: tuple, low: bool) -> None:
+        with self._cv:
+            (self._low if low else self._high).append(item)
             if self._idle == 0 and len(self._threads) < self._max_workers:
                 t = threading.Thread(
                     target=self._worker, daemon=True, name="hbm-prefetch"
                 )
                 self._threads.append(t)
                 t.start()
+            else:
+                self._cv.notify()
+
+    def _take(self) -> tuple:
+        with self._cv:
+            self._idle += 1
+            while not self._high and not self._low:
+                self._cv.wait()
+            self._idle -= 1
+            return self._high.popleft() if self._high else self._low.popleft()
 
     def _worker(self) -> None:
         while True:
-            with self._mu:
-                self._idle += 1
-            item = self._work.get()
-            with self._mu:
-                self._idle -= 1
-            frag, pool, remaining, rlock, done = item
-            try:
-                was_cold = (
-                    frag._device is None
-                    or frag._device_version != frag._version
-                )
-                frag.device_plane()
-                pool.count_prefetch(
-                    hit=0 if was_cold else 1, miss=1 if was_cold else 0
-                )
-            except Exception:  # noqa: BLE001 — prefetch is best-effort;
-                pass  # the query path re-raises any real failure itself
-            finally:
-                with rlock:
-                    remaining[0] -= 1
-                    if remaining[0] == 0:
-                        done.set()
+            item = self._take()
+            if item[0] == "prefetch":
+                self._run_prefetch(*item[1:])
+            else:
+                self._run_stage(*item[1:])
+
+    def _run_prefetch(self, frag, pool, remaining, rlock, done) -> None:
+        try:
+            was_cold = self._is_cold(frag)
+            frag.device_plane()
+            pool.count_prefetch(
+                hit=0 if was_cold else 1, miss=1 if was_cold else 0
+            )
+        except Exception:  # noqa: BLE001 — prefetch is best-effort;
+            pass  # the query path re-raises any real failure itself
+        finally:
+            with rlock:
+                remaining[0] -= 1
+                if remaining[0] == 0:
+                    done.set()
+
+    def _run_stage(self, frag, pool, job: StageJob, throttle_s: float) -> None:
+        try:
+            if not self._is_cold(frag):
+                # A query (or its prefetch) got here first — the whole
+                # point of lazy staging.
+                pool.count_stage(done=1)
+                job._finish_one(skipped=True)
+                return
+            if throttle_s > 0:
+                time.sleep(throttle_s)
+            frag.device_plane()
+            pool.count_stage(done=1, nbytes=frag.plane_nbytes)
+            job._finish_one(staged=True)
+        except Exception as e:  # noqa: BLE001 — staging is best-effort,
+            # but never silent: the error counts and the last one
+            # surfaces in /debug/hbm.
+            pool.count_stage(errors=1, last_error=repr(e))
+            job._finish_one(error=True)

@@ -314,7 +314,10 @@ class CoalesceScheduler:
         if len(segs) == 1:
             dev_in = segs[0]
         else:
-            bucket = 1 << (total - 1).bit_length()
+            # The canonical slice-axis bucket (plan.slice_bucket): the
+            # concatenated launch lands on the same compiled program a
+            # direct query over that bucket would.
+            bucket = plan.slice_bucket(total)
             pad = bucket - total
             parts = list(segs)
             if pad:

@@ -885,7 +885,7 @@ class Executor:
                 i += 1
         if not kept:
             return None, kept, empties
-        bucket = 1 << (len(kept) - 1).bit_length()
+        bucket = plan.slice_bucket(len(kept))
         if bucket <= rows_buf.shape[0]:
             # positions past the last kept slice were never written
             batch_np = rows_buf[:bucket]
@@ -1132,7 +1132,7 @@ class Executor:
         groups: dict[int, list[int]] = {d: [] for d in range(n_dev)}
         for s in kept:
             groups[s % n_dev].append(s)
-        chunk = 1 << (((len(kept) + n_dev - 1) // n_dev) - 1).bit_length()
+        chunk = plan.slice_bucket((len(kept) + n_dev - 1) // n_dev)
         spill: list[int] = []
         for d in range(n_dev):
             while len(groups[d]) > chunk:
@@ -1689,7 +1689,13 @@ class Executor:
         copy, no src upload — and is fetched as ONE array.  The
         per-fragment path paid a dispatch + a 128 KiB src upload + a
         fetch PER SLICE: 444 ms/query at 100 slices through the
-        tunnel."""
+        tunnel.
+
+        The ``topn.dispatch`` / ``topn.fetch`` spans split the device
+        cost: dispatch covers gather prep + the async program launches,
+        fetch the blocking device->host transfer — with ``topn.select``
+        in the callers, the per-stage TopN(src) breakdown ROADMAP 5
+        needs before attacking the 5-7 ms residual."""
         groups: dict[tuple, list] = {}
         for entry in parts:
             ref = entry[1]
@@ -1699,30 +1705,35 @@ class Executor:
                 (ref.shape, ref.plane_rows, ref.device), []
             ).append(entry)
         dev_outs = []  # (device array, [states]) fetched in one pass
-        for _gkey, members in groups.items():
-            # Pad the group to a power-of-two bucket by repeating the
-            # last member (the row dimension is already pad_rows-
-            # bucketed): an unpadded group size would compile a fresh
-            # XLA program per distinct slice count.  Surplus rows are
-            # simply not consumed when the fetched scores distribute.
-            n_pad = 1 << (len(members) - 1).bit_length()
-            padded = members + [members[-1]] * (n_pad - len(members))
-            planes = tuple(m[1].plane for m in padded)
-            slots = np.stack([m[1].slots for m in padded])
-            # Same-plane src slot for every member -> zero src bytes
-            # cross the host boundary (and no extra leaf shapes in the
-            # jit key); otherwise one stacked host-snapshot transfer
-            # for the group.
-            if all(m[3] is not None for m in padded):
-                src_slots = np.asarray([m[3] for m in padded], dtype=np.int32)
-                out = bp.score_planes(planes, slots, src_slots=src_slots)
-            else:
-                srcs = np.stack([m[2] for m in padded])
-                out = bp.score_planes(planes, slots, srcs=srcs)
-            dev_outs.append((out, [m[0] for m in members]))
+        with self.tracer.span("topn.dispatch", groups=len(groups)):
+            for _gkey, members in groups.items():
+                # Pad the group to a power-of-two bucket by repeating
+                # the last member (the row dimension is already
+                # pad_rows-bucketed): an unpadded group size would
+                # compile a fresh XLA program per distinct slice count.
+                # Surplus rows are simply not consumed when the fetched
+                # scores distribute.
+                n_pad = 1 << (len(members) - 1).bit_length()
+                padded = members + [members[-1]] * (n_pad - len(members))
+                planes = tuple(m[1].plane for m in padded)
+                slots = np.stack([m[1].slots for m in padded])
+                # Same-plane src slot for every member -> zero src bytes
+                # cross the host boundary (and no extra leaf shapes in
+                # the jit key); otherwise one stacked host-snapshot
+                # transfer for the group.
+                if all(m[3] is not None for m in padded):
+                    src_slots = np.asarray(
+                        [m[3] for m in padded], dtype=np.int32
+                    )
+                    out = bp.score_planes(planes, slots, src_slots=src_slots)
+                else:
+                    srcs = np.stack([m[2] for m in padded])
+                    out = bp.score_planes(planes, slots, srcs=srcs)
+                dev_outs.append((out, [m[0] for m in members]))
         if not dev_outs:
             return
-        fetched = jax.device_get([o for o, _ in dev_outs])
+        with self.tracer.span("topn.fetch", arrays=len(dev_outs)):
+            fetched = jax.device_get([o for o, _ in dev_outs])
         for arr, (_, sts) in zip(fetched, dev_outs):
             arr = np.asarray(arr)
             for i, st in enumerate(sts):
@@ -2019,7 +2030,8 @@ class Executor:
         n = _uint_arg(c, "n")[0]
         if len(c.children) > 1:
             raise ExecutorError("TopN() can only have one input bitmap")
-        ent = self._topn_folded_entry(index, c, slices)
+        with self.tracer.span("topn.prep", slices=len(slices)):
+            ent = self._topn_folded_entry(index, c, slices)
         if ent.get("empty"):
             return []
         if ent.get("two_phase"):
@@ -2047,48 +2059,52 @@ class Executor:
         # two-phase protocol's first round would have produced for the
         # slice's own candidates (cand_ids is a subset of the union) —
         # all in numpy: at union scale, Pair-object bookkeeping in
-        # Python dominated warm TopN host time.
-        winner_ids: list[np.ndarray] = []
-        fulls: list[tuple[np.ndarray, np.ndarray]] = []
-        for frag, topt, cand_ids, cand_mask, st in states:
-            ids, cnts, keep, short = frag.top_score_arrays(st)
-            fulls.append((ids[keep], cnts[keep]))
-            if topt.src is None:
-                winner_ids.append(
-                    cand_ids[: topt.n] if topt.n else cand_ids
-                )
-            elif short:
-                # Scoring short-circuited (e.g. no src segment here):
-                # the subset selection would short-circuit identically.
-                winner_ids.append(ids)
-            else:
-                sel_ids, _ = frag.select_winners(
-                    ids, cnts, keep, cand_ids, topt.n, cand_mask=cand_mask
-                )
-                winner_ids.append(sel_ids)
-        ids2 = (
-            np.unique(np.concatenate(winner_ids))
-            if winner_ids
-            else np.empty(0, np.int64)
-        )
-        if not len(ids2):
-            return []
+        # Python dominated warm TopN host time.  The ``topn.select``
+        # span is the host-winner-selection leg of the per-stage
+        # TopN(src) breakdown (with topn.dispatch/topn.fetch).
+        with self.tracer.span("topn.select", parts=len(states)):
+            winner_ids: list[np.ndarray] = []
+            fulls: list[tuple[np.ndarray, np.ndarray]] = []
+            for frag, topt, cand_ids, cand_mask, st in states:
+                ids, cnts, keep, short = frag.top_score_arrays(st)
+                fulls.append((ids[keep], cnts[keep]))
+                if topt.src is None:
+                    winner_ids.append(
+                        cand_ids[: topt.n] if topt.n else cand_ids
+                    )
+                elif short:
+                    # Scoring short-circuited (e.g. no src segment
+                    # here): the subset selection would short-circuit
+                    # identically.
+                    winner_ids.append(ids)
+                else:
+                    sel_ids, _ = frag.select_winners(
+                        ids, cnts, keep, cand_ids, topt.n, cand_mask=cand_mask
+                    )
+                    winner_ids.append(sel_ids)
+            ids2 = (
+                np.unique(np.concatenate(winner_ids))
+                if winner_ids
+                else np.empty(0, np.int64)
+            )
+            if not len(ids2):
+                return []
 
-        # Phase-2 equivalent: exact counts for the winner union, already
-        # in hand; counts SUM across slices (reference reduce:
-        # Pairs.Add, cache.go:312-334).
-        kept = []
-        for i, cts in fulls:
-            m = isin_sorted(i, ids2)
-            kept.append((i[m], cts[m]))
-        merged = merge_counts_by_id(kept)
-        if merged is None:
-            return []
-        uids, sums = merged
-        order = np.lexsort((uids, -sums))
-        if n and n < len(order):
-            order = order[:n]
-        return [Pair(int(uids[k]), int(sums[k])) for k in order]
+            # Phase-2 equivalent: exact counts for the winner union,
+            # already in hand; counts SUM across slices (reference
+            # reduce: Pairs.Add, cache.go:312-334).
+            kept = []
+            for i, cts in fulls:
+                m = isin_sorted(i, ids2)
+                kept.append((i[m], cts[m]))
+            merged = merge_counts_by_id(kept)
+            if merged is None:
+                return []
+            uids, sums = merged
+            order = np.lexsort((uids, -sums))
+            if n and n < len(order):
+                order = order[:n]
+            return [Pair(int(uids[k]), int(sums[k])) for k in order]
 
     def _execute_topn_slices(
         self, index: str, c: Call, slices: list[int], opt: ExecOptions

@@ -23,7 +23,8 @@ executor.go:418-434,486-505,621-637).
 
 from __future__ import annotations
 
-import functools
+import threading
+from collections import OrderedDict, namedtuple
 
 import jax
 import jax.numpy as jnp
@@ -324,8 +325,94 @@ def expr_has_bsi(expr: tuple) -> bool:
     return any(expr_has_bsi(e) for e in expr[1:])
 
 
-@functools.lru_cache(maxsize=512)
-def _compiled_total_count(expr: tuple, mesh):
+def slice_bucket(n: int) -> int:
+    """Canonical pow2 bucket for a batch's leading slice axis — the ONE
+    bucketing rule every batch assembler (executor, coalescer, warmup)
+    must use, so their launches land on the same compiled programs."""
+    from pilosa_tpu.ops import bitplane as bp
+
+    return bp.pow2_bucket(n, 1)
+
+
+class _Program:
+    """Recording proxy around one jitted wrapper: records the bucketed
+    leading batch axis at call time (feeding the hard-bound gauges) and
+    passes ``lower`` through for AOT compile probes.  The underlying
+    jit wrapper compiles once per distinct batch shape — with callers
+    bucketing the slice axis to powers of two, a wrapper's compiled
+    entry count is bounded by the bucket-class count, not by how many
+    distinct slice sets queries touch."""
+
+    __slots__ = ("fn", "family")
+
+    def __init__(self, fn, family: str):
+        self.fn = fn
+        self.family = family
+
+    def __call__(self, batch):
+        _note_bucket(self.family, int(batch.shape[0]))
+        return self.fn(batch)
+
+    def lower(self, *args, **kwargs):
+        return self.fn.lower(*args, **kwargs)
+
+
+CacheInfo = namedtuple("CacheInfo", ["hits", "misses", "maxsize", "currsize"])
+
+
+class _ProgramCache:
+    """Bounded memo of jit wrappers keyed by compile statics, with
+    ``cache_info()`` compatible with the functools.lru_cache interface
+    it replaces — replaced so :func:`program_cache_stats` can walk the
+    live wrappers and count their COMPILED entries (an lru_cache hides
+    its values).  Eviction past ``maxsize`` drops the oldest wrapper
+    (and with it, its compiled executables)."""
+
+    def __init__(self, builder, family: str, maxsize: int = 512):
+        self._builder = builder
+        self._family = family
+        self._maxsize = maxsize
+        self._d: "OrderedDict[tuple, _Program]" = OrderedDict()
+        self._mu = threading.Lock()
+        self._hits = 0
+        self._misses = 0
+
+    def __call__(self, *key) -> _Program:
+        with self._mu:
+            prog = self._d.get(key)
+            if prog is not None:
+                self._hits += 1
+                return prog
+            self._misses += 1
+        fn = self._builder(*key)
+        prog = _Program(fn, self._family)
+        with self._mu:
+            cur = self._d.setdefault(key, prog)
+            while len(self._d) > self._maxsize:
+                self._d.popitem(last=False)
+            return cur
+
+    def cache_info(self) -> CacheInfo:
+        with self._mu:
+            return CacheInfo(self._hits, self._misses, self._maxsize, len(self._d))
+
+    def cache_clear(self) -> None:
+        with self._mu:
+            progs = list(self._d.values())
+            self._d.clear()
+            self._hits = self._misses = 0
+        for p in progs:
+            try:
+                p.fn.clear_cache()
+            except Exception:  # noqa: BLE001 — jax version without it
+                pass
+
+    def programs(self) -> list[_Program]:
+        with self._mu:
+            return list(self._d.values())
+
+
+def _build_total_count(expr: tuple, mesh):
     per_slice = expr_has_bsi(expr)
 
     def fn(batch):
@@ -356,14 +443,26 @@ def _compiled_total_count(expr: tuple, mesh):
     return jax.jit(fn, out_shardings=NamedSharding(mesh, P()))
 
 
-@functools.lru_cache(maxsize=512)
-def _compiled_batched(expr: tuple, reduce: str):
+def _build_batched(expr: tuple, reduce: str):
     return jax.jit(jax.vmap(_make_fn(expr, reduce)))
 
 
+_compiled_batched = _ProgramCache(_build_batched, "plan.batched")
+_compiled_total_count = _ProgramCache(_build_total_count, "plan.totalCount")
+
+
 # ---------------------------------------------------------------------------
-# compiled-program cardinality (observability for ROADMAP 2a's cap)
+# compiled-program cardinality (ROADMAP 2a: canonical keys + hard bounds)
 # ---------------------------------------------------------------------------
+
+# family -> largest bucketed leading batch axis dispatched so far.
+# Plain dict writes: racing writers both store valid maxima.
+_BUCKET_HIGHWATER: dict[str, int] = {}
+
+
+def _note_bucket(family: str, bucket: int) -> None:
+    if bucket > _BUCKET_HIGHWATER.get(family, 0):
+        _BUCKET_HIGHWATER[family] = bucket
 
 
 def _jit_cache_size(fn) -> int:
@@ -376,18 +475,25 @@ def _jit_cache_size(fn) -> int:
 
 
 def program_cache_stats() -> dict[str, int]:
-    """Compiled-program cache entry counts per jit wrapper family —
-    the ``exec.programCache.entries`` gauge on /metrics.  ``plan.*``
-    counts distinct (tree shape, reduce)/(tree shape, mesh) wrapper
-    FUNCTIONS (each then compiles per batch-shape bucket);
+    """COMPILED-program counts per jit family — the
+    ``exec.programCache.entries`` gauge on /metrics.  ``plan.*`` sums
+    the compiled entries inside every live (tree shape, reduce)/(tree
+    shape, mesh) wrapper (one entry per batch-shape bucket);
     ``bitplane.*`` counts compiled entries inside the module-level jit
-    wrappers (the TopN scorer keys on per-fragment plane shapes — the
-    cardinality ROADMAP 2a wants capped)."""
+    wrappers (the TopN scorer keys on per-fragment plane shapes).
+    Every counted key is canonicalized — slice axes, plane rows,
+    candidate slots, and fragment-group sizes all bucket to powers of
+    two — so each family is hard-bounded by its bucket grid
+    (:func:`program_cache_bounds`), not by schema churn."""
     from pilosa_tpu.ops import bitplane as bp
 
     out = {
-        "plan.batched": _compiled_batched.cache_info().currsize,
-        "plan.totalCount": _compiled_total_count.cache_info().currsize,
+        "plan.batched": sum(
+            _jit_cache_size(p.fn) for p in _compiled_batched.programs()
+        ),
+        "plan.totalCount": sum(
+            _jit_cache_size(p.fn) for p in _compiled_total_count.programs()
+        ),
         "bitplane.scorePlanes": (
             _jit_cache_size(bp._score_planes_self_src)
             + _jit_cache_size(bp._score_planes_host_src)
@@ -399,6 +505,69 @@ def program_cache_stats() -> dict[str, int]:
     return out
 
 
+def program_cache_bounds() -> dict[str, int]:
+    """Hard per-family cardinality bounds implied by the pow2 bucket
+    grids at the LARGEST shapes observed so far (``exec.programCache.
+    bound`` on /metrics).  ``entries <= bound`` is an invariant: a
+    family exceeding its bound means some caller stopped canonicalizing
+    its compile key — exactly what the churny-schema regression test
+    asserts.  Families whose keys carry arbitrary caller shapes
+    (``bitplane.fusedCount``) have no derivable bound and are omitted."""
+    from pilosa_tpu.ops import bitplane as bp
+
+    hw = bp.shape_highwater()
+    rb = bp.ROW_BLOCK
+
+    def slice_classes(family: str) -> int:
+        return bp.bucket_classes(max(_BUCKET_HIGHWATER.get(family, 1), 1))
+
+    return {
+        # distinct wrappers x slice-bucket classes per wrapper
+        "plan.batched": (
+            _compiled_batched.cache_info().currsize
+            * slice_classes("plan.batched")
+        ),
+        "plan.totalCount": (
+            _compiled_total_count.cache_info().currsize
+            * slice_classes("plan.totalCount")
+        ),
+        # (self-src + host-src) x fragment-group classes x plane-row
+        # classes x candidate-slot classes
+        "bitplane.scorePlanes": (
+            2
+            * bp.bucket_classes(max(hw.get("score_frags", 1), 1))
+            * bp.bucket_classes(max(hw.get("score_rows", rb), rb), rb)
+            * bp.bucket_classes(max(hw.get("score_slots", rb), rb), rb)
+        ),
+        "bitplane.topCounts": bp.bucket_classes(
+            max(hw.get("top_rows", rb), rb), rb
+        ),
+    }
+
+
 def program_cache_entries() -> int:
     """Total compiled-program cache entries (the headline gauge)."""
     return program_cache_stats()["total"]
+
+
+def clear_program_caches() -> None:
+    """Drop every compiled program and the bucket high-water marks —
+    test isolation for the cardinality regression suite (a process
+    that already ran queries would otherwise leak entries into another
+    test's gauge assertions)."""
+    from pilosa_tpu.ops import bitplane as bp
+
+    _compiled_batched.cache_clear()
+    _compiled_total_count.cache_clear()
+    _BUCKET_HIGHWATER.clear()
+    bp._SHAPE_HIGHWATER.clear()
+    for fn in (
+        bp._score_planes_self_src,
+        bp._score_planes_host_src,
+        bp._fused_count_xla,
+        bp._top_counts_xla,
+    ):
+        try:
+            fn.clear_cache()
+        except Exception:  # noqa: BLE001 — jax version without it
+            pass

@@ -114,6 +114,31 @@ def prewarm_coalesce(
     return warmed
 
 
+def prewarm_topn(
+    row_buckets=(bp.ROW_BLOCK, 2 * bp.ROW_BLOCK), group_buckets=(1,)
+) -> int:
+    """Compile the fused TopN scorer's smallest bucket shapes — the
+    self-src variant of ``bp.score_planes`` (the common
+    ``TopN(Bitmap(frame=f), frame=f)`` shape) at the first plane-row /
+    candidate-slot classes.  Every dimension of the scorer's jit key is
+    pow2-bucketed (ops/bitplane.py), so this warms the exact programs a
+    fresh node's first TopN queries hit."""
+    warmed = 0
+    for rows in row_buckets:
+        for n in group_buckets:
+            planes = tuple(
+                np.zeros((rows, bp.WORDS_PER_SLICE), dtype=np.uint32)
+                for _ in range(n)
+            )
+            slots = np.zeros((n, rows), dtype=np.int32)
+            src_slots = np.zeros(n, dtype=np.int32)
+            bp.score_planes(
+                planes, slots, src_slots=src_slots
+            ).block_until_ready()
+            warmed += 1
+    return warmed
+
+
 def prewarm(buckets=(1, 2, 4, 8), exprs=_STANDARD_EXPRS, coalesce=False) -> int:
     """Compile the standard (tree shape x slice bucket) programs.
 
@@ -162,6 +187,7 @@ def prewarm(buckets=(1, 2, 4, 8), exprs=_STANDARD_EXPRS, coalesce=False) -> int:
                 plan.compiled_total_count(expr, mesh)(batch).block_until_ready()
                 plan.compiled_batched(expr, "row")(batch).block_until_ready()
                 warmed += 2
+    warmed += prewarm_topn()
     if coalesce:
         warmed += prewarm_coalesce()
     return warmed

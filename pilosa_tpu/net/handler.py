@@ -1140,6 +1140,13 @@ class Handler:
             gauges["exec.programCache.entries"] = stats.pop("total")
             for family, n in stats.items():
                 gauges[f"exec.programCache.entries[cache:{family}]"] = n
+            # Hard per-family cardinality bounds implied by the pow2
+            # bucket grids (entries <= bound is an invariant; a breach
+            # means a caller stopped canonicalizing its compile key).
+            bounds = plan_mod.program_cache_bounds()
+            gauges["exec.programCache.bound"] = sum(bounds.values())
+            for family, n in bounds.items():
+                gauges[f"exec.programCache.bound[cache:{family}]"] = n
         except Exception:  # noqa: BLE001 — stats must not fail the scrape
             pass
 

@@ -56,6 +56,8 @@ class Server:
         trace_ring: int = 64,
         hbm_budget_bytes: int = 0,
         device_prefetch: bool = True,
+        device_stage: bool = True,
+        stage_throttle_ms: float = 0.0,
         coalesce: bool = True,
         coalesce_max_batch: int = 64,
         coalesce_max_wait_us: int = 0,
@@ -93,6 +95,14 @@ class Server:
         # cold-mirror prefetcher toggle.
         self.hbm_budget_bytes = hbm_budget_bytes
         self.device_prefetch = device_prefetch
+        # Lazy overlapped cold staging ([device] stage): a restarted
+        # node starts serving immediately while its fragment mirrors
+        # stream into HBM in the background — gossip-hot slices first,
+        # then the pre-restart residency order.  stage_throttle_ms
+        # rate-limits the background lane (0 = full speed).
+        self.device_stage = device_stage
+        self.stage_throttle_ms = stage_throttle_ms
+        self.staging_job = None
         # Cross-query coalescing ([exec] config): concurrent queries
         # sharing a compile key ride one fused launch (exec/coalesce.py).
         self.coalesce = coalesce
@@ -218,21 +228,6 @@ class Server:
             warmup.prewarm_async(
                 logger=self.logger, coalesce=self.coalesce
             )
-            # After the programs, the DATA: stage fragment planes into
-            # HBM in the background so first queries skip the
-            # host->device transfer too (the dominant cold cost once
-            # compiles come from the persistent cache).
-            def _warm_mirrors():
-                try:
-                    n = self.holder.warm_device_mirrors()
-                    if n:
-                        self.logger(f"warmed {n} fragment device mirrors")
-                except Exception as e:  # noqa: BLE001
-                    self.logger(f"mirror warming failed: {e}")
-
-            threading.Thread(
-                target=_warm_mirrors, daemon=True, name="mirror-warm"
-            ).start()
 
         # Start HTTP listener first so ":0" resolves to the real port
         # before the node self-registers (reference: server.go:109-125).
@@ -283,6 +278,11 @@ class Server:
                     self.handle_remote_status(st)
 
                 ns.state_merger = _merge
+            if hasattr(ns, "hot_provider") and ns.hot_provider is None:
+                # Announce this node's hottest resident slices on every
+                # ping/ack, so restarting peers stage what the cluster
+                # is being asked about FIRST.
+                ns.hot_provider = self.holder.hot_slices
             if hasattr(ns, "on_membership_change"):
                 ns.on_membership_change = self._on_membership_change
             ns.open()
@@ -303,6 +303,28 @@ class Server:
             **kwargs,
         )
         self.handler.executor = self.executor
+
+        # Lazy overlapped cold staging: serving starts NOW; fragment
+        # mirrors stream into HBM behind it — gossip-announced hot
+        # slices first, then the pre-restart residency table (MRU
+        # first), then everything else.  A query landing on a still-
+        # cold slice stages exactly its own planes through the query
+        # path/prefetcher and jumps this backlog.  The eager
+        # warm_device_mirrors loop this replaces serialized the whole
+        # mirror set (~254 MB, cold e2e 4.79 s) before the first
+        # answer.
+        if self.device_stage:
+            self.staging_job = self.holder.stage_device_mirrors(
+                device_mod.prefetcher(),
+                hot_slices=self._gossip_hot_slices(),
+                throttle_s=self.stage_throttle_ms / 1000.0,
+                tracer=self.tracer,
+            )
+            if self.staging_job.total:
+                self.logger(
+                    f"staging {self.staging_job.total} fragment mirrors "
+                    "in the background (device.stage.* / /debug/hbm)"
+                )
 
         self._http_thread = threading.Thread(
             target=self._http.serve_forever, daemon=True, name=f"http:{self.host}"
@@ -427,6 +449,18 @@ class Server:
                     )
         except Exception:  # noqa: BLE001 — device stats are best-effort
             pass
+
+    def _gossip_hot_slices(self) -> dict[str, list[int]]:
+        """Peers' fresh hot-slice announcements (union), when the
+        cluster runs a gossip node set; {} otherwise."""
+        ns = getattr(self.cluster, "node_set", None)
+        fn = getattr(ns, "remote_hot_slices", None)
+        if fn is None:
+            return {}
+        try:
+            return fn()
+        except Exception:  # noqa: BLE001 — staging order is best-effort
+            return {}
 
     def _on_membership_change(self, items) -> None:
         """Merge NodeSet membership into cluster node *states*.  The node

@@ -37,9 +37,15 @@ CONTAINER_BITS = 1 << 16
 WORDS_PER_CONTAINER = CONTAINER_BITS // WORD_BITS  # 2048
 CONTAINERS_PER_SLICE = SLICE_WIDTH // CONTAINER_BITS  # 16
 
-# Rows are padded to multiples of ROW_BLOCK so query shapes bucket into a
-# small set of compiled programs (avoids XLA recompilation storms when
-# maxRowID grows one row at a time).
+# Rows are padded to power-of-two shape classes (floor ROW_BLOCK) so
+# query shapes bucket into a LOG-bounded set of compiled programs.  The
+# former multiple-of-8 padding kept single-row growth from recompiling,
+# but a churny schema still minted a fresh XLA program every 8 rows
+# (~326 ms each, VERDICT item 3): plane mirrors and candidate slot
+# arrays both enter jit keys by shape, so their shape-class count IS the
+# compiled-program cardinality.  pow2 classes bound it at
+# log2(rows/ROW_BLOCK)+1 regardless of how many distinct fragment
+# shapes the schema produces.
 ROW_BLOCK = 8
 
 
@@ -55,11 +61,25 @@ def empty_plane(rows: int) -> np.ndarray:
     return np.zeros((rows, WORDS_PER_SLICE), dtype=np.uint32)
 
 
+def pow2_bucket(n: int, floor: int = 1) -> int:
+    """Round ``n`` up to the next power of two, at least ``floor``."""
+    if n <= floor:
+        return floor
+    return 1 << (n - 1).bit_length()
+
+
+def bucket_classes(hi: int, floor: int = 1) -> int:
+    """How many distinct pow2 shape classes cover sizes in [1, hi] —
+    the hard bound on compiled-program cardinality per bucketed
+    dimension (exec/plan.program_cache_bounds)."""
+    if hi <= floor:
+        return 1
+    return (pow2_bucket(hi, floor) // floor).bit_length()
+
+
 def pad_rows(rows: int) -> int:
-    """Round a row count up to the shape bucket."""
-    if rows <= 0:
-        return ROW_BLOCK
-    return ((rows + ROW_BLOCK - 1) // ROW_BLOCK) * ROW_BLOCK
+    """Round a row count up to its pow2 shape class (floor ROW_BLOCK)."""
+    return pow2_bucket(rows, ROW_BLOCK)
 
 
 # ---------------------------------------------------------------------------
@@ -351,6 +371,25 @@ def _top_counts_xla(plane, src_row):
     )
 
 
+# Largest bucketed dimension each scorer family has seen — the inputs to
+# the hard cardinality bounds (exec/plan.program_cache_bounds): every
+# dimension below is pow2-bucketed by the callers, so a family's compiled
+# entry count can never exceed the product of its dimensions' class
+# counts.  Plain dict writes (no lock): racing writers both store valid
+# maxima and the bound is re-derived per read.
+_SHAPE_HIGHWATER: dict[str, int] = {}
+
+
+def _note_shape(**dims: int) -> None:
+    for k, v in dims.items():
+        if v > _SHAPE_HIGHWATER.get(k, 0):
+            _SHAPE_HIGHWATER[k] = v
+
+
+def shape_highwater() -> dict[str, int]:
+    return dict(_SHAPE_HIGHWATER)
+
+
 def top_counts(plane, src_row):
     """Per-row |row AND src| -> int32[rows]: the batched TopN(Src=...) scorer.
 
@@ -359,6 +398,7 @@ def top_counts(plane, src_row):
     every row in one fused batched kernel and select on the host — same
     results, hardware-shaped loop structure.
     """
+    _note_shape(top_rows=int(plane.shape[0]))
     return _top_counts_xla(plane, src_row)
 
 
@@ -409,7 +449,19 @@ def score_planes(planes, slots, src_slots=None, srcs=None):
     popcount reduce, so each candidate row is read once.  Returns
     int32[n_frag, rows].  One dispatch + one fetch per query where the
     per-fragment path paid a dispatch, a src transfer, and a fetch PER
-    SLICE (444 ms/query at 100 slices through the tunnel)."""
+    SLICE (444 ms/query at 100 slices through the tunnel).
+
+    Every dimension of the jit key is pow2-bucketed by the callers —
+    fragment count (executor group padding), plane rows (pad_rows at
+    plane allocation), candidate slots (pad_rows at prepare) — so the
+    compiled-program count is bounded by the product of the classes,
+    not by how many distinct fragment shapes the schema churns through.
+    """
+    _note_shape(
+        score_frags=len(planes),
+        score_rows=max(int(p.shape[0]) for p in planes),
+        score_slots=int(slots.shape[-1]),
+    )
     if srcs is None:
         return _score_planes_self_src(planes, slots, src_slots)
     return _score_planes_host_src(planes, slots, srcs)

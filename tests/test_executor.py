@@ -972,7 +972,10 @@ def test_warmup_prewarm_compiles_standard_shapes():
     per_expr = 2  # count + row at bucket 1
     if pmesh.default_slices_mesh() is not None:
         per_expr += 2 * 2  # mesh chunks (1, 2) x (total-count, row)
-    assert n == len(warmup._STANDARD_EXPRS) * per_expr
+    # + the fused TopN scorer's smallest bucket shapes (prewarm_topn:
+    # row classes x group classes).
+    topn = 2
+    assert n == len(warmup._STANDARD_EXPRS) * per_expr + topn
 
 
 def test_enable_compile_cache_idempotent():

@@ -1,13 +1,15 @@
 """CI smoke pass over bench.py: a tiny CPU-only run that asserts the
 JSON artifact parses and carries the coalescer's counters plus the
-``bsi`` tier (Range/Sum over integer bit-planes).
+``bsi`` tier (Range/Sum over integer bit-planes), the ``cold_restart``
+tier (time-to-first-answer under lazy staging), and the program-cache
+entries/bounds invariant.
 
 Not a performance measurement — a wiring check: the bench's executor
 tiers must produce one valid JSON line on stdout with the coalesce
 section (launches / occupancy / dispatches-per-query per concurrent
 tier) and the bsi tier's Gcols/s + ms/query figures, so a refactor
 cannot silently break the artifact the perf trajectory is built from.
-Run via ``make bench-smoke``; wired into CI as a non-blocking step.
+Run via ``make bench-smoke``; a BLOCKING CI step since PR 7.
 """
 
 from __future__ import annotations
@@ -86,13 +88,35 @@ def main() -> int:
                     file=sys.stderr,
                 )
                 return 1
+    cold = out.get("cold_restart")
+    if not isinstance(cold, dict):
+        print(f"FAIL: artifact missing cold_restart tier: {out}", file=sys.stderr)
+        return 1
+    for key in ("first_answer_ms", "staging_complete_ms", "staging",
+                "programs_compiled"):
+        if key not in cold:
+            print(f"FAIL: cold_restart missing {key!r}: {cold}", file=sys.stderr)
+            return 1
+    pc = out.get("program_cache")
+    if not isinstance(pc, dict) or "entries" not in pc or "bounds" not in pc:
+        print(f"FAIL: artifact missing program_cache: {out}", file=sys.stderr)
+        return 1
+    for fam, bound in pc["bounds"].items():
+        if pc["entries"].get(fam, 0) > bound:
+            print(
+                f"FAIL: program cache family {fam!r} exceeds its hard"
+                f" bound: {pc}",
+                file=sys.stderr,
+            )
+            return 1
     print(
         f"OK: metric={out['metric']} value={out['value']} {out['unit']};"
         f" coalesce launches={total['launches']}"
         f" queries={total['queries']}"
         f" mean_occupancy={total['mean_occupancy']};"
         f" bsi range {bsi['range']['gcols_s']} Gcols/s"
-        f" / sum {bsi['sum']['gcols_s']} Gcols/s"
+        f" / sum {bsi['sum']['gcols_s']} Gcols/s;"
+        f" cold restart first answer {cold['first_answer_ms']} ms"
     )
     return 0
 
