@@ -25,6 +25,17 @@ Layer map (mirrors SURVEY.md §1):
 
 __version__ = "0.1.0"
 
-from pilosa_tpu.ops.bitplane import SLICE_WIDTH
+import os as _os
+
+if _os.environ.get("PILOSA_LOCK_CHECK"):
+    # Runtime lock-order validation (analyze/runtime.py): wrap every
+    # lock the package creates so acquisition order observed while the
+    # suites run is checked against the static analyzer's graph.  Must
+    # install BEFORE any submodule creates its module-level locks.
+    from pilosa_tpu.analyze import runtime as _lock_check
+
+    _lock_check.install()
+
+from pilosa_tpu.ops.bitplane import SLICE_WIDTH  # noqa: E402
 
 __all__ = ["SLICE_WIDTH", "__version__"]

@@ -1,0 +1,48 @@
+"""pilosa_tpu.analyze — the concurrency & compile-hazard analyzer.
+
+The reference Pilosa leans on Go's toolchain for correctness: ``go
+vet`` plus the ``-race`` detector guard a 29-lock, many-goroutine core.
+This package is the Python/JAX rebuild's equivalent, purpose-built for
+THIS codebase's three recurring bug classes instead of generic style:
+
+* **lock-order** (:mod:`.locks`): discovers every
+  ``threading.Lock/RLock/Condition`` the package creates, builds the
+  interprocedural acquisition graph (``with`` nesting, ``acquire()``
+  calls, and calls made while a lock is held), reports cycles as
+  potential deadlocks, and flags blocking calls (socket I/O,
+  ``Future.result``, ``queue.get``, device transfers, ``time.sleep``)
+  made under a lock.
+* **compile-hazard** (:mod:`.compilehaz`): JAX-layer lints — dynamic
+  shapes reaching a jit entry point without the canonical pow2
+  bucketing (``bp.pow2_bucket`` / ``plan.slice_bucket``), f-string /
+  stringified values in compile keys, host<->device sync inside hot
+  loops, and ``functools.lru_cache`` on methods (leaks ``self``).
+* **resource-discipline** (:mod:`.resources`): pin leases, trace
+  spans, ChunkPipes, and deadline scopes acquired without a
+  guaranteeing ``with``/``finally``.
+
+Run as ``python -m pilosa_tpu.analyze`` (wired into ``make check`` and
+CI as a blocking gate).  Known-safe sites are DOCUMENTED, not silenced,
+in ``analyze.toml`` — every allowlist entry carries a reason and goes
+stale-visible when the code it matched disappears.
+
+The static lock graph is additionally proven against reality: with
+``PILOSA_LOCK_CHECK=1`` (:mod:`.runtime`) every lock the package
+creates is wrapped so acquisition order observed while the tier-1 and
+chaos suites run is checked for consistency with the static graph.
+"""
+
+from __future__ import annotations
+
+from pilosa_tpu.analyze.config import AnalyzeConfig, load_config, repo_root
+from pilosa_tpu.analyze.report import Finding, Report
+from pilosa_tpu.analyze.run import run_analysis
+
+__all__ = [
+    "AnalyzeConfig",
+    "Finding",
+    "Report",
+    "load_config",
+    "repo_root",
+    "run_analysis",
+]

@@ -1,0 +1,64 @@
+"""``python -m pilosa_tpu.analyze`` — the CI gate.
+
+Exit status 0 when every finding is covered by ``analyze.toml``;
+1 when any active finding remains (the gate fails CLOSED on new
+hazards).  ``--json`` writes the machine-readable report (published as
+a CI build artifact), ``--graph`` dumps the static lock-order graph.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from pilosa_tpu.analyze.config import load_config
+from pilosa_tpu.analyze.run import PASSES, run_analysis
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m pilosa_tpu.analyze",
+        description="concurrency & compile-hazard analyzer",
+    )
+    ap.add_argument(
+        "passes",
+        nargs="*",
+        default=[],
+        metavar="pass",
+        help=f"subset of passes to run: {', '.join(PASSES)} (default: all)",
+    )
+    ap.add_argument("--config", help="path to analyze.toml")
+    ap.add_argument("--json", dest="json_path", help="write JSON report")
+    ap.add_argument("--graph", help="write the static lock graph as JSON")
+    ap.add_argument(
+        "-q", "--quiet", action="store_true", help="summary line only"
+    )
+    args = ap.parse_args(argv)
+
+    cfg = load_config(args.config)
+    for p in args.passes:
+        if p not in PASSES:
+            ap.error(f"unknown pass {p!r} (choose from {', '.join(PASSES)})")
+    passes = tuple(args.passes) if args.passes else PASSES
+    if "locks" not in passes and args.graph:
+        passes = passes + ("locks",)
+    rep, graph = run_analysis(config=cfg, passes=passes)
+
+    if args.json_path:
+        with open(args.json_path, "w", encoding="utf-8") as fh:
+            fh.write(rep.to_json() + "\n")
+    if args.graph and graph is not None:
+        with open(args.graph, "w", encoding="utf-8") as fh:
+            json.dump(graph.to_dict(), fh, indent=2, sort_keys=True)
+            fh.write("\n")
+
+    text = rep.render_text()
+    if args.quiet:
+        text = text.splitlines()[-1]
+    print(text)
+    return rep.exit_code()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,253 @@
+"""Pass 2 — compile-hazard lint for the JAX layer.
+
+The recompile blowups PR 7 hand-hunted are mechanical: a jit entry
+point whose input shape (or static key) varies with schema/traffic
+mints a fresh ~326 ms XLA program per distinct value unless every
+shape-bearing component is bucketed through the canonical helpers
+(``bp.pow2_bucket`` / ``plan.slice_bucket`` / ``bp.pad_rows``).
+
+Rules:
+
+* ``jit-unbucketed-shape`` — a function (in a configured hot module)
+  that both builds a dynamically-shaped array (``concatenate`` /
+  ``stack`` / ``pad`` / ``zeros`` sized from ``.shape`` / ``len()``)
+  AND dispatches a compile entry point, without ever calling a bucket
+  helper.  Function granularity keeps it honest: cross-function flows
+  are out of scope (and covered by the program-cache bound gauges at
+  runtime).
+* ``jit-key-fstring`` — an f-string / ``str()`` / ``repr()`` inside an
+  argument to a compile entry point: stringified dynamic values make
+  unbounded compile keys.
+* ``host-sync-in-loop`` — ``.item()`` / ``jax.device_get`` /
+  ``block_until_ready`` / ``np.asarray`` on a device value inside a
+  ``for``/``while`` in a hot module: a per-iteration host<->device
+  round trip in exactly the paths the coalescer exists to batch.
+* ``lru-cache-method`` — ``functools.lru_cache``/``cache`` on a
+  method: the cache keys on ``self`` and keeps every instance alive.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from pilosa_tpu.analyze.report import Finding
+
+_DEFAULT_ENTRY_POINTS = {"compiled_batched", "compiled_total_count"}
+_DEFAULT_BUCKET_FNS = {
+    "pow2_bucket",
+    "slice_bucket",
+    "pad_rows",
+    "bucket_classes",
+}
+_BUILDERS = {"concatenate", "stack", "pad", "zeros", "ones", "full", "empty"}
+_SYNC_ATTRS = {"item", "block_until_ready", "device_get"}
+
+
+def _attr_name(func) -> str | None:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+class CompilePass:
+    def __init__(self, idx):
+        self.idx = idx
+        self.cfg = idx.config
+        self.entry_points = _DEFAULT_ENTRY_POINTS | set(
+            self.cfg.compile_entry_points
+        )
+        self.bucket_fns = _DEFAULT_BUCKET_FNS | set(self.cfg.bucket_fns)
+        self.findings: list[Finding] = []
+
+    def _is_hot(self, path: str) -> bool:
+        if not self.cfg.hot_modules:
+            return True
+        return any(
+            path == m or path.startswith(m.rstrip("/") + "/")
+            for m in self.cfg.hot_modules
+        )
+
+    def run(self) -> list[Finding]:
+        for fq, fi in self.idx.functions.items():
+            self._lru_cache_rule(fq, fi)
+            if self._is_hot(fi.path):
+                self._function_rules(fq, fi)
+        seen: set = set()
+        uniq = []
+        for f in self.findings:
+            if f.key in seen:
+                continue
+            seen.add(f.key)
+            uniq.append(f)
+        self.findings = uniq
+        return self.findings
+
+    # ------------------------------------------------------------------
+
+    def _lru_cache_rule(self, fq: str, fi) -> None:
+        if fi.class_qual is None:
+            return
+        node = fi.node
+        args = node.args.args
+        if not args or args[0].arg not in ("self", "cls"):
+            return
+        deco_names = set()
+        for d in node.decorator_list:
+            if isinstance(d, ast.Call):
+                d = d.func
+            n = _attr_name(d)
+            if n:
+                deco_names.add(n)
+        if deco_names & {"lru_cache", "cache"}:
+            if "staticmethod" in deco_names:
+                return
+            self.findings.append(
+                Finding(
+                    rule="lru-cache-method",
+                    path=fi.path,
+                    line=node.lineno,
+                    message=(
+                        f"lru_cache on method {fq}: the cache keys on "
+                        "self and keeps every instance (and its device "
+                        "arrays) alive — use a module-level cache keyed "
+                        "explicitly, or cache on an attribute"
+                    ),
+                    key=f"lru-cache-method:{fq}",
+                )
+            )
+
+    # ------------------------------------------------------------------
+
+    def _function_rules(self, fq: str, fi) -> None:
+        entry_calls: list[ast.Call] = []
+        builder_dynamic: list[ast.Call] = []
+        has_bucket_call = False
+        loop_depth_syncs: list[tuple] = []
+        device_vars: set[str] = set()
+
+        def is_entry(call: ast.Call) -> bool:
+            n = _attr_name(call.func)
+            return n in self.entry_points
+
+        def subtree_has_shape(node) -> bool:
+            for n in ast.walk(node):
+                if isinstance(n, ast.Attribute) and n.attr == "shape":
+                    return True
+                if (
+                    isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Name)
+                    and n.func.id == "len"
+                ):
+                    return True
+            return False
+
+        def scan(node, in_loop: bool) -> None:
+            nonlocal has_bucket_call
+            for child in ast.iter_child_nodes(node):
+                child_in_loop = in_loop or isinstance(
+                    node, (ast.For, ast.While)
+                )
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                if isinstance(child, ast.Call):
+                    n = _attr_name(child.func)
+                    if n in self.bucket_fns:
+                        has_bucket_call = True
+                    if is_entry(child):
+                        entry_calls.append(child)
+                    if n in _BUILDERS and subtree_has_shape(child):
+                        builder_dynamic.append(child)
+                    if child_in_loop and self._is_sync(child, device_vars):
+                        loop_depth_syncs.append((child, n))
+                if isinstance(child, ast.Assign) and isinstance(
+                    child.value, ast.Call
+                ):
+                    vn = _attr_name(child.value.func) or ""
+                    if vn in (
+                        "device_put",
+                        "device_get",
+                        "device_plane",
+                        "device_row",
+                    ) or vn in self.entry_points:
+                        for t in child.targets:
+                            if isinstance(t, ast.Name):
+                                device_vars.add(t.id)
+                scan(child, child_in_loop)
+
+        scan(fi.node, False)
+
+        if entry_calls and builder_dynamic and not has_bucket_call:
+            c = builder_dynamic[0]
+            self.findings.append(
+                Finding(
+                    rule="jit-unbucketed-shape",
+                    path=fi.path,
+                    line=c.lineno,
+                    message=(
+                        f"{fq} builds a dynamically-shaped array "
+                        f"({_attr_name(c.func)} sized from .shape/len) and "
+                        "dispatches a compile entry point without routing "
+                        "the size through pow2_bucket/slice_bucket/pad_rows "
+                        "— every distinct shape compiles a fresh program"
+                    ),
+                    key=f"jit-unbucketed-shape:{fq}",
+                )
+            )
+        for call in entry_calls:
+            for arg in list(call.args) + [k.value for k in call.keywords]:
+                for n in ast.walk(arg):
+                    bad = None
+                    if isinstance(n, ast.JoinedStr):
+                        bad = "f-string"
+                    elif (
+                        isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Name)
+                        and n.func.id in ("str", "repr")
+                    ):
+                        bad = n.func.id + "()"
+                    if bad:
+                        self.findings.append(
+                            Finding(
+                                rule="jit-key-fstring",
+                                path=fi.path,
+                                line=n.lineno,
+                                message=(
+                                    f"{fq} passes a {bad} into compile "
+                                    f"entry {_attr_name(call.func)} — "
+                                    "stringified dynamic values make "
+                                    "unbounded compile keys"
+                                ),
+                                key=f"jit-key-fstring:{fq}:{_attr_name(call.func)}",
+                            )
+                        )
+        for call, n in loop_depth_syncs:
+            self.findings.append(
+                Finding(
+                    rule="host-sync-in-loop",
+                    path=fi.path,
+                    line=call.lineno,
+                    message=(
+                        f"{fq}: {n or 'sync'} on a device value inside a "
+                        "loop — one host<->device round trip per iteration"
+                    ),
+                    key=f"host-sync-in-loop:{fq}:{n}",
+                    severity="warn",
+                )
+            )
+
+    def _is_sync(self, call: ast.Call, device_vars: set) -> bool:
+        f = call.func
+        n = _attr_name(f)
+        if n in ("item", "block_until_ready"):
+            return True
+        if n == "device_get":
+            return True
+        if n == "asarray" and isinstance(f, ast.Attribute):
+            # np.asarray(x) syncs only when x is a device value; flag
+            # just the locally-provable case to keep host-side numpy
+            # assembly loops quiet.
+            if call.args and isinstance(call.args[0], ast.Name):
+                return call.args[0].id in device_vars
+        return False

@@ -1,0 +1,157 @@
+"""analyze.toml — analyzer configuration and the documented allowlist.
+
+The file lives at the repository root next to ``pyproject.toml``.  Its
+``[[allow]]`` entries are the ONLY way to ship with a finding: each
+names a rule, an ``fnmatch`` pattern over the finding's stable key, and
+a mandatory human reason — the known-safe sites are documented, never
+silenced.  Entries that stop matching anything are reported as stale so
+the file cannot rot.
+
+Everything else in the file tunes resolution rather than suppressing
+output: interface groups (duck-typed receivers like ``.stats`` /
+``.tracer``), factory return types (``device.pool() -> PlanePool``),
+and declared dynamic call edges for callbacks the AST cannot follow
+(the residency pool's eviction hooks, a span's deferred ``__exit__``).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import os
+from dataclasses import dataclass, field
+
+try:  # Python 3.11+
+    import tomllib as _toml
+except ModuleNotFoundError:  # pragma: no cover - 3.10 container fallback
+    import tomli as _toml  # type: ignore[no-redef]
+
+
+@dataclass
+class AllowEntry:
+    rule: str
+    match: str
+    reason: str
+    hits: int = 0  # findings matched during this run (0 after = stale)
+
+    def matches(self, finding) -> bool:
+        if self.rule not in ("*", finding.rule):
+            return False
+        return fnmatch.fnmatchcase(finding.key, self.match)
+
+
+@dataclass
+class InterfaceGroup:
+    """Duck-typed receiver resolution: a call ``x.m(...)`` on an
+    unresolvable receiver resolves to every ``classes`` member defining
+    ``m`` when ``m`` is one of the group's method names."""
+
+    name: str
+    classes: list[str]
+    methods: list[str]
+
+
+@dataclass
+class CallEdge:
+    """A declared dynamic call edge the AST cannot see (stored
+    callbacks, context-manager exits)."""
+
+    src: str
+    dst: str
+    reason: str = ""
+
+
+@dataclass
+class AnalyzeConfig:
+    package: str = "pilosa_tpu"
+    exclude: list[str] = field(default_factory=list)
+    allow: list[AllowEntry] = field(default_factory=list)
+    groups: list[InterfaceGroup] = field(default_factory=list)
+    call_edges: list[CallEdge] = field(default_factory=list)
+    # function qualname -> class qualname it returns an instance of
+    returns: dict[str, str] = field(default_factory=dict)
+    # attribute name -> class qualnames (fallback when inference fails)
+    attr_types: dict[str, list[str]] = field(default_factory=dict)
+    blocking_calls: list[str] = field(default_factory=list)
+    hot_modules: list[str] = field(default_factory=list)
+    compile_entry_points: list[str] = field(default_factory=list)
+    bucket_fns: list[str] = field(default_factory=list)
+    scoped_resources: dict[str, str] = field(default_factory=dict)
+    path: str = ""
+
+    def allowed(self, finding) -> AllowEntry | None:
+        for entry in self.allow:
+            if entry.matches(finding):
+                entry.hits += 1
+                return entry
+        return None
+
+    def stale_allow_entries(self) -> list[AllowEntry]:
+        return [e for e in self.allow if e.hits == 0]
+
+
+def repo_root() -> str:
+    """The directory holding ``analyze.toml`` / ``pyproject.toml`` —
+    the parent of the installed package directory when running from a
+    source checkout, else the current working directory."""
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    root = os.path.dirname(here)
+    if os.path.exists(os.path.join(root, "analyze.toml")):
+        return root
+    return os.getcwd()
+
+
+def load_config(path: str | None = None) -> AnalyzeConfig:
+    """Load ``analyze.toml``; a missing file yields the built-in
+    defaults (empty allowlist)."""
+    if path is None:
+        path = os.path.join(repo_root(), "analyze.toml")
+    cfg = AnalyzeConfig(path=path)
+    if not os.path.exists(path):
+        return cfg
+    with open(path, "rb") as fh:
+        data = _toml.load(fh)
+
+    top = data.get("analyze", {})
+    cfg.package = top.get("package", cfg.package)
+    cfg.exclude = list(top.get("exclude", []))
+
+    locks = data.get("locks", {})
+    cfg.blocking_calls = list(locks.get("blocking-calls", []))
+    for g in locks.get("group", []):
+        cfg.groups.append(
+            InterfaceGroup(
+                name=g.get("name", ""),
+                classes=list(g.get("classes", [])),
+                methods=list(g.get("methods", [])),
+            )
+        )
+    for c in locks.get("call", []):
+        cfg.call_edges.append(
+            CallEdge(
+                src=c.get("from", ""),
+                dst=c.get("to", ""),
+                reason=c.get("reason", ""),
+            )
+        )
+    cfg.returns = dict(locks.get("returns", {}))
+    cfg.attr_types = {
+        k: list(v) for k, v in locks.get("attr-types", {}).items()
+    }
+
+    comp = data.get("compile", {})
+    cfg.hot_modules = list(comp.get("hot-modules", []))
+    cfg.compile_entry_points = list(comp.get("entry-points", []))
+    cfg.bucket_fns = list(comp.get("bucket-fns", []))
+
+    res = data.get("resources", {})
+    cfg.scoped_resources = dict(res.get("scoped", {}))
+
+    for a in data.get("allow", []):
+        cfg.allow.append(
+            AllowEntry(
+                rule=a.get("rule", "*"),
+                match=a.get("match", ""),
+                reason=a.get("reason", ""),
+            )
+        )
+    return cfg

@@ -1,0 +1,642 @@
+"""AST package index: modules, classes, functions, locks, resolution.
+
+One parse of the whole package feeds all three passes.  Resolution is
+deliberately best-effort — exact where the AST allows (self-methods,
+module functions, imports, locally-inferred instance types, configured
+factory returns) and duck-typed through configured interface groups
+where it does not (``.stats`` / ``.tracer`` receivers).  Unresolved
+calls resolve to nothing rather than to everything: the lock pass wants
+a graph that is complete over the package's REAL interactions (the
+runtime validation mode keeps it honest) without drowning in
+impossible edges.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+
+LOCK_FACTORIES = {"Lock": "Lock", "RLock": "RLock", "Condition": "Condition"}
+
+
+@dataclass
+class LockSite:
+    lock_id: str  # e.g. "pilosa_tpu.device.pool.PlanePool._mu"
+    path: str  # repo-relative, e.g. "pilosa_tpu/device/pool.py"
+    line: int  # line of the threading.X(...) call
+    kind: str  # "Lock" | "RLock" | "Condition"
+
+    @property
+    def reentrant(self) -> bool:
+        return self.kind == "RLock"
+
+
+@dataclass
+class FunctionInfo:
+    qualname: str  # "pilosa_tpu.exec.plan._ProgramCache.__call__"
+    modname: str
+    class_qual: str | None
+    node: object  # ast.FunctionDef | ast.AsyncFunctionDef
+    path: str
+
+
+@dataclass
+class ClassInfo:
+    qualname: str
+    modname: str
+    node: object
+    bases: list[str] = field(default_factory=list)  # resolved qualnames
+    methods: dict[str, FunctionInfo] = field(default_factory=dict)
+    # attr name -> candidate class qualnames (from self.X = Cls(...))
+    attr_types: dict[str, set] = field(default_factory=dict)
+    # container attr name -> element class qualnames (self.X[k] = <obj>)
+    elem_types: dict[str, set] = field(default_factory=dict)
+    # attr name -> lock_id (self.X = threading.Lock() / alias target)
+    lock_attrs: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class ModuleInfo:
+    modname: str  # dotted, e.g. "pilosa_tpu.exec.plan"
+    path: str  # repo-relative
+    tree: object
+    # local name -> dotted target (module, class, or function qualname)
+    imports: dict[str, str] = field(default_factory=dict)
+    # module-level lock name -> lock_id
+    lock_globals: dict[str, str] = field(default_factory=dict)
+    # module-level names bound to contextvars.ContextVar(...) — their
+    # .get() is a contextvar read, not a queue pop
+    ctxvars: set = field(default_factory=set)
+
+
+class PackageIndex:
+    """Parsed package + symbol tables + lock registry."""
+
+    def __init__(self, pkg_dir: str, package: str, config):
+        self.pkg_dir = pkg_dir
+        self.package = package
+        self.config = config
+        self.root = os.path.dirname(pkg_dir)
+        self.modules: dict[str, ModuleInfo] = {}
+        self.classes: dict[str, ClassInfo] = {}
+        self.functions: dict[str, FunctionInfo] = {}
+        self.locks: dict[str, LockSite] = {}
+        self.locks_by_loc: dict[tuple, str] = {}
+        # method name -> class qualnames defining it (for group lookup)
+        self.method_classes: dict[str, list[str]] = {}
+        # group method name -> candidate function qualnames
+        self.group_methods: dict[str, list[str]] = {}
+        self._load()
+        self._index_symbols()
+        self._index_groups()
+
+    # ------------------------------------------------------------------
+    # loading
+    # ------------------------------------------------------------------
+
+    def _load(self) -> None:
+        excl = set(self.config.exclude or [])
+        for dirpath, dirnames, filenames in os.walk(self.pkg_dir):
+            dirnames[:] = sorted(
+                d for d in dirnames if d != "__pycache__"
+            )
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                full = os.path.join(dirpath, fn)
+                rel = os.path.relpath(full, self.root)
+                if rel in excl or rel.replace(os.sep, "/") in excl:
+                    continue
+                mod = rel[: -len(".py")].replace(os.sep, ".")
+                if mod.endswith(".__init__"):
+                    mod = mod[: -len(".__init__")]
+                with open(full, "r", encoding="utf-8") as fh:
+                    src = fh.read()
+                tree = ast.parse(src, filename=rel)
+                self.modules[mod] = ModuleInfo(
+                    modname=mod, path=rel.replace(os.sep, "/"), tree=tree
+                )
+
+    # ------------------------------------------------------------------
+    # symbol tables
+    # ------------------------------------------------------------------
+
+    def _index_symbols(self) -> None:
+        for mi in self.modules.values():
+            self._index_imports(mi)
+        for mi in self.modules.values():
+            for node in mi.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    self._index_class(mi, node)
+                elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    q = f"{mi.modname}.{node.name}"
+                    self.functions[q] = FunctionInfo(
+                        q, mi.modname, None, node, mi.path
+                    )
+            self._discover_module_locks(mi)
+        # attr/elem types settle in two rounds (cross-class chains)
+        for _ in range(2):
+            for mi in self.modules.values():
+                for node in mi.tree.body:
+                    if isinstance(node, ast.ClassDef):
+                        ci = self.classes[f"{mi.modname}.{node.name}"]
+                        self._index_attr_types(mi, ci)
+        for mi in self.modules.values():
+            for node in mi.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    ci = self.classes[f"{mi.modname}.{node.name}"]
+                    self._discover_class_locks(mi, ci)
+
+    def _index_imports(self, mi: ModuleInfo) -> None:
+        for node in ast.walk(mi.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name.startswith(self.package):
+                        mi.imports[alias.asname or alias.name.split(".")[0]] = (
+                            alias.name
+                        )
+            elif isinstance(node, ast.ImportFrom):
+                if not node.module or not node.module.startswith(self.package):
+                    continue
+                for alias in node.names:
+                    mi.imports[alias.asname or alias.name] = (
+                        f"{node.module}.{alias.name}"
+                    )
+
+    def _index_class(self, mi: ModuleInfo, node: ast.ClassDef) -> None:
+        q = f"{mi.modname}.{node.name}"
+        ci = ClassInfo(qualname=q, modname=mi.modname, node=node)
+        for b in node.bases:
+            bq = self.resolve_symbol(mi, b)
+            if bq:
+                ci.bases.append(bq)
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fq = f"{q}.{item.name}"
+                fi = FunctionInfo(fq, mi.modname, q, item, mi.path)
+                ci.methods[item.name] = fi
+                self.functions[fq] = fi
+                self.method_classes.setdefault(item.name, []).append(q)
+        self.classes[q] = ci
+
+    def resolve_symbol(self, mi: ModuleInfo, node) -> str | None:
+        """Dotted name of a Name/Attribute expression, through this
+        module's package imports; None for anything external."""
+        if isinstance(node, ast.Name):
+            tgt = mi.imports.get(node.id)
+            if tgt:
+                return tgt
+            if f"{mi.modname}.{node.id}" in self.classes:
+                return f"{mi.modname}.{node.id}"
+            if f"{mi.modname}.{node.id}" in self.functions:
+                return f"{mi.modname}.{node.id}"
+            return None
+        if isinstance(node, ast.Attribute):
+            base = self.resolve_symbol(mi, node.value)
+            if base:
+                return f"{base}.{node.attr}"
+            return None
+        return None
+
+    def _annotation_class(self, mi, ann) -> str | None:
+        """Package class named by a return/arg annotation; unwraps
+        ``X | None`` and ``Optional[X]``."""
+        if ann is None:
+            return None
+        if isinstance(ann, ast.BinOp):  # X | None
+            left = self._annotation_class(mi, ann.left)
+            return left or self._annotation_class(mi, ann.right)
+        if isinstance(ann, ast.Subscript):  # Optional[X] / list[X]
+            return self._annotation_class(mi, ann.slice)
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            try:
+                ann = ast.parse(ann.value, mode="eval").body
+            except SyntaxError:
+                return None
+            return self._annotation_class(mi, ann)
+        sym = self.resolve_symbol(mi, ann)
+        if sym in self.classes:
+            return sym
+        return None
+
+    def _call_result_class(self, mi, call: ast.Call, var_types) -> str | None:
+        """Class qualname a call returns an instance of: direct class
+        instantiation, a configured factory return, or a resolvable
+        function whose return annotation names a package class."""
+        fq = self.resolve_symbol(mi, call.func)
+        if fq is None and isinstance(call.func, ast.Attribute):
+            # method call on an inferred receiver
+            for cand in self._receiver_classes(mi, call.func.value, var_types):
+                r = self.config.returns.get(f"{cand}.{call.func.attr}")
+                if r:
+                    return r
+                for c in self._mro(cand):
+                    ci = self.classes.get(c)
+                    if ci and call.func.attr in ci.methods:
+                        meth = ci.methods[call.func.attr]
+                        ann = self._annotation_class(
+                            self.modules[meth.modname], meth.node.returns
+                        )
+                        if ann:
+                            return ann
+                        break
+            return None
+        if fq in self.classes:
+            return fq
+        if fq:
+            r = self.config.returns.get(fq)
+            if r:
+                return r
+            fn = self.functions.get(fq)
+            if fn is not None:
+                return self._annotation_class(
+                    self.modules[fn.modname], fn.node.returns
+                )
+        return None
+
+    # unwrappers around an iterable that preserve the element type
+    _ITER_WRAPPERS = {"sorted", "list", "tuple", "reversed", "set", "iter"}
+
+    def _container_elem_types(self, mi, node, var_types) -> set:
+        """Element classes when ``node`` is a read from a typed
+        container attribute: self.X[k], self.X.get/pop(k),
+        self.X.values()/items() (iteration handled by callers)."""
+        attr = None
+        if isinstance(node, ast.Subscript):
+            attr = node.value
+        elif (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("get", "pop", "values", "items", "setdefault")
+        ):
+            attr = node.func.value
+        if (
+            isinstance(attr, ast.Attribute)
+            and isinstance(attr.value, ast.Name)
+            and attr.value.id == "self"
+        ):
+            cq = var_types.get("self<class>")
+            out: set = set()
+            if cq:
+                for c in self._mro(cq):
+                    ci = self.classes.get(c)
+                    if ci and attr.attr in ci.elem_types:
+                        out |= ci.elem_types[attr.attr]
+            return out
+        return set()
+
+    def expr_types(self, mi, node, var_types) -> set:
+        """Candidate classes an expression evaluates to."""
+        if isinstance(node, ast.Name):
+            if node.id == "self" and var_types.get("self<class>"):
+                return {var_types["self<class>"]}
+            v = var_types.get(node.id)
+            return set(v) if v else set()
+        if isinstance(node, ast.Call):
+            cls = self._call_result_class(mi, node, var_types)
+            if cls:
+                return {cls}
+            return self._container_elem_types(mi, node, var_types)
+        if isinstance(node, ast.Subscript):
+            return self._container_elem_types(mi, node, var_types)
+        if isinstance(node, ast.Attribute):
+            out: set = set()
+            if isinstance(node.value, ast.Name) and node.value.id == "self":
+                cq = var_types.get("self<class>")
+                if cq:
+                    for c in self._mro(cq):
+                        ci = self.classes.get(c)
+                        if ci and node.attr in ci.attr_types:
+                            out |= ci.attr_types[node.attr]
+            cfg = self.config.attr_types.get(node.attr)
+            if cfg:
+                out |= set(cfg)
+            return out
+        if isinstance(node, ast.BoolOp):  # x = given or Default()
+            out = set()
+            for v in node.values:
+                out |= self.expr_types(mi, v, var_types)
+            return out
+        if isinstance(node, ast.IfExp):
+            return self.expr_types(mi, node.body, var_types) | self.expr_types(
+                mi, node.orelse, var_types
+            )
+        return set()
+
+    def _iter_elem_types(self, mi, it, var_types) -> tuple[set, bool]:
+        """(element classes, is_items_pairs) for a ``for``/comprehension
+        iterable expression."""
+        while (
+            isinstance(it, ast.Call)
+            and isinstance(it.func, ast.Name)
+            and it.func.id in self._ITER_WRAPPERS
+            and it.args
+        ):
+            it = it.args[0]
+        if isinstance(it, ast.Call) and isinstance(it.func, ast.Attribute):
+            if it.func.attr in ("values", "items"):
+                elems = self._container_elem_types(mi, it, var_types)
+                return elems, it.func.attr == "items"
+        return set(), False
+
+    def infer_types(self, mi, class_qual, fnode) -> dict:
+        """Local-variable class inference for one function body: two
+        passes so chained assignments settle."""
+        vt: dict = {}
+        if class_qual:
+            vt["self<class>"] = class_qual
+        args = getattr(fnode, "args", None)
+        if args is not None:
+            for arg in list(args.args) + list(args.kwonlyargs):
+                cls = self._annotation_class(mi, arg.annotation)
+                if cls:
+                    vt.setdefault(arg.arg, set()).add(cls)
+        for _ in range(2):
+            for st in ast.walk(fnode):
+                if isinstance(st, ast.Assign) and len(st.targets) == 1:
+                    t = st.targets[0]
+                    if isinstance(t, ast.Name):
+                        ts = self.expr_types(mi, st.value, vt)
+                        if ts:
+                            vt.setdefault(t.id, set()).update(ts)
+                elif isinstance(st, (ast.For, ast.AsyncFor)):
+                    self._bind_loop_target(mi, st.target, st.iter, vt)
+                elif isinstance(
+                    st, (ast.ListComp, ast.SetComp, ast.GeneratorExp, ast.DictComp)
+                ):
+                    for gen in st.generators:
+                        self._bind_loop_target(mi, gen.target, gen.iter, vt)
+        return vt
+
+    def _bind_loop_target(self, mi, target, it, vt) -> None:
+        elems, is_items = self._iter_elem_types(mi, it, vt)
+        if not elems:
+            return
+        if is_items:
+            if (
+                isinstance(target, ast.Tuple)
+                and len(target.elts) == 2
+                and isinstance(target.elts[1], ast.Name)
+            ):
+                vt.setdefault(target.elts[1].id, set()).update(elems)
+        elif isinstance(target, ast.Name):
+            vt.setdefault(target.id, set()).update(elems)
+
+    def _index_attr_types(self, mi, ci: ClassInfo) -> None:
+        """Populate attr_types (self.X = <typed expr>) and elem_types
+        (self.X[k] = <typed expr>) from every method body."""
+        for meth in ci.methods.values():
+            vt = self.infer_types(mi, ci.qualname, meth.node)
+            for st in ast.walk(meth.node):
+                if not isinstance(st, ast.Assign) or len(st.targets) != 1:
+                    continue
+                t = st.targets[0]
+                ts = self.expr_types(mi, st.value, vt)
+                if not ts:
+                    continue
+                if (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                ):
+                    ci.attr_types.setdefault(t.attr, set()).update(ts)
+                elif (
+                    isinstance(t, ast.Subscript)
+                    and isinstance(t.value, ast.Attribute)
+                    and isinstance(t.value.value, ast.Name)
+                    and t.value.value.id == "self"
+                ):
+                    ci.elem_types.setdefault(t.value.attr, set()).update(ts)
+
+    def _receiver_classes(self, mi, node, var_types) -> list[str]:
+        """Candidate class qualnames for a call receiver expression."""
+        return sorted(self.expr_types(mi, node, var_types))
+
+    def _mro(self, cq: str) -> list[str]:
+        out, seen = [], set()
+        stack = [cq]
+        while stack:
+            c = stack.pop(0)
+            if c in seen or c not in self.classes:
+                continue
+            seen.add(c)
+            out.append(c)
+            stack.extend(self.classes[c].bases)
+        return out
+
+    # ------------------------------------------------------------------
+    # lock discovery
+    # ------------------------------------------------------------------
+
+    def _lock_factory_kind(self, mi, call: ast.Call) -> str | None:
+        """"Lock"/"RLock"/"Condition" when ``call`` is a threading
+        factory call, else None."""
+        f = call.func
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+            if f.value.id == "threading" and f.attr in LOCK_FACTORIES:
+                return f.attr
+        if isinstance(f, ast.Name) and f.id in LOCK_FACTORIES:
+            # from threading import Lock — not used in-tree, but cheap
+            for node in ast.walk(mi.tree):
+                if isinstance(node, ast.ImportFrom) and node.module == "threading":
+                    if any((a.asname or a.name) == f.id for a in node.names):
+                        return LOCK_FACTORIES[f.id]
+        return None
+
+    def _register_lock(self, lock_id: str, mi, call, kind: str) -> str:
+        site = LockSite(lock_id, mi.path, call.lineno, kind)
+        self.locks[lock_id] = site
+        self.locks_by_loc[(site.path, site.line)] = lock_id
+        return lock_id
+
+    def _discover_module_locks(self, mi: ModuleInfo) -> None:
+        for node in mi.tree.body:
+            tgt = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt = node.targets[0]
+                value = node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                tgt = node.target
+                value = node.value
+            else:
+                continue
+            if isinstance(tgt, ast.Name) and isinstance(value, ast.Call):
+                kind = self._lock_factory_kind(mi, value)
+                if kind:
+                    lid = self._register_lock(
+                        f"{mi.modname}.{tgt.id}", mi, value, kind
+                    )
+                    mi.lock_globals[tgt.id] = lid
+                fname = value.func
+                if (
+                    isinstance(fname, ast.Attribute)
+                    and fname.attr == "ContextVar"
+                ) or (
+                    isinstance(fname, ast.Name) and fname.id == "ContextVar"
+                ):
+                    mi.ctxvars.add(tgt.id)
+
+    def _discover_class_locks(self, mi: ModuleInfo, ci: ClassInfo) -> None:
+        for meth in ci.methods.values():
+            for st in ast.walk(meth.node):
+                if not isinstance(st, ast.Assign) or len(st.targets) != 1:
+                    continue
+                t = st.targets[0]
+                if not (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                    and isinstance(st.value, ast.Call)
+                ):
+                    continue
+                call = st.value
+                kind = self._lock_factory_kind(mi, call)
+                if not kind:
+                    continue
+                if kind == "Condition" and call.args:
+                    arg = call.args[0]
+                    # Condition(self._mu): pure alias of an existing lock
+                    if (
+                        isinstance(arg, ast.Attribute)
+                        and isinstance(arg.value, ast.Name)
+                        and arg.value.id == "self"
+                    ):
+                        tgt = self._class_lock_attr(ci.qualname, arg.attr)
+                        if tgt:
+                            ci.lock_attrs[t.attr] = tgt
+                            continue
+                    # Condition(threading.Lock()): the inner Lock IS the
+                    # lock; its creation site is this line.
+                lid = f"{ci.qualname}.{t.attr}"
+                self._register_lock(lid, mi, call, kind)
+                ci.lock_attrs[t.attr] = lid
+
+    def _class_lock_attr(self, class_qual: str, attr: str) -> str | None:
+        for c in self._mro(class_qual):
+            ci = self.classes.get(c)
+            if ci and attr in ci.lock_attrs:
+                return ci.lock_attrs[attr]
+        return None
+
+    # ------------------------------------------------------------------
+    # interface groups
+    # ------------------------------------------------------------------
+
+    def _index_groups(self) -> None:
+        for g in self.config.groups:
+            for cq in g.classes:
+                ci = self.classes.get(cq)
+                if ci is None:
+                    continue
+                names = g.methods or list(ci.methods)
+                for m in names:
+                    if m in ci.methods:
+                        self.group_methods.setdefault(m, []).append(
+                            ci.methods[m].qualname
+                        )
+
+    # ------------------------------------------------------------------
+    # call / lock-expression resolution (used by the passes)
+    # ------------------------------------------------------------------
+
+    def resolve_call(self, mi, class_qual, call: ast.Call, var_types) -> list[str]:
+        """Candidate function qualnames a call may invoke.  Empty when
+        unresolvable — the passes treat that as 'no effect' and lean on
+        config call-edges plus the runtime validator for coverage."""
+        f = call.func
+        # self.m(...) -> method on this class (or a base)
+        if (
+            isinstance(f, ast.Attribute)
+            and isinstance(f.value, ast.Name)
+            and f.value.id == "self"
+            and class_qual
+        ):
+            for c in self._mro(class_qual):
+                ci = self.classes.get(c)
+                if ci and f.attr in ci.methods:
+                    return [ci.methods[f.attr].qualname]
+            # fall through: self.<attr>.<m> handled below via receiver
+        # plain name: module function / imported function / class ctor
+        if isinstance(f, ast.Name):
+            sym = self.resolve_symbol(mi, f)
+            if sym in self.functions:
+                return [sym]
+            if sym in self.classes:
+                init = self.classes[sym].methods.get("__init__")
+                return [init.qualname] if init else []
+            return []
+        if not isinstance(f, ast.Attribute):
+            return []
+        # dotted module path: pkg.mod.func(...)
+        sym = self.resolve_symbol(mi, f)
+        if sym in self.functions:
+            return [sym]
+        if sym in self.classes:
+            init = self.classes[sym].methods.get("__init__")
+            return [init.qualname] if init else []
+        # receiver with an inferred / configured class
+        out: list[str] = []
+        for cand in self._receiver_classes(mi, f.value, var_types):
+            for c in self._mro(cand):
+                ci = self.classes.get(c)
+                if ci and f.attr in ci.methods:
+                    out.append(ci.methods[f.attr].qualname)
+                    break
+        if out:
+            return sorted(set(out))
+        # duck-typed interface group fallback
+        return list(self.group_methods.get(f.attr, []))
+
+    def resolve_lock_expr(self, mi, class_qual, node, local_locks) -> str | None:
+        """Lock id of an expression used as ``with <expr>`` or
+        ``<expr>.acquire()``; None when it isn't a known lock."""
+        if isinstance(node, ast.Name):
+            if node.id in local_locks:
+                return local_locks[node.id]
+            if node.id in mi.lock_globals:
+                return mi.lock_globals[node.id]
+            sym = mi.imports.get(node.id)
+            if sym and sym in self.locks:
+                return sym
+            return None
+        if isinstance(node, ast.Attribute):
+            if isinstance(node.value, ast.Name) and node.value.id == "self":
+                if class_qual:
+                    lid = self._class_lock_attr(class_qual, node.attr)
+                    if lid:
+                        return lid
+                return None
+            # two-level: self.store.lock — receiver class carries it
+            if (
+                isinstance(node.value, ast.Attribute)
+                and isinstance(node.value.value, ast.Name)
+                and node.value.value.id == "self"
+            ):
+                vt = {"self<class>": class_qual} if class_qual else {}
+                for cand in self._receiver_classes(mi, node.value, vt):
+                    lid = self._class_lock_attr(cand, node.attr)
+                    if lid:
+                        return lid
+            # module attr: mod.LOCK
+            sym = self.resolve_symbol(mi, node)
+            if sym and sym in self.locks:
+                return sym
+            return None
+        return None
+
+    def stats(self) -> dict:
+        return {
+            "files": len(self.modules),
+            "classes": len(self.classes),
+            "functions": len(self.functions),
+            "locks": len(self.locks),
+        }
+
+
+def build_index(config) -> PackageIndex:
+    import importlib
+
+    pkg = importlib.import_module(config.package)
+    pkg_dir = os.path.dirname(os.path.abspath(pkg.__file__))
+    return PackageIndex(pkg_dir, config.package, config)

@@ -1,0 +1,624 @@
+"""Pass 1 — interprocedural lock-order analysis.
+
+Per function the walker tracks the set of held locks through ``with``
+nesting and explicit ``acquire()``/``release()`` calls (including the
+pool's ``acquire(blocking=False)`` eviction-callback idiom, which
+yields NON-BLOCKING edges — they cannot deadlock, but cycles through
+them are still reported so the design stays documented in
+``analyze.toml`` rather than implicit).  A fixpoint over the call graph
+then summarizes, for every function, the locks it may transitively
+acquire and the blocking calls it may transitively reach, so an
+acquisition made three calls below a ``with`` still produces its edge.
+
+Lock identity is the CREATION SITE (``module.Class.attr``, a module
+global, or a function local) — all instances of a class share one node,
+the standard lock-order abstraction.  ``threading.Condition(self._mu)``
+aliases to the wrapped lock; a Condition's ``wait()`` under exactly its
+own lock is the one blocking call that is exempt (wait releases it).
+
+Findings:
+  * ``lock-cycle`` — a cycle in the acquisition graph (severity
+    ``error`` when every edge is blocking, ``warn`` when a
+    non-blocking edge breaks the deadlock).
+  * ``blocking-under-lock`` — socket I/O, ``Future.result``, bare
+    ``queue.get``, ``join``, ``wait``, ``time.sleep``, or a device
+    transfer reachable while a lock is held.
+  * ``self-deadlock`` — a non-reentrant Lock re-acquired (possibly
+    through calls) while already held.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from pilosa_tpu.analyze.report import Finding
+
+# Attribute names whose call is treated as blocking regardless of the
+# receiver (receiver-aware exemptions applied after).
+_BLOCKING_ATTRS = {
+    "result": "Future.result",
+    "wait": "wait",
+    "sleep": "sleep",
+    "block_until_ready": "block_until_ready",
+    "recv": "socket.recv",
+    "recvfrom": "socket.recvfrom",
+    "accept": "socket.accept",
+    "connect": "socket.connect",
+    "sendall": "socket.sendall",
+    "sendto": "socket.sendto",
+    "getresponse": "http.getresponse",
+    "urlopen": "urlopen",
+    "device_put": "jax.device_put",
+    "device_get": "jax.device_get",
+}
+_BLOCKING_NAMES = {
+    "sleep": "sleep",
+    "urlopen": "urlopen",
+    "wait": "futures.wait",
+    "as_completed": "futures.as_completed",
+}
+
+
+def _dotted(node) -> str:
+    """Best-effort dotted rendering of a call target for messages."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return f"{_dotted(node.value)}.{node.attr}"
+    if isinstance(node, ast.Call):
+        return f"{_dotted(node.func)}()"
+    return "<expr>"
+
+
+@dataclass(frozen=True)
+class Edge:
+    src: str
+    dst: str
+    nonblocking: bool
+    path: str
+    line: int
+    via: str  # human chain description
+
+
+@dataclass
+class _FuncFacts:
+    # (lock_id, nonblocking, line, held-snapshot tuple)
+    acquires: list = field(default_factory=list)
+    # (candidate qualnames tuple, held tuple, line, call text)
+    calls: list = field(default_factory=list)
+    # (desc, exempt_lock_or_None, held tuple, line)
+    blocking: list = field(default_factory=list)
+
+
+class LockGraph:
+    """The acquisition graph handed to reporting AND to the runtime
+    validator (analyze.runtime verifies observed edges against it)."""
+
+    def __init__(self):
+        self.edges: dict[tuple, Edge] = {}  # (src, dst) -> witness edge
+        self.lock_sites: dict[tuple, str] = {}  # (path, line) -> lock_id
+        self.locks: dict[str, object] = {}  # lock_id -> LockSite
+
+    def add(self, edge: Edge) -> None:
+        cur = self.edges.get((edge.src, edge.dst))
+        # A blocking witness outranks a non-blocking one.
+        if cur is None or (cur.nonblocking and not edge.nonblocking):
+            self.edges[(edge.src, edge.dst)] = edge
+
+    def has_path(self, src: str, dst: str) -> bool:
+        seen, stack = set(), [src]
+        while stack:
+            n = stack.pop()
+            if n == dst:
+                return True
+            if n in seen:
+                continue
+            seen.add(n)
+            stack.extend(b for (a, b) in self.edges if a == n)
+        return False
+
+    def to_dict(self) -> dict:
+        return {
+            "locks": {
+                lid: {"path": s.path, "line": s.line, "kind": s.kind}
+                for lid, s in sorted(self.locks.items())
+            },
+            "edges": [
+                {
+                    "src": e.src,
+                    "dst": e.dst,
+                    "nonblocking": e.nonblocking,
+                    "via": e.via,
+                    "where": f"{e.path}:{e.line}",
+                }
+                for e in sorted(
+                    self.edges.values(), key=lambda e: (e.src, e.dst)
+                )
+            ],
+        }
+
+
+class LockPass:
+    def __init__(self, idx):
+        self.idx = idx
+        self.cfg = idx.config
+        self.facts: dict[str, _FuncFacts] = {}
+        self.graph = LockGraph()
+        self.findings: list[Finding] = []
+        # summaries: qualname -> {lock_id: (nonblocking, chain)}
+        self.may_acquire: dict[str, dict] = {}
+        # qualname -> {key: (desc, exempt_lock, chain)}
+        self.may_block: dict[str, dict] = {}
+
+    # ------------------------------------------------------------------
+
+    def run(self):
+        for fq, fi in self.idx.functions.items():
+            self.facts[fq] = _Walker(self, fi).walk()
+        self._apply_config_edges()
+        self._fixpoint()
+        self._edges_and_findings()
+        self._cycles()
+        self.graph.lock_sites = dict(self.idx.locks_by_loc)
+        self.graph.locks = dict(self.idx.locks)
+        return self.findings, self.graph
+
+    def _apply_config_edges(self) -> None:
+        for ce in self.cfg.call_edges:
+            facts = self.facts.get(ce.src)
+            if facts is None:
+                continue
+            facts.calls.append(
+                ((ce.dst,), (), 0, f"<config: {ce.reason or ce.dst}>")
+            )
+
+    # ------------------------------------------------------------------
+    # interprocedural fixpoint
+    # ------------------------------------------------------------------
+
+    def _fixpoint(self) -> None:
+        acq = {fq: {} for fq in self.facts}
+        blk = {fq: {} for fq in self.facts}
+        for fq, facts in self.facts.items():
+            for lock, nb, line, _held in facts.acquires:
+                cur = acq[fq].get(lock)
+                if cur is None or (cur[0] and not nb):
+                    acq[fq][lock] = (nb, (f"{fq}:{line}",))
+            for desc, exempt, _held, line in facts.blocking:
+                blk[fq][(desc, exempt)] = (desc, exempt, (f"{fq}:{line}",))
+        changed = True
+        while changed:
+            changed = False
+            for fq, facts in self.facts.items():
+                for cands, _held, line, _txt in facts.calls:
+                    for g in cands:
+                        if g == fq:
+                            continue
+                        for lock, (nb, chain) in acq.get(g, {}).items():
+                            if len(chain) >= 8:
+                                continue
+                            cur = acq[fq].get(lock)
+                            if cur is None or (cur[0] and not nb):
+                                acq[fq][lock] = (
+                                    nb,
+                                    (f"{fq}:{line}",) + chain,
+                                )
+                                changed = True
+                        for key, (desc, exempt, chain) in blk.get(
+                            g, {}
+                        ).items():
+                            if len(chain) >= 8:
+                                continue
+                            if key not in blk[fq]:
+                                blk[fq][key] = (
+                                    desc,
+                                    exempt,
+                                    (f"{fq}:{line}",) + chain,
+                                )
+                                changed = True
+        self.may_acquire = acq
+        self.may_block = blk
+
+    # ------------------------------------------------------------------
+    # edges + findings
+    # ------------------------------------------------------------------
+
+    def _reentrant(self, lock: str) -> bool:
+        site = self.idx.locks.get(lock)
+        return bool(site and site.reentrant)
+
+    def _emit_edges(self, fi, held, lock, nb, line, via) -> None:
+        for h in held:
+            if h == lock:
+                if not nb and not self._reentrant(lock):
+                    self.findings.append(
+                        Finding(
+                            rule="self-deadlock",
+                            path=fi.path,
+                            line=line,
+                            message=(
+                                f"{fi.qualname} may re-acquire non-reentrant "
+                                f"{lock} while already holding it ({via})"
+                            ),
+                            key=f"self-deadlock:{fi.qualname}:{lock}",
+                        )
+                    )
+                continue
+            self.graph.add(Edge(h, lock, nb, fi.path, line, via))
+
+    def _edges_and_findings(self) -> None:
+        for fq, facts in self.facts.items():
+            fi = self.idx.functions[fq]
+            for lock, nb, line, held in facts.acquires:
+                self._emit_edges(fi, held, lock, nb, line, f"with in {fq}")
+            for cands, held, line, txt in facts.calls:
+                if not held:
+                    continue
+                for g in cands:
+                    if g == fq:
+                        continue
+                    for lock, (nb, chain) in self.may_acquire.get(
+                        g, {}
+                    ).items():
+                        self._emit_edges(
+                            fi, held, lock, nb, line,
+                            f"{fq} -> " + " -> ".join(chain),
+                        )
+                    for desc, exempt, chain in self.may_block.get(
+                        g, {}
+                    ).values():
+                        self._blocking_finding(
+                            fi, held, desc, exempt, line,
+                            via=" -> ".join(chain),
+                        )
+            for desc, exempt, held, line in facts.blocking:
+                if held:
+                    self._blocking_finding(fi, held, desc, exempt, line)
+
+    def _blocking_finding(self, fi, held, desc, exempt, line, via="") -> None:
+        locks = sorted(set(held))
+        if exempt is not None and locks == [exempt]:
+            return  # cv.wait under exactly its own lock
+        key = f"blocking-under-lock:{fi.qualname}:{'+'.join(locks)}:{desc}"
+        if any(f.key == key for f in self.findings):
+            return
+        msg = f"{desc} while holding {', '.join(locks)}"
+        if via:
+            msg += f" (via {via})"
+        self.findings.append(
+            Finding(
+                rule="blocking-under-lock",
+                path=fi.path,
+                line=line,
+                message=msg,
+                key=key,
+                severity="warn",
+            )
+        )
+
+    # ------------------------------------------------------------------
+    # cycles
+    # ------------------------------------------------------------------
+
+    def _cycles(self) -> None:
+        adj: dict[str, list[str]] = {}
+        for a, b in self.graph.edges:
+            adj.setdefault(a, []).append(b)
+        order = sorted(adj)
+        seen_cycles: set = set()
+        for start in order:
+            # DFS for simple cycles through `start` using only nodes
+            # >= start (Johnson-style dedup); graphs here are tiny.
+            stack = [(start, [start])]
+            while stack:
+                node, path = stack.pop()
+                for nxt in sorted(adj.get(node, [])):
+                    if nxt == start and len(path) > 0:
+                        cyc = tuple(path)
+                        canon = tuple(sorted(cyc))
+                        if canon in seen_cycles or len(cyc) < 2:
+                            continue
+                        seen_cycles.add(canon)
+                        self._cycle_finding(cyc)
+                    elif nxt > start and nxt not in path and len(path) < 6:
+                        stack.append((nxt, path + [nxt]))
+            if len(seen_cycles) > 100:
+                break
+
+    def _cycle_finding(self, cyc: tuple) -> None:
+        # rotate so the lexicographically-smallest lock leads: stable key
+        i = cyc.index(min(cyc))
+        cyc = cyc[i:] + cyc[:i]
+        edges = [
+            self.graph.edges[(cyc[j], cyc[(j + 1) % len(cyc)])]
+            for j in range(len(cyc))
+        ]
+        all_blocking = all(not e.nonblocking for e in edges)
+        chain = " -> ".join(cyc + (cyc[0],))
+        detail = "; ".join(
+            f"{e.src}->{e.dst}{' (non-blocking)' if e.nonblocking else ''} "
+            f"at {e.path}:{e.line}"
+            for e in edges
+        )
+        self.findings.append(
+            Finding(
+                rule="lock-cycle",
+                path=edges[0].path,
+                line=edges[0].line,
+                message=(
+                    ("potential deadlock: " if all_blocking else
+                     "lock-order cycle (broken by a non-blocking acquire): ")
+                    + chain + " — " + detail
+                ),
+                key="lock-cycle:" + "->".join(cyc),
+                severity="error" if all_blocking else "warn",
+            )
+        )
+
+
+class _Walker:
+    """Single-function walk: held-set tracking + local inference."""
+
+    def __init__(self, pass_: LockPass, fi):
+        self.p = pass_
+        self.idx = pass_.idx
+        self.fi = fi
+        self.mi = self.idx.modules[fi.modname]
+        self.facts = _FuncFacts()
+        self.var_types: dict[str, set] = {}
+        if fi.class_qual:
+            self.var_types["self<class>"] = fi.class_qual
+        self.local_locks: dict[str, str] = {}
+
+    # -- pre-pass ------------------------------------------------------
+
+    def _prepass(self) -> None:
+        node = self.fi.node
+        self.var_types = self.idx.infer_types(
+            self.mi, self.fi.class_qual, node
+        )
+        for st in ast.walk(node):
+            if not isinstance(st, ast.Assign) or len(st.targets) != 1:
+                continue
+            t = st.targets[0]
+            if not isinstance(t, ast.Name) or not isinstance(st.value, ast.Call):
+                continue
+            kind = self.idx._lock_factory_kind(self.mi, st.value)
+            if kind:
+                lid = f"{self.fi.qualname}.<{t.id}>"
+                self.idx._register_lock(lid, self.mi, st.value, kind)
+                self.local_locks[t.id] = lid
+
+    # -- walk ----------------------------------------------------------
+
+    def walk(self) -> _FuncFacts:
+        self._prepass()
+        self._body(self.fi.node.body, [])
+        return self.facts
+
+    def _body(self, stmts, held) -> None:
+        held = list(held)
+        for st in stmts:
+            self._stmt(st, held)
+
+    def _resolve_lock(self, expr) -> str | None:
+        lid = self.idx.resolve_lock_expr(
+            self.mi, self.fi.class_qual, expr, self.local_locks
+        )
+        return lid
+
+    def _acquire(self, lock, nb, line, held) -> None:
+        self.facts.acquires.append(
+            (lock, nb, line, tuple(h for h in held))
+        )
+        held.append(lock)
+
+    def _release(self, lock, held) -> None:
+        if lock in held:
+            held.reverse()
+            held.remove(lock)
+            held.reverse()
+
+    def _stmt(self, st, held) -> None:
+        if isinstance(st, ast.With):
+            pushed = []
+            for item in st.items:
+                ce = item.context_expr
+                lid = self._resolve_lock(ce)
+                if lid is not None:
+                    self._acquire(lid, False, ce.lineno, held)
+                    pushed.append(lid)
+                else:
+                    self._exprs(ce, held)
+            self._body(st.body, held)
+            for lid in reversed(pushed):
+                self._release(lid, held)
+            return
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # Closure: walk its body with a FRESH held set but the same
+            # local context, attributing its effects to the enclosing
+            # function — conservative for the worker-thread closures the
+            # gossip/prefetch layers use.
+            self._body(st.body, [])
+            return
+        if isinstance(st, ast.ClassDef):
+            return
+        if isinstance(st, ast.If):
+            # `if not X.acquire(blocking=False): return` — the guarded
+            # remainder of the function runs with X held non-blocking.
+            acq = self._acquire_in_test(st.test)
+            if acq is not None and self._body_escapes(st.body):
+                lock, nb, line = acq
+                self._acquire(lock, nb, line, held)
+                self._body(st.orelse, held)
+                return
+            self._exprs(st.test, held)
+            self._body(st.body, held)
+            self._body(st.orelse, held)
+            return
+        if isinstance(st, (ast.For, ast.AsyncFor)):
+            self._exprs(st.iter, held)
+            self._body(st.body, held)
+            self._body(st.orelse, held)
+            return
+        if isinstance(st, ast.While):
+            self._exprs(st.test, held)
+            self._body(st.body, held)
+            self._body(st.orelse, held)
+            return
+        if isinstance(st, ast.Try):
+            self._body(st.body, held)
+            for h in st.handlers:
+                self._body(h.body, held)
+            self._body(st.orelse, held)
+            self._body(st.finalbody, held)
+            return
+        if isinstance(st, ast.Expr) and isinstance(st.value, ast.Call):
+            call = st.value
+            f = call.func
+            if isinstance(f, ast.Attribute) and f.attr in ("acquire", "release"):
+                lid = self._resolve_lock(f.value)
+                if lid is not None:
+                    if f.attr == "acquire":
+                        self._acquire(
+                            lid, self._nonblocking(call), call.lineno, held
+                        )
+                    else:
+                        self._release(lid, held)
+                    return
+        # generic statement: scan expressions
+        for child in ast.iter_child_nodes(st):
+            self._exprs(child, held)
+
+    @staticmethod
+    def _body_escapes(body) -> bool:
+        return len(body) >= 1 and isinstance(
+            body[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break)
+        )
+
+    def _acquire_in_test(self, test):
+        """(lock, nonblocking, line) when the If test is
+        ``not X.acquire(...)`` / ``X.acquire(...)`` on a known lock."""
+        node = test
+        if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
+            node = node.operand
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "acquire"
+        ):
+            lid = self._resolve_lock(node.func.value)
+            if lid is not None:
+                return (lid, self._nonblocking(node), node.lineno)
+        return None
+
+    @staticmethod
+    def _nonblocking(call: ast.Call) -> bool:
+        for kw in call.keywords:
+            if kw.arg == "blocking" and isinstance(kw.value, ast.Constant):
+                return kw.value.value is False
+        if call.args and isinstance(call.args[0], ast.Constant):
+            return call.args[0].value is False
+        return False
+
+    # -- expressions ---------------------------------------------------
+
+    def _exprs(self, node, held) -> None:
+        if node is None:
+            return
+        for call in [
+            n for n in ast.walk(node) if isinstance(n, ast.Call)
+        ]:
+            self._handle_call(call, held)
+
+    def _handle_call(self, call: ast.Call, held) -> None:
+        if self.idx._lock_factory_kind(self.mi, call):
+            return
+        f = call.func
+        # mid-expression acquire/release on a known lock
+        if isinstance(f, ast.Attribute) and f.attr in ("acquire", "release"):
+            lid = self._resolve_lock(f.value)
+            if lid is not None:
+                if f.attr == "acquire":
+                    self._acquire(lid, self._nonblocking(call), call.lineno, held)
+                else:
+                    self._release(lid, held)
+                return
+        self._check_blocking(call, held)
+        cands = self.idx.resolve_call(
+            self.mi, self.fi.class_qual, call, self.var_types
+        )
+        if cands:
+            self.facts.calls.append(
+                (tuple(cands), tuple(held), call.lineno, _dotted(f))
+            )
+
+    def _check_blocking(self, call: ast.Call, held) -> None:
+        f = call.func
+        desc = None
+        exempt = None
+        if isinstance(f, ast.Attribute):
+            attr = f.attr
+            if attr == "join":
+                if not self._looks_like_thread_join(call):
+                    return
+                desc = "thread.join"
+            elif attr == "get":
+                # bare .get() — queue.get; dict.get always passes a key
+                if call.args or any(k.arg != "timeout" for k in call.keywords):
+                    return
+                if (
+                    isinstance(f.value, ast.Name)
+                    and f.value.id in self.mi.ctxvars
+                ):
+                    return  # ContextVar.get() — a read, not a pop
+                desc = "queue.get"
+            elif attr == "connect":
+                # sqlite3.connect opens a database file, not a socket
+                if isinstance(f.value, ast.Name) and f.value.id == "sqlite3":
+                    return
+                desc = _BLOCKING_ATTRS[attr]
+            elif attr in _BLOCKING_ATTRS:
+                if isinstance(f.value, ast.Constant):
+                    return
+                desc = _BLOCKING_ATTRS[attr]
+                if attr == "wait":
+                    lid = self._resolve_lock(f.value)
+                    if lid is not None:
+                        desc = f"Condition.wait({lid})"
+                        exempt = lid
+        elif isinstance(f, ast.Name) and f.id in _BLOCKING_NAMES:
+            if self.idx.resolve_symbol(self.mi, f) is not None:
+                return  # package-local function named wait/sleep
+            desc = _BLOCKING_NAMES[f.id]
+        if desc is None:
+            txt = _dotted(f)
+            for pat in self.cfg_blocking():
+                if txt == pat or txt.endswith("." + pat):
+                    desc = pat
+                    break
+        if desc is None:
+            return
+        self.facts.blocking.append(
+            (desc, exempt, tuple(held), call.lineno)
+        )
+
+    def cfg_blocking(self):
+        return self.p.cfg.blocking_calls
+
+    @staticmethod
+    def _looks_like_thread_join(call: ast.Call) -> bool:
+        recv = call.func.value
+        if isinstance(recv, ast.Constant):
+            return False  # "sep".join(...)
+        if isinstance(recv, ast.Attribute) and recv.attr == "path":
+            return False  # os.path.join
+        if len(call.args) > 1:
+            return False
+        if call.args and not isinstance(call.args[0], (ast.Constant, ast.Name)):
+            return False
+        if len(call.args) == 1 and isinstance(call.args[0], ast.Constant):
+            if not isinstance(call.args[0].value, (int, float)):
+                return False
+        return True

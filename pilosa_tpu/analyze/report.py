@@ -1,0 +1,101 @@
+"""Findings and the analyzer report (text + JSON artifact)."""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Finding:
+    """One analyzer hit.
+
+    ``key`` is the STABLE fingerprint allowlist entries match against —
+    built from qualified names and rule details, never line numbers, so
+    an unrelated edit above a documented site cannot un-document it.
+    """
+
+    rule: str
+    path: str
+    line: int
+    message: str
+    key: str
+    severity: str = "error"  # "error" | "warn"
+    allowed_by: str = ""  # reason text of the matching allowlist entry
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}"
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "severity": self.severity,
+            "message": self.message,
+            "key": self.key,
+            "allowed": bool(self.allowed_by),
+            "allowed_by": self.allowed_by,
+        }
+
+
+@dataclass
+class Report:
+    findings: list[Finding] = field(default_factory=list)
+    stale_allow: list[str] = field(default_factory=list)
+    stats: dict = field(default_factory=dict)
+    elapsed_s: float = 0.0
+
+    @property
+    def active(self) -> list[Finding]:
+        """Findings NOT covered by the allowlist — these fail the gate."""
+        return [f for f in self.findings if not f.allowed_by]
+
+    @property
+    def allowed(self) -> list[Finding]:
+        return [f for f in self.findings if f.allowed_by]
+
+    def exit_code(self) -> int:
+        return 1 if self.active else 0
+
+    def to_dict(self) -> dict:
+        return {
+            "findings": [f.to_dict() for f in self.findings],
+            "active": len(self.active),
+            "allowed": len(self.allowed),
+            "stale_allow": self.stale_allow,
+            "stats": self.stats,
+            "elapsed_s": round(self.elapsed_s, 3),
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2, sort_keys=True)
+
+    def render_text(self) -> str:
+        out: list[str] = []
+        by_rule: dict[str, list[Finding]] = {}
+        for f in self.active:
+            by_rule.setdefault(f.rule, []).append(f)
+        for rule in sorted(by_rule):
+            out.append(f"[{rule}]")
+            for f in sorted(by_rule[rule], key=lambda f: (f.path, f.line)):
+                out.append(f"  {f.location()}: {f.message}")
+                out.append(f"    key: {f.key}")
+        if self.allowed:
+            out.append(f"-- {len(self.allowed)} finding(s) documented in analyze.toml:")
+            for f in sorted(self.allowed, key=lambda f: (f.rule, f.path, f.line)):
+                reason = f.allowed_by.split(". ")[0].split(": ")[0]
+                out.append(
+                    f"  [{f.rule}] {f.location()}: allowed — {reason}"
+                )
+        for stale in self.stale_allow:
+            out.append(f"-- STALE allowlist entry (matched nothing): {stale}")
+        s = self.stats
+        out.append(
+            f"analyze: {s.get('files', 0)} files, {s.get('locks', 0)} locks, "
+            f"{s.get('edges', 0)} lock-order edges "
+            f"({s.get('nonblocking_edges', 0)} non-blocking); "
+            f"{len(self.active)} active finding(s), {len(self.allowed)} "
+            f"allowed; {self.elapsed_s:.2f}s"
+        )
+        return "\n".join(out)

@@ -1,0 +1,156 @@
+"""Pass 3 — resource discipline: scoped objects need a guaranteed exit.
+
+Pin leases (``pool.pinned(...)``), trace spans (``tracer.span(...)``),
+deadline scopes, and ChunkPipes hold something real — a pinned HBM
+entry, an open trace, a contextvar token, a bounded buffer a reader
+blocks on.  Each must be used as a ``with`` (or have its ``close`` /
+``release`` guaranteed by a ``finally``), or escape to an owner that
+does.  An acquisition whose cleanup rides the happy path leaks exactly
+when a query fails — the moment the lease mattered.
+
+Detection per call to a configured factory:
+  * ``with F(...)``                         -> ok
+  * ``return F(...)`` / ``yield F(...)``    -> ok (escapes to caller)
+  * ``self.x = F(...)`` / container store   -> ok (owner manages it)
+  * ``x = F(...)`` later used as ``with x`` -> ok
+  * ``x = F(...)`` with ``x.close()/release()/unpin()/finish()`` inside
+    some ``finally``                        -> ok
+  * ``x = F(...)`` passed to another call   -> ok (escapes)
+  * anything else                           -> ``leaked-scope`` finding
+"""
+
+from __future__ import annotations
+
+import ast
+
+from pilosa_tpu.analyze.report import Finding
+
+# factory attr/name -> human description; extended by analyze.toml
+# [resources.scoped] entries.
+_DEFAULT_SCOPED = {
+    "pinned": "pin lease",
+    "span": "trace span",
+    "start_trace": "trace root",
+    "deadline_scope": "deadline scope",
+    "ChunkPipe": "chunk pipe",
+}
+_RELEASERS = {"close", "release", "unpin", "finish", "__exit__", "abort"}
+
+
+def _call_name(call: ast.Call) -> str | None:
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+class ResourcePass:
+    def __init__(self, idx):
+        self.idx = idx
+        self.scoped = dict(_DEFAULT_SCOPED)
+        self.scoped.update(self.idx.config.scoped_resources)
+        self.findings: list[Finding] = []
+
+    def run(self) -> list[Finding]:
+        for fq, fi in self.idx.functions.items():
+            self._check_function(fq, fi)
+        return self.findings
+
+    def _check_function(self, fq: str, fi) -> None:
+        node = fi.node
+        parent: dict = {}
+        for p in ast.walk(node):
+            for c in ast.iter_child_nodes(p):
+                parent[c] = p
+
+        # names with a releaser called inside ANY finally/With-exit in
+        # this function, and names later used as a with-context
+        released: set[str] = set()
+        withed: set[str] = set()
+        for st in ast.walk(node):
+            if isinstance(st, ast.Try):
+                for fin_st in st.finalbody:
+                    for c in ast.walk(fin_st):
+                        if (
+                            isinstance(c, ast.Call)
+                            and isinstance(c.func, ast.Attribute)
+                            and c.func.attr in _RELEASERS
+                            and isinstance(c.func.value, ast.Name)
+                        ):
+                            released.add(c.func.value.id)
+            if isinstance(st, ast.With):
+                for item in st.items:
+                    ce = item.context_expr
+                    if isinstance(ce, ast.Name):
+                        withed.add(ce.id)
+                    # contextlib.closing(x) / ExitStack().enter_context(x)
+                    if isinstance(ce, ast.Call):
+                        for a in ce.args:
+                            if isinstance(a, ast.Name):
+                                withed.add(a.id)
+
+        for call in ast.walk(node):
+            if not isinstance(call, ast.Call):
+                continue
+            name = _call_name(call)
+            if name not in self.scoped:
+                continue
+            ctx = parent.get(call)
+            # with F(...):  — direct scope
+            if isinstance(ctx, ast.withitem):
+                continue
+            # return/yield F(...) — escapes to the caller
+            if isinstance(ctx, (ast.Return, ast.Yield, ast.YieldFrom)):
+                continue
+            # argument to another call — escapes
+            if isinstance(ctx, ast.Call) and call in ctx.args:
+                continue
+            if isinstance(ctx, ast.Assign):
+                tgt = ctx.targets[0] if len(ctx.targets) == 1 else None
+                # self.x = F(...) or container[k] = F(...): owner manages
+                if isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                    continue
+                if isinstance(tgt, ast.Name):
+                    if tgt.id in withed or tgt.id in released:
+                        continue
+                    # stored then returned / passed on?
+                    if self._escapes(node, tgt.id):
+                        continue
+            self.findings.append(
+                Finding(
+                    rule="leaked-scope",
+                    path=fi.path,
+                    line=call.lineno,
+                    message=(
+                        f"{fq}: {self.scoped[name]} from {name}(...) is "
+                        "not guaranteed release — use `with`, or release "
+                        "in a `finally`"
+                    ),
+                    key=f"leaked-scope:{fq}:{name}",
+                )
+            )
+        # dedup identical keys (a helper called twice)
+        seen: set = set()
+        uniq = []
+        for f in self.findings:
+            if f.key in seen:
+                continue
+            seen.add(f.key)
+            uniq.append(f)
+        self.findings = uniq
+
+    @staticmethod
+    def _escapes(func_node, var: str) -> bool:
+        for n in ast.walk(func_node):
+            if isinstance(n, ast.Return) and n.value is not None:
+                for c in ast.walk(n.value):
+                    if isinstance(c, ast.Name) and c.id == var:
+                        return True
+            if isinstance(n, ast.Call):
+                for a in list(n.args) + [k.value for k in n.keywords]:
+                    for c in ast.walk(a):
+                        if isinstance(c, ast.Name) and c.id == var:
+                            return True
+        return False

@@ -1,0 +1,59 @@
+"""Orchestration: build the index, run the passes, apply the allowlist."""
+
+from __future__ import annotations
+
+import time
+
+from pilosa_tpu.analyze.compilehaz import CompilePass
+from pilosa_tpu.analyze.config import AnalyzeConfig, load_config
+from pilosa_tpu.analyze.index import build_index
+from pilosa_tpu.analyze.locks import LockPass
+from pilosa_tpu.analyze.report import Report
+from pilosa_tpu.analyze.resources import ResourcePass
+
+PASSES = ("locks", "compile", "resources")
+
+
+def run_analysis(
+    config: AnalyzeConfig | None = None,
+    passes=PASSES,
+    index=None,
+):
+    """Run the selected passes; returns ``(Report, LockGraph | None)``."""
+    t0 = time.monotonic()
+    cfg = config or load_config()
+    idx = index or build_index(cfg)
+    findings = []
+    graph = None
+    if "locks" in passes:
+        lock_findings, graph = LockPass(idx).run()
+        findings.extend(lock_findings)
+    if "compile" in passes:
+        findings.extend(CompilePass(idx).run())
+    if "resources" in passes:
+        findings.extend(ResourcePass(idx).run())
+
+    # stable order + allowlist
+    findings.sort(key=lambda f: (f.rule, f.path, f.line, f.key))
+    for f in findings:
+        entry = cfg.allowed(f)
+        if entry is not None:
+            f.allowed_by = entry.reason or entry.match
+    rep = Report(findings=findings)
+    rep.stale_allow = [
+        f"[{e.rule}] {e.match}" for e in cfg.stale_allow_entries()
+    ]
+    rep.stats = idx.stats()
+    if graph is not None:
+        rep.stats["edges"] = len(graph.edges)
+        rep.stats["nonblocking_edges"] = sum(
+            1 for e in graph.edges.values() if e.nonblocking
+        )
+    rep.elapsed_s = time.monotonic() - t0
+    return rep, graph
+
+
+def static_lock_graph(config: AnalyzeConfig | None = None):
+    """Just the lock graph — the runtime validator's reference."""
+    _, graph = run_analysis(config=config, passes=("locks",))
+    return graph

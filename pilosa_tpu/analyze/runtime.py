@@ -1,0 +1,287 @@
+"""PILOSA_LOCK_CHECK=1 — runtime validation of the static lock graph.
+
+The static analyzer is only trustworthy if reality agrees with it, so
+this module wraps every ``threading.Lock/RLock/Condition`` the PACKAGE
+creates (foreign creations — stdlib, jax — pass through untouched) and
+records, per acquisition, the ordered pairs (held-lock -> new-lock)
+observed across all threads.  A lock's runtime identity is its
+CREATION SITE (file, line), which is exactly how the static pass
+registers it — so :func:`verify` can check that every observed
+acquisition order is present in the static graph's transitive closure.
+A disagreement means the analyzer missed an interaction (fix the
+resolution or declare the callback edge in analyze.toml) — the suites
+running green under this mode is what makes the static report
+evidence, not opinion.
+
+Install happens from ``pilosa_tpu/__init__`` BEFORE any submodule
+import, so module-level locks are wrapped too.  Overhead per
+acquisition is one thread-local list append plus, for never-seen
+pairs, one set insert — measured noise on the tier-1 suite.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+
+ENV = "PILOSA_LOCK_CHECK"
+
+_real_lock = threading.Lock
+_real_rlock = threading.RLock
+_real_condition = threading.Condition
+
+_installed = False
+_pkg_dir: str | None = None
+
+# (src_site, dst_site, nonblocking) -> count; guarded by _edges_mu.
+# Sites are (relpath, line).
+_edges: dict = {}
+_edges_mu = _real_lock()
+# every wrapped-lock creation site seen at runtime
+_created: set = set()
+
+_tls = threading.local()
+
+
+def enabled() -> bool:
+    return bool(os.environ.get(ENV))
+
+
+def _held() -> list:
+    h = getattr(_tls, "held", None)
+    if h is None:
+        h = _tls.held = []
+    return h
+
+
+def _relpath(filename: str) -> str:
+    assert _pkg_dir is not None
+    rel = os.path.relpath(filename, os.path.dirname(_pkg_dir))
+    return rel.replace(os.sep, "/")
+
+
+def _note_acquire(site, nonblocking: bool) -> None:
+    held = _held()
+    if site not in held:
+        new_edges = [
+            (h, site, nonblocking) for h in dict.fromkeys(held) if h != site
+        ]
+        if new_edges:
+            with _edges_mu:
+                for e in new_edges:
+                    _edges[e] = _edges.get(e, 0) + 1
+    held.append(site)
+
+
+def _note_release(site) -> None:
+    held = _held()
+    for i in range(len(held) - 1, -1, -1):
+        if held[i] == site:
+            del held[i]
+            return
+
+
+class _CheckedLock:
+    """Order-recording wrapper around one Lock/RLock instance."""
+
+    __slots__ = ("_inner", "site")
+
+    def __init__(self, inner, site):
+        self._inner = inner
+        self.site = site
+
+    def acquire(self, blocking=True, timeout=-1):
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            _note_acquire(self.site, not blocking)
+        return ok
+
+    def release(self):
+        self._inner.release()
+        _note_release(self.site)
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+    def __repr__(self):
+        return f"<checked {self._inner!r} @ {self.site[0]}:{self.site[1]}>"
+
+
+class _CheckedRLock(_CheckedLock):
+    """Adds the RLock protocol Condition relies on; the save/restore
+    hooks keep the held-stack honest across ``Condition.wait``."""
+
+    __slots__ = ()
+
+    def _is_owned(self):
+        return self._inner._is_owned()
+
+    def _release_save(self):
+        state = self._inner._release_save()
+        # fully released: drop every occurrence of this site
+        held = _held()
+        n = held.count(self.site)
+        for _ in range(n):
+            _note_release(self.site)
+        return (state, n)
+
+    def _acquire_restore(self, saved):
+        state, n = saved
+        self._inner._acquire_restore(state)
+        for _ in range(n):
+            _note_acquire(self.site, False)
+
+    def _at_fork_reinit(self):  # pragma: no cover - fork safety
+        self._inner._at_fork_reinit()
+        _tls.held = []
+
+
+def _caller_site():
+    """(relpath, line) of the package frame creating a lock; None when
+    the creator is outside the package (leave those locks alone)."""
+    f = sys._getframe(2)
+    filename = f.f_code.co_filename
+    if _pkg_dir is None or not filename.startswith(_pkg_dir + os.sep):
+        return None
+    return (_relpath(filename), f.f_lineno)
+
+
+def _make_lock():
+    site = _caller_site()
+    inner = _real_lock()
+    if site is None:
+        return inner
+    _created.add(site)
+    return _CheckedLock(inner, site)
+
+
+def _make_rlock():
+    site = _caller_site()
+    inner = _real_rlock()
+    if site is None:
+        return inner
+    _created.add(site)
+    return _CheckedRLock(inner, site)
+
+
+def _make_condition(lock=None):
+    site = _caller_site()
+    if lock is None and site is not None:
+        # Condition() creates its lock HERE: give it this site so the
+        # static registry (which keys the Condition call) matches.
+        _created.add(site)
+        lock = _CheckedRLock(_real_rlock(), site)
+    return _real_condition(lock)
+
+
+def install() -> None:
+    """Patch the threading lock factories (idempotent).  Must run
+    before the package's submodules create their module-level locks."""
+    global _installed, _pkg_dir
+    if _installed:
+        return
+    _pkg_dir = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    threading.Lock = _make_lock
+    threading.RLock = _make_rlock
+    threading.Condition = _make_condition
+    _installed = True
+
+
+def observed_edges() -> dict:
+    with _edges_mu:
+        return dict(_edges)
+
+
+def observed_sites() -> set:
+    return set(_created)
+
+
+def reset() -> None:
+    """Drop observations (unit tests)."""
+    with _edges_mu:
+        _edges.clear()
+
+
+def _match_site(site, lock_sites: dict) -> str | None:
+    """Map a runtime creation site to a static lock id; tolerates a
+    couple of lines of drift for multi-line factory calls."""
+    lid = lock_sites.get(site)
+    if lid is not None:
+        return lid
+    path, line = site
+    best = None
+    for (p, ln), cand in lock_sites.items():
+        if p == path and abs(ln - line) <= 3:
+            if best is not None:
+                return None  # ambiguous
+            best = cand
+    return best
+
+
+def verify(graph=None, config=None, edges=None, sites=None) -> list[str]:
+    """Compare observations against the static graph; returns human-
+    readable disagreements (empty = consistent).  ``edges``/``sites``
+    override the live observations (unit tests)."""
+    if graph is None:
+        from pilosa_tpu.analyze.run import static_lock_graph
+
+        graph = static_lock_graph(config)
+    if edges is None:
+        edges = observed_edges()
+    if sites is None:
+        sites = observed_sites()
+    problems: list[str] = []
+    site_to_id: dict = {}
+    for site in sites:
+        lid = _match_site(site, graph.lock_sites)
+        if lid is None:
+            problems.append(
+                f"lock created at {site[0]}:{site[1]} was never "
+                "discovered by the static pass"
+            )
+        else:
+            site_to_id[site] = lid
+    # transitive closure over static edges (order consistency, not
+    # just direct adjacency: A->C observed while the code path goes
+    # A->B->C is still the same order)
+    for (src, dst, nb), count in sorted(edges.items()):
+        a = site_to_id.get(src)
+        b = site_to_id.get(dst)
+        if a is None or b is None:
+            continue  # unknown-site problem already reported
+        if a == b:
+            continue
+        if not graph.has_path(a, b):
+            problems.append(
+                f"observed acquisition order {a} -> {b}"
+                f"{' (non-blocking)' if nb else ''} x{count} "
+                f"(locks at {src[0]}:{src[1]} -> {dst[0]}:{dst[1]}) "
+                "has no path in the static lock graph — the analyzer "
+                "missed an interaction; fix resolution or declare the "
+                "call edge in analyze.toml"
+            )
+    return problems
+
+
+def report() -> str:
+    edges = observed_edges()
+    lines = [
+        f"lock-check: {len(observed_sites())} wrapped locks, "
+        f"{len(edges)} distinct ordered pairs observed"
+    ]
+    for (src, dst, nb), count in sorted(edges.items()):
+        lines.append(
+            f"  {src[0]}:{src[1]} -> {dst[0]}:{dst[1]}"
+            f"{' (non-blocking)' if nb else ''} x{count}"
+        )
+    return "\n".join(lines)

@@ -306,11 +306,11 @@ def _import_value_reader(client, args, f) -> None:
         try:
             col_id = int(record[0])
         except ValueError:
-            raise CommandError(f"invalid column id on row {rnum}: {record[0]!r}")
+            raise CommandError(f"invalid column id on row {rnum}: {record[0]!r}") from None
         try:
             value = int(record[1])
         except ValueError:
-            raise CommandError(f"invalid value on row {rnum}: {record[1]!r}")
+            raise CommandError(f"invalid value on row {rnum}: {record[1]!r}") from None
         buf.append((col_id, value))
         if len(buf) >= args.buffer_size:
             _flush_values(client, args, buf)
@@ -423,11 +423,11 @@ def _import_reader(client, args, f) -> None:
         try:
             row_id = int(record[0])
         except ValueError:
-            raise CommandError(f"invalid row id on row {rnum}: {record[0]!r}")
+            raise CommandError(f"invalid row id on row {rnum}: {record[0]!r}") from None
         try:
             col_id = int(record[1])
         except ValueError:
-            raise CommandError(f"invalid column id on row {rnum}: {record[1]!r}")
+            raise CommandError(f"invalid column id on row {rnum}: {record[1]!r}") from None
         ts = 0
         if len(record) > 2 and record[2]:
             try:
@@ -435,7 +435,7 @@ def _import_reader(client, args, f) -> None:
             except ValueError:
                 raise CommandError(
                     f"invalid timestamp on row {rnum}: {record[2]!r}"
-                )
+                ) from None
             # wire carries unix nanoseconds (reference: ctl/import.go:157)
             ts = int(dt.replace(tzinfo=timezone.utc).timestamp() * 1e9)
         buf.append((row_id, col_id, ts))
@@ -625,7 +625,7 @@ def run_sort(args) -> int:
     try:
         rows.sort(key=lambda r: (int(r[1]) // SLICE_WIDTH, int(r[0]), int(r[1])))
     except (ValueError, IndexError) as e:
-        raise CommandError(f"bad csv row: {e}")
+        raise CommandError(f"bad csv row: {e}") from e
     w = csv.writer(sys.stdout)
     w.writerows(rows)
     return 0

@@ -21,7 +21,8 @@ from __future__ import annotations
 import time
 from collections import OrderedDict
 from dataclasses import dataclass
-from typing import Iterable, Protocol
+from collections.abc import Iterable
+from typing import Protocol
 
 from pilosa_tpu.obs.stats import NopStatsClient
 

@@ -14,7 +14,7 @@ from __future__ import annotations
 import os
 import sys
 import threading
-from typing import Callable
+from collections.abc import Callable
 
 from pilosa_tpu.core import cache as cache_mod
 from pilosa_tpu.core.fragment import Fragment
@@ -123,6 +123,7 @@ class View:
 
     def create_fragment_if_not_exists(self, slice_i: int) -> Fragment:
         """reference: view.go:218-250"""
+        notify = False
         with self._mu:
             frag = self._fragments.get(slice_i)
             if frag is not None:
@@ -132,12 +133,18 @@ class View:
             frag = self._new_fragment(slice_i)
             frag.open()
             self._fragments[slice_i] = frag
-            if (grew or first) and self.on_create_slice is not None:
-                # (index, view name, slice) — the view name tells the
-                # server whether the new slice is inverse-oriented
-                # (reference: view.go:236-241 CreateSliceMessage).
-                self.on_create_slice(self.index, self.name, slice_i)
-            return frag
+            notify = (grew or first) and self.on_create_slice is not None
+        # OUTSIDE the view lock: the callback crosses into the net
+        # layer (the server's gossip CreateSliceMessage broadcast —
+        # socket I/O and the gossip mutex must not run under a core
+        # data lock).  Found by PILOSA_LOCK_CHECK against the static
+        # graph in PR 8; same rule as Fragment.close's listeners.
+        if notify:
+            # (index, view name, slice) — the view name tells the
+            # server whether the new slice is inverse-oriented
+            # (reference: view.go:236-241 CreateSliceMessage).
+            self.on_create_slice(self.index, self.name, slice_i)
+        return frag
 
     # --- writes (reference: view.go:262-279) ---
 

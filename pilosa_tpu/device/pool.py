@@ -41,7 +41,7 @@ import os
 import threading
 from collections import OrderedDict
 from dataclasses import dataclass, field
-from typing import Callable
+from collections.abc import Callable
 
 from pilosa_tpu.obs import trace
 from pilosa_tpu.obs.stats import NopStatsClient
@@ -144,7 +144,8 @@ class PlanePool:
         return self._detect_budget()
 
     def _detect_budget(self) -> int:
-        if self._detected is None:
+        detected = self._detected
+        if detected is None:
             limit = 0
             try:
                 import jax
@@ -155,8 +156,8 @@ class PlanePool:
                     limit = int(mem["bytes_limit"] * DEFAULT_BUDGET_FRACTION)
             except Exception:  # noqa: BLE001 — detection is best-effort
                 limit = 0
-            self._detected = limit
-        return self._detected
+            self._detected = detected = limit
+        return detected
 
     # ------------------------------------------------------------------
     # tenant lifecycle
@@ -176,6 +177,11 @@ class PlanePool:
         failure call :meth:`remove`.  Re-admission preserves pins."""
         budget = self.budget_bytes()
         need = {d: int(n) for d, n in bytes_by_device.items() if n}
+        # Stats emission happens AFTER the critical section: a stats
+        # backend (UDP sendto, tag formatting) must never extend the
+        # pool lock's hold time — this is the hottest query-path lock.
+        n_ev = n_skip = 0
+        over_budget = False
         with self._mu:
             old = self._entries.pop(key, None)
             pins = 0
@@ -186,11 +192,10 @@ class PlanePool:
                 self._resident.get(d, 0) + n > budget for d, n in need.items()
             ):
                 with self.tracer.span("evict", trigger=category) as sp:
-                    n_ev = self._evict_for_locked(need, budget, key)
+                    n_ev, n_skip = self._evict_for_locked(need, budget, key)
                     sp.annotate(evicted=n_ev)
                 if n_ev:
                     self._evictions += n_ev
-                    self.stats.count("device.evictions", n_ev)
             ent = _Entry(
                 key=key,
                 bytes_by_device=need,
@@ -208,8 +213,15 @@ class PlanePool:
                 # their owners were busy): correctness beats the budget,
                 # but the breach is counted, never silent.
                 self._over_budget += 1
-                self.stats.count("device.overBudget")
-            self._publish_locked(need)
+                over_budget = True
+            gauges = self._gauges_locked(need)
+        if n_ev:
+            self.stats.count("device.evictions", n_ev)
+        if n_skip:
+            self.stats.count("device.evictSkipped", n_skip)
+        if over_budget:
+            self.stats.count("device.overBudget")
+        self._publish(gauges)
 
     def touch(self, key: tuple) -> None:
         with self._mu:
@@ -220,6 +232,7 @@ class PlanePool:
         """Update an entry's bytes in place (e.g. the sparse-row cache
         shrinking) without changing its LRU position or running
         admission eviction."""
+        gauges = []
         with self._mu:
             ent = self._entries.get(key)
             if ent is None:
@@ -229,14 +242,17 @@ class PlanePool:
                 d: int(n) for d, n in bytes_by_device.items() if n
             }
             self._credit(ent)
-            self._publish_locked(ent.bytes_by_device)
+            gauges = self._gauges_locked(ent.bytes_by_device)
+        self._publish(gauges)
 
     def remove(self, key: tuple) -> None:
+        gauges = []
         with self._mu:
             ent = self._entries.pop(key, None)
             if ent is not None:
                 self._debit(ent)
-                self._publish_locked(ent.bytes_by_device)
+                gauges = self._gauges_locked(ent.bytes_by_device)
+        self._publish(gauges)
 
     def contains(self, key: tuple) -> bool:
         with self._mu:
@@ -295,8 +311,11 @@ class PlanePool:
     # eviction (callers hold _mu)
     # ------------------------------------------------------------------
 
-    def _evict_for_locked(self, need: dict, budget: int, exclude_key) -> int:
+    def _evict_for_locked(self, need: dict, budget: int, exclude_key) -> tuple:
+        """Returns ``(evicted, skipped)`` counts; the caller emits the
+        stats for both outside the lock."""
         evicted = 0
+        skipped = 0
         for k in list(self._entries.keys()):
             if all(
                 self._resident.get(d, 0) + n <= budget
@@ -324,8 +343,8 @@ class PlanePool:
                 evicted += 1
             else:
                 self._evict_skipped += 1
-                self.stats.count("device.evictSkipped")
-        return evicted
+                skipped += 1
+        return evicted, skipped
 
     # ------------------------------------------------------------------
     # accounting (callers hold _mu)
@@ -353,20 +372,32 @@ class PlanePool:
         )
 
     def _dev_stat(self, dev):
+        # Called outside _mu (stats must not extend the critical
+        # section); a racing create stores two equivalent children and
+        # the last write wins — benign.
         c = self._dev_stats.get(dev)
         if c is None:
             c = self.stats.with_tags(f"device:{_device_label(dev)}")
             self._dev_stats[dev] = c
         return c
 
-    def _publish_locked(self, devices) -> None:
-        for d in devices:
-            self._dev_stat(d).gauge(
-                "device.residentBytes", float(self._resident.get(d, 0))
-            )
-        self.stats.gauge(
-            "device.cacheBytes", float(self._cat_bytes.get("cache", 0))
+    def _gauges_locked(self, devices) -> list:
+        """Snapshot the gauge values for ``devices`` under ``_mu``; the
+        caller publishes via :meth:`_publish` AFTER releasing it (a
+        stats backend must never extend the pool's critical section)."""
+        out = [
+            (d, "device.residentBytes", float(self._resident.get(d, 0)))
+            for d in devices
+        ]
+        out.append(
+            (None, "device.cacheBytes", float(self._cat_bytes.get("cache", 0)))
         )
+        return out
+
+    def _publish(self, gauges) -> None:
+        for dev, name, value in gauges:
+            client = self.stats if dev is None else self._dev_stat(dev)
+            client.gauge(name, value)
 
     # ------------------------------------------------------------------
     # prefetch bookkeeping (incremented by device/prefetch.py)

@@ -394,9 +394,13 @@ class Executor:
 
     def _evict_batch_key(self, key: tuple) -> bool:
         """Pool eviction hook for a batch-cache entry.  Non-blocking:
-        the insert path holds ``_batch_mu`` while it calls into the
-        pool, so a blocking acquire here could deadlock — skipping a
-        busy cache is always safe."""
+        the pool invokes this under ITS lock while request threads
+        hold ``_batch_mu`` around cache reads/inserts (pool tenancy
+        itself is registered outside ``_batch_mu`` — see
+        _cached_batch_build), so a blocking acquire here could still
+        deadlock through that interleaving — skipping a busy cache is
+        always safe.  The lock-order analyzer (pilosa_tpu/analyze)
+        tracks this as a non-blocking edge."""
         if not self._batch_mu.acquire(blocking=False):
             return False
         try:
@@ -2300,7 +2304,7 @@ class Executor:
             try:
                 timestamp = datetime.strptime(ts, TIME_FORMAT)
             except ValueError:
-                raise ExecutorError(f"invalid date: {ts}")
+                raise ExecutorError(f"invalid date: {ts}") from None
 
         return self._write_views(
             index, c, opt, view, f,
@@ -2668,4 +2672,4 @@ def _time_arg(c: Call, key: str) -> datetime:
     try:
         return datetime.strptime(v, TIME_FORMAT)
     except ValueError:
-        raise ExecutorError(f"cannot parse Range() {key} time")
+        raise ExecutorError(f"cannot parse Range() {key} time") from None

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import threading
 from collections import OrderedDict, namedtuple
+from collections.abc import Callable
 
 import jax
 import jax.numpy as jnp
@@ -253,7 +254,7 @@ def _make_fn(expr: tuple, reduce: str):
     return fn
 
 
-def compiled_batched(expr: tuple, reduce: str):
+def compiled_batched(expr: tuple, reduce: str) -> "_Program":
     """One jitted program per (tree shape, reduce kind), vmapped over a
     leading slice axis — input uint32[n_slices, n_leaves, 32768].  All of
     a node's local slices evaluate in ONE device program (the TPU-shaped
@@ -278,7 +279,7 @@ def compiled_batched(expr: tuple, reduce: str):
 MAX_ONDEVICE_COUNT_PARTIALS = 1 << 15
 
 
-def compiled_total_count(expr: tuple, mesh=None):
+def compiled_total_count(expr: tuple, mesh=None) -> "_Program":
     """Count(tree) reduced to one replicated int32[2] = (hi, lo) limb
     pair on-device; total = (hi << 16) + lo, recombined by the caller
     (recombine_count_limbs).  ``mesh=None`` compiles the single-device
@@ -368,7 +369,7 @@ class _ProgramCache:
     its values).  Eviction past ``maxsize`` drops the oldest wrapper
     (and with it, its compiled executables)."""
 
-    def __init__(self, builder, family: str, maxsize: int = 512):
+    def __init__(self, builder: Callable, family: str, maxsize: int = 512):
         self._builder = builder
         self._family = family
         self._maxsize = maxsize

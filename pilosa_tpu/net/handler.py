@@ -45,7 +45,8 @@ import traceback
 import urllib.parse
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Callable, Iterable
+from collections.abc import Callable, Iterable
+from typing import Any
 
 import numpy as np
 
@@ -850,13 +851,13 @@ class Handler:
             try:
                 slices = [int(s) for s in req.query["slices"].split(",")]
             except ValueError:
-                raise ValueError("invalid slice argument")
+                raise ValueError("invalid slice argument") from None
         quantum = "YMDH"
         if req.query.get("time_granularity"):
             try:
                 quantum = tq.parse_time_quantum(req.query["time_granularity"])
             except ValueError:
-                raise ValueError("invalid time granularity")
+                raise ValueError("invalid time granularity") from None
         return {
             "query": req.body.decode(),
             "slices": slices,

@@ -39,7 +39,9 @@ import http.client
 import random
 import threading
 import time
+from collections.abc import Callable
 from contextlib import contextmanager
+from typing import Any
 
 # Header carrying the REMAINING deadline budget in milliseconds at send
 # time.  The receiver restarts the clock on receipt.
@@ -206,7 +208,11 @@ class RetryPolicy:
         self.stats = stats or NopStatsClient()
         self._rng = random.Random(seed)
 
-    def call(self, fn, retryable=TRANSPORT_ERRORS):
+    def call(
+        self,
+        fn: Callable[[], Any],
+        retryable: tuple[type[BaseException], ...] = TRANSPORT_ERRORS,
+    ) -> Any:
         """Run ``fn()`` with up to ``attempts`` tries.  Only
         ``retryable`` exceptions retry; everything else (including
         DeadlineExceeded and BreakerOpenError) propagates at once."""

@@ -71,20 +71,29 @@ class NopStatsClient:
         pass
 
 
+class _ExpvarStore:
+    """The shared mutable state behind one Expvar client family
+    (``with_tags`` children share their parent's store).  A real class
+    rather than a dict so the lock is a named attribute the
+    concurrency analyzer (pilosa_tpu/analyze) can track."""
+
+    __slots__ = ("lock", "counts", "gauges", "sets", "histograms")
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.counts = defaultdict(int)
+        self.gauges: dict = {}
+        self.sets: dict = {}
+        self.histograms = defaultdict(list)
+
+
 class ExpvarStatsClient:
     """In-memory counters/gauges keyed by tag-qualified names, readable
     as one JSON snapshot from /debug/vars (reference: stats.go:78-150)."""
 
-    def __init__(self, _store=None, _tags: list[str] | None = None):
-        if _store is None:
-            _store = {
-                "lock": threading.Lock(),
-                "counts": defaultdict(int),
-                "gauges": {},
-                "sets": {},
-                "histograms": defaultdict(list),
-            }
-        self._store = _store
+    def __init__(self, _store: _ExpvarStore | None = None,
+                 _tags: list[str] | None = None):
+        self._store = _store if _store is not None else _ExpvarStore()
         self._tags = _tags or []
 
     def _key(self, name: str, tags: list[str] | None = None) -> str:
@@ -102,27 +111,27 @@ class ExpvarStatsClient:
         )
 
     def count(self, name: str, value: int = 1) -> None:
-        with self._store["lock"]:
-            self._store["counts"][self._key(name)] += value
+        with self._store.lock:
+            self._store.counts[self._key(name)] += value
 
     def count_with_custom_tags(self, name: str, value: int, tags: list[str]) -> None:
-        with self._store["lock"]:
-            self._store["counts"][self._key(name, tags)] += value
+        with self._store.lock:
+            self._store.counts[self._key(name, tags)] += value
 
     def gauge(self, name: str, value: float) -> None:
-        with self._store["lock"]:
-            self._store["gauges"][self._key(name)] = value
+        with self._store.lock:
+            self._store.gauges[self._key(name)] = value
 
     def histogram(self, name: str, value: float) -> None:
-        with self._store["lock"]:
-            h = self._store["histograms"][self._key(name)]
+        with self._store.lock:
+            h = self._store.histograms[self._key(name)]
             h.append(value)
             if len(h) > 4096:  # bound memory
                 del h[: len(h) - 4096]
 
     def set(self, name: str, value: str) -> None:
-        with self._store["lock"]:
-            self._store["sets"][self._key(name)] = value
+        with self._store.lock:
+            self._store.sets[self._key(name)] = value
 
     def timing(self, name: str, value: float) -> None:
         self.histogram(name, value)
@@ -132,14 +141,14 @@ class ExpvarStatsClient:
 
     def snapshot(self) -> dict:
         """For /debug/vars (and the /metrics Prometheus rendering)."""
-        with self._store["lock"]:
+        with self._store.lock:
             out: dict = {
-                "counts": dict(self._store["counts"]),
-                "gauges": dict(self._store["gauges"]),
-                "sets": dict(self._store["sets"]),
+                "counts": dict(self._store.counts),
+                "gauges": dict(self._store.gauges),
+                "sets": dict(self._store.sets),
             }
             hists = {}
-            for k, values in self._store["histograms"].items():
+            for k, values in self._store.histograms.items():
                 if not values:
                     continue
                 s = sorted(values)

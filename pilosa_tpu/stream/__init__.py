@@ -28,7 +28,8 @@ so net, cli, sync, and core can all ride it without cycles.
 
 from __future__ import annotations
 
-from typing import Iterable, Iterator, TypeVar
+from collections.abc import Iterable, Iterator
+from typing import TypeVar
 
 # One knob for every streaming path: response re-chunking, pipe chunk
 # assembly, and client-side reads all default to this size.  Configured

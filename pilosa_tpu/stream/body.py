@@ -9,7 +9,7 @@ abandoned response (client disconnect) releases producer resources.
 
 from __future__ import annotations
 
-from typing import Iterable, Iterator
+from collections.abc import Iterable, Iterator
 
 
 def rechunk(chunks: Iterable[bytes], chunk_bytes: int) -> Iterator[bytes]:

@@ -80,7 +80,7 @@ class ChunkedBodyReader:
         try:
             size = int(size_s, 16)
         except ValueError:
-            raise ValueError(f"invalid chunk size: {size_s[:32]!r}")
+            raise ValueError(f"invalid chunk size: {size_s[:32]!r}") from None
         if size == 0:
             # Trailer section: read through the blank line.
             while True:

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import http.client
 import time
-from typing import Callable, Iterator
+from collections.abc import Callable, Iterator
 
 # Transient transport failures worth a fresh dial; HTTP-status errors
 # (our ClientError) are NOT retried — the server answered.

@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import threading
 from collections import deque
-from typing import Callable, Iterator
+from collections.abc import Callable, Iterator
 
 
 class PipeAbortedError(RuntimeError):

@@ -230,7 +230,7 @@ class FragmentSyncer:
                 # caches, op-log) — reference: fragment.go:1465-1492.
                 # Batched so a badly diverged block never assembles one
                 # huge request (or trips max-writes-per-request).
-                def _lines():
+                def _lines(set_ps=set_ps, clear_ps=clear_ps):
                     for r, c in zip(set_ps.row_ids, set_ps.column_ids):
                         yield (
                             f'SetBit(frame="{f.frame}", rowID={r},'

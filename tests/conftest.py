@@ -33,6 +33,32 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_sessionfinish(session, exitstatus):
+    """PILOSA_LOCK_CHECK=1: after the suite, assert every lock
+    acquisition order observed at runtime is consistent with the static
+    lock graph (pilosa_tpu/analyze) — the analyzer is proven against
+    reality on every instrumented run, not just committed."""
+    if not os.environ.get("PILOSA_LOCK_CHECK"):
+        return
+    from pilosa_tpu.analyze import runtime as lock_check
+
+    problems = lock_check.verify()
+    rep = session.config.pluginmanager.get_plugin("terminalreporter")
+    lines = [lock_check.report().splitlines()[0]]
+    if problems:
+        lines.append("lock-check: STATIC/RUNTIME DISAGREEMENT")
+        lines.extend("  " + p for p in problems)
+        session.exitstatus = 1
+    else:
+        lines.append("lock-check: runtime acquisition order consistent "
+                     "with the static lock graph")
+    for ln in lines:
+        if rep is not None:
+            rep.write_line(ln)
+        else:
+            print(ln)
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)

@@ -1,0 +1,546 @@
+"""The analyzer analyzed: each pass must catch its seeded fixture bug,
+the live codebase must be clean modulo the documented allowlist, and
+the runtime lock-check wrapper must record orders and flag graphs that
+disagree.
+
+Fixture snippets are written into a throwaway ``fixpkg`` package and
+indexed directly — no import of the fixture code ever happens (the
+analyzer is purely syntactic), so fixtures are free to reference
+modules that don't exist.
+"""
+
+import textwrap
+
+from pilosa_tpu.analyze import AnalyzeConfig, load_config, run_analysis
+from pilosa_tpu.analyze import runtime as rt
+from pilosa_tpu.analyze.config import AllowEntry
+from pilosa_tpu.analyze.index import PackageIndex
+from pilosa_tpu.analyze.locks import LockGraph
+
+
+def analyze_snippet(tmp_path, source, config=None, passes=("locks", "compile", "resources")):
+    pkg = tmp_path / "fixpkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "mod.py").write_text(textwrap.dedent(source))
+    cfg = config or AnalyzeConfig(package="fixpkg")
+    idx = PackageIndex(str(pkg), "fixpkg", cfg)
+    return run_analysis(config=cfg, passes=passes, index=idx)
+
+
+def keys(rep, rule=None):
+    return [f.key for f in rep.findings if rule is None or f.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# pass 1: lock order
+# ---------------------------------------------------------------------------
+
+
+def test_lock_cycle_detected(tmp_path):
+    rep, graph = analyze_snippet(
+        tmp_path,
+        """
+        import threading
+
+        A = threading.Lock()
+        B = threading.Lock()
+
+        def f():
+            with A:
+                with B:
+                    pass
+
+        def g():
+            with B:
+                with A:
+                    pass
+        """,
+    )
+    cycles = [f for f in rep.findings if f.rule == "lock-cycle"]
+    assert len(cycles) == 1
+    assert cycles[0].severity == "error"  # every edge blocking
+    assert "fixpkg.mod.A" in cycles[0].key and "fixpkg.mod.B" in cycles[0].key
+    assert ("fixpkg.mod.A", "fixpkg.mod.B") in graph.edges
+    assert ("fixpkg.mod.B", "fixpkg.mod.A") in graph.edges
+
+
+def test_interprocedural_cycle_detected(tmp_path):
+    rep, graph = analyze_snippet(
+        tmp_path,
+        """
+        import threading
+
+        A = threading.Lock()
+        B = threading.Lock()
+
+        def helper_b():
+            with B:
+                pass
+
+        def helper_a():
+            with A:
+                pass
+
+        def f():
+            with A:
+                helper_b()
+
+        def g():
+            with B:
+                helper_a()
+        """,
+    )
+    assert len([f for f in rep.findings if f.rule == "lock-cycle"]) == 1
+
+
+def test_nonblocking_edge_downgrades_cycle(tmp_path):
+    rep, _ = analyze_snippet(
+        tmp_path,
+        """
+        import threading
+
+        A = threading.Lock()
+        B = threading.Lock()
+
+        def f():
+            with A:
+                with B:
+                    pass
+
+        def g():
+            with B:
+                if not A.acquire(blocking=False):
+                    return
+                try:
+                    pass
+                finally:
+                    A.release()
+        """,
+    )
+    cycles = [f for f in rep.findings if f.rule == "lock-cycle"]
+    assert len(cycles) == 1
+    assert cycles[0].severity == "warn"
+    assert "non-blocking" in cycles[0].message
+
+
+def test_blocking_call_under_lock(tmp_path):
+    rep, _ = analyze_snippet(
+        tmp_path,
+        """
+        import threading
+        import time
+
+        L = threading.Lock()
+
+        def f():
+            with L:
+                time.sleep(1)
+        """,
+    )
+    ks = keys(rep, "blocking-under-lock")
+    assert len(ks) == 1
+    assert "sleep" in ks[0] and "fixpkg.mod.L" in ks[0]
+
+
+def test_blocking_call_reached_through_helper(tmp_path):
+    rep, _ = analyze_snippet(
+        tmp_path,
+        """
+        import threading
+
+        L = threading.Lock()
+
+        def slow(fut):
+            return fut.result(timeout=5)
+
+        def f(fut):
+            with L:
+                slow(fut)
+        """,
+    )
+    assert any("Future.result" in k for k in keys(rep, "blocking-under-lock"))
+
+
+def test_condition_wait_under_own_lock_is_exempt(tmp_path):
+    rep, _ = analyze_snippet(
+        tmp_path,
+        """
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self._cv = threading.Condition(self._mu)
+
+            def take(self):
+                with self._cv:
+                    while True:
+                        self._cv.wait()
+        """,
+    )
+    assert keys(rep, "blocking-under-lock") == []
+
+
+def test_self_deadlock_on_plain_lock(tmp_path):
+    rep, _ = analyze_snippet(
+        tmp_path,
+        """
+        import threading
+
+        L = threading.Lock()
+
+        def g():
+            with L:
+                pass
+
+        def f():
+            with L:
+                g()
+        """,
+    )
+    assert len(keys(rep, "self-deadlock")) == 1
+
+
+def test_rlock_reentry_is_fine(tmp_path):
+    rep, _ = analyze_snippet(
+        tmp_path,
+        """
+        import threading
+
+        L = threading.RLock()
+
+        def g():
+            with L:
+                pass
+
+        def f():
+            with L:
+                g()
+        """,
+    )
+    assert keys(rep, "self-deadlock") == []
+    assert keys(rep, "lock-cycle") == []
+
+
+# ---------------------------------------------------------------------------
+# pass 2: compile hazards
+# ---------------------------------------------------------------------------
+
+
+def test_unbucketed_jit_shape(tmp_path):
+    rep, _ = analyze_snippet(
+        tmp_path,
+        """
+        import jax.numpy as jnp
+
+        def launch(expr, batch):
+            pad = jnp.zeros((batch.shape[0], 8), dtype=batch.dtype)
+            full = jnp.concatenate([batch, pad])
+            return compiled_batched(expr, "count")(full)
+        """,
+    )
+    assert len(keys(rep, "jit-unbucketed-shape")) == 1
+
+
+def test_bucketed_dispatch_is_clean(tmp_path):
+    rep, _ = analyze_snippet(
+        tmp_path,
+        """
+        import jax.numpy as jnp
+
+        def launch(expr, batch):
+            bucket = slice_bucket(int(batch.shape[0]))
+            pad = jnp.zeros((bucket - batch.shape[0], 8), dtype=batch.dtype)
+            full = jnp.concatenate([batch, pad])
+            return compiled_batched(expr, "count")(full)
+        """,
+    )
+    assert keys(rep, "jit-unbucketed-shape") == []
+
+
+def test_fstring_in_compile_key(tmp_path):
+    rep, _ = analyze_snippet(
+        tmp_path,
+        """
+        def launch(frame, batch):
+            return compiled_batched(f"{frame}-{batch.shape}", "count")(batch)
+        """,
+    )
+    assert len(keys(rep, "jit-key-fstring")) == 1
+
+
+def test_lru_cache_on_method(tmp_path):
+    rep, _ = analyze_snippet(
+        tmp_path,
+        """
+        import functools
+
+        class Planner:
+            @functools.lru_cache(maxsize=64)
+            def plan(self, expr):
+                return expr
+
+        @functools.lru_cache
+        def fine_module_level(expr):
+            return expr
+        """,
+    )
+    ks = keys(rep, "lru-cache-method")
+    assert len(ks) == 1
+    assert "Planner.plan" in ks[0]
+
+
+def test_host_sync_in_loop(tmp_path):
+    rep, _ = analyze_snippet(
+        tmp_path,
+        """
+        def fetch_all(frags):
+            out = []
+            for f in frags:
+                row = f.device_plane()
+                out.append(row.block_until_ready())
+            return out
+        """,
+    )
+    assert len(keys(rep, "host-sync-in-loop")) == 1
+
+
+# ---------------------------------------------------------------------------
+# pass 3: resource discipline
+# ---------------------------------------------------------------------------
+
+
+def test_leaked_pin_lease(tmp_path):
+    rep, _ = analyze_snippet(
+        tmp_path,
+        """
+        def bad(pool, keys):
+            lease = pool.pinned(*keys)
+            return 1
+
+        def good(pool, keys):
+            with pool.pinned(*keys):
+                return 1
+
+        def also_good(pool, keys):
+            return pool.pinned(*keys)
+
+        def finally_good(pool, keys):
+            lease = pool.pinned(*keys)
+            try:
+                return 1
+            finally:
+                lease.release()
+        """,
+    )
+    ks = keys(rep, "leaked-scope")
+    assert len(ks) == 1
+    assert "fixpkg.mod.bad" in ks[0]
+
+
+def test_leaked_span(tmp_path):
+    rep, _ = analyze_snippet(
+        tmp_path,
+        """
+        def bad(tracer):
+            sp = tracer.span("work")
+            do_work()
+        """,
+    )
+    assert len(keys(rep, "leaked-scope")) == 1
+
+
+# ---------------------------------------------------------------------------
+# allowlist mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_allowlist_documents_and_goes_stale(tmp_path):
+    cfg = AnalyzeConfig(package="fixpkg")
+    cfg.allow = [
+        AllowEntry(
+            rule="blocking-under-lock",
+            match="blocking-under-lock:*:sleep",
+            reason="test doc",
+        ),
+        AllowEntry(rule="lock-cycle", match="lock-cycle:does.not.exist*",
+                   reason="stale"),
+    ]
+    rep, _ = analyze_snippet(
+        tmp_path,
+        """
+        import threading
+        import time
+
+        L = threading.Lock()
+
+        def f():
+            with L:
+                time.sleep(1)
+        """,
+        config=cfg,
+    )
+    assert rep.active == []
+    assert len(rep.allowed) == 1
+    assert rep.allowed[0].allowed_by == "test doc"
+    assert rep.exit_code() == 0
+    assert len(rep.stale_allow) == 1 and "does.not.exist" in rep.stale_allow[0]
+
+
+# ---------------------------------------------------------------------------
+# the live codebase
+# ---------------------------------------------------------------------------
+
+
+def test_live_codebase_clean_modulo_allowlist():
+    cfg = load_config()
+    rep, graph = run_analysis(config=cfg)
+    assert rep.active == [], "\n".join(
+        f"{f.rule} {f.location()}: {f.message}" for f in rep.active
+    )
+    assert rep.stale_allow == [], rep.stale_allow
+    # the acceptance bar: the whole-package run stays fast
+    assert rep.elapsed_s < 30.0
+    # the graph must cover the known design edges (PR-3 pool<->owner)
+    assert (
+        "pilosa_tpu.core.fragment.Fragment._mu",
+        "pilosa_tpu.device.pool.PlanePool._mu",
+    ) in graph.edges
+    back = graph.edges.get(
+        (
+            "pilosa_tpu.device.pool.PlanePool._mu",
+            "pilosa_tpu.core.fragment.Fragment._mu",
+        )
+    )
+    assert back is not None and back.nonblocking
+
+
+def test_live_lock_registry_covers_every_creation_site():
+    """Every `threading.Lock/RLock/Condition(...)` textually present in
+    the package must be in the static registry — otherwise the runtime
+    validator would report unknown locks on first use."""
+    import re
+    import subprocess
+
+    cfg = load_config()
+    _, graph = run_analysis(config=cfg, passes=("locks",))
+    out = subprocess.run(
+        ["grep", "-rn", "-E",
+         r"threading\.(Lock|RLock|Condition)\(",
+         "pilosa_tpu", "--include=*.py"],
+        capture_output=True, text=True, check=True,
+    ).stdout
+    missing = []
+    for line in out.splitlines():
+        path, lineno, text = line.split(":", 2)
+        if "/analyze/" in path or "__pycache__" in path:
+            continue  # the validator itself uses raw factories
+        if re.search(r"=\s*threading\.(Lock|RLock|Condition)$", text.strip()):
+            continue  # alias assignment, not a creation
+        if re.search(r"threading\.Condition\(self\.", text):
+            # Condition(self._mu) wraps an EXISTING lock: statically an
+            # alias of that lock's site, no new lock at runtime either.
+            continue
+        if (path, int(lineno)) not in graph.lock_sites:
+            missing.append(line)
+    assert missing == [], missing
+
+
+# ---------------------------------------------------------------------------
+# runtime validation mode
+# ---------------------------------------------------------------------------
+
+
+def _fake_graph():
+    g = LockGraph()
+    g.lock_sites = {
+        ("pkg/a.py", 10): "pkg.a.A",
+        ("pkg/b.py", 20): "pkg.b.B",
+        ("pkg/c.py", 30): "pkg.c.C",
+    }
+    from pilosa_tpu.analyze.locks import Edge
+
+    g.add(Edge("pkg.a.A", "pkg.b.B", False, "pkg/a.py", 11, "t"))
+    g.add(Edge("pkg.b.B", "pkg.c.C", False, "pkg/b.py", 21, "t"))
+    return g
+
+
+def test_verify_accepts_direct_and_transitive_orders():
+    g = _fake_graph()
+    edges = {
+        (("pkg/a.py", 10), ("pkg/b.py", 20), False): 3,
+        # transitive A -> C: fine, the static order implies it
+        (("pkg/a.py", 10), ("pkg/c.py", 30), False): 1,
+    }
+    sites = set().union(*[{e[0], e[1]} for e in edges])
+    assert rt.verify(graph=g, edges=edges, sites=sites) == []
+
+
+def test_verify_flags_reversed_order_and_unknown_lock():
+    g = _fake_graph()
+    edges = {(("pkg/b.py", 20), ("pkg/a.py", 10), False): 1}
+    sites = {("pkg/b.py", 20), ("pkg/a.py", 10), ("pkg/zz.py", 1)}
+    problems = rt.verify(graph=g, edges=edges, sites=sites)
+    assert any("no path in the static lock graph" in p for p in problems)
+    assert any("never discovered" in p for p in problems)
+
+
+def test_checked_lock_records_held_order():
+    saved_edges = dict(rt._edges)
+    saved_created = set(rt._created)
+    saved_held = list(rt._held())
+    try:
+        rt._edges.clear()
+        rt._tls.held = []
+        a = rt._CheckedLock(rt._real_lock(), ("x/a.py", 1))
+        b = rt._CheckedRLock(rt._real_rlock(), ("x/b.py", 2))
+        with a:
+            with b:
+                with b:  # reentrant: no self-edge
+                    pass
+        assert rt.observed_edges() == {
+            (("x/a.py", 1), ("x/b.py", 2), False): 1
+        }
+        assert rt._held() == []
+        # non-blocking acquire records a non-blocking edge
+        with b:
+            assert a.acquire(blocking=False)
+            a.release()
+        assert (("x/b.py", 2), ("x/a.py", 1), True) in rt.observed_edges()
+    finally:
+        rt._edges.clear()
+        rt._edges.update(saved_edges)
+        rt._created.clear()
+        rt._created.update(saved_created)
+        rt._tls.held = saved_held
+
+
+def test_condition_roundtrip_through_checked_lock():
+    import threading
+
+    saved_edges = dict(rt._edges)
+    try:
+        rt._edges.clear()
+        rt._tls.held = []
+        inner = rt._CheckedRLock(rt._real_rlock(), ("x/cv.py", 7))
+        cv = rt._real_condition(inner)
+        fired = []
+
+        def waiter():
+            with cv:
+                while not fired:
+                    cv.wait(timeout=5)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        with cv:
+            fired.append(1)
+            cv.notify()
+        t.join(timeout=5)
+        assert not t.is_alive()
+        assert rt._held() == []
+    finally:
+        rt._edges.clear()
+        rt._edges.update(saved_edges)
+        rt._tls.held = []

@@ -114,6 +114,19 @@ def main() -> int:
         finally:
             s0.close()
             s1.close()
+    if os.environ.get("PILOSA_LOCK_CHECK"):
+        # Runtime lock-order validation (PR 8): every acquisition order
+        # observed during the chaos pass must be consistent with the
+        # static lock graph (pilosa_tpu/analyze).
+        from pilosa_tpu.analyze import runtime as lock_check
+
+        problems = lock_check.verify()
+        print(lock_check.report().splitlines()[0])
+        if problems:
+            for p in problems:
+                print("lock-check DISAGREEMENT:", p)
+            return 1
+        print("lock-check ok: runtime order consistent with static graph")
     return 0
 
 
