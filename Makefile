@@ -5,7 +5,7 @@
 
 PYTHON ?= python
 
-.PHONY: default test lint analyze typecheck check bench bench-smoke chaos-smoke install build docker clean generate
+.PHONY: default test lint analyze typecheck check bench bench-smoke chaos-smoke load-smoke install build docker clean generate
 
 default: build test
 
@@ -74,6 +74,14 @@ bench-smoke:
 # exactly.  Non-blocking in CI (.github/workflows/check.yml).
 chaos-smoke:
 	$(PYTHON) tools/chaos_smoke.py
+
+# Tiny CPU open-loop load pass (tools/load_smoke.py over the
+# tools/load_harness.py storm generator): asserts the artifact carries
+# the goodput-vs-offered-load curve + shed counters, and that shed-rate
+# is 0 at trivial load.  Writes load-report.json (uploaded as a CI
+# artifact).  Non-blocking in CI (.github/workflows/check.yml).
+load-smoke:
+	$(PYTHON) tools/load_smoke.py
 
 docker:
 	docker build -t pilosa-tpu .

@@ -293,6 +293,7 @@ def main() -> None:
     # subprocess BEFORE this process touches the device — coordinator
     # fan-out/reduce overhead is host-side and must not ride the shared
     # TPU pool's variance.  ~1 min.
+    cluster_reduce = None
     if os.environ.get("BENCH_SKIP_CLUSTER_TIER") != "1":
         import subprocess
 
@@ -308,12 +309,63 @@ def main() -> None:
             if out.returncode == 0 and out.stdout.strip():
                 for line in out.stderr.strip().splitlines():
                     log(line)
-                log(f"cluster_reduce tier: {out.stdout.strip().splitlines()[-1]}")
+                last = out.stdout.strip().splitlines()[-1]
+                log(f"cluster_reduce tier: {last}")
+                try:
+                    cluster_reduce = json.loads(last)
+                except json.JSONDecodeError:
+                    pass
             else:
                 log(f"cluster tier failed: rc={out.returncode} "
                     f"stderr={out.stderr.strip()[-300:]!r}")
         except Exception as e:
             log(f"cluster tier failed: {e}")
+
+    # Admission-storm tier: the open-loop sustained-load harness
+    # (tools/load_harness.py) self-boots a node twice — admission ON
+    # then OFF — and sweeps offered load past 2-3x capacity, recording
+    # goodput-vs-offered-load and the max-sustained-QPS-at-p99-SLO
+    # figure.  A CPU subprocess like the cluster tier: admission and
+    # the HTTP/queue path under storm are host-side, and the open-loop
+    # generator must not contend with this process's device work.
+    admission_storm = None
+    if os.environ.get("BENCH_SKIP_ADMISSION_TIER") != "1":
+        import subprocess
+
+        lh = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools", "load_harness.py"
+        )
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+        try:
+            out = subprocess.run(
+                [sys.executable, lh, "--self-boot", "--compare",
+                 "--slices", "8", "--duration", "5", "--deadline-ms", "500",
+                 "--slo-ms", "250",
+                 # Gates sized to the CPU node this tier boots (see
+                 # docs/administration.md "Sizing the gates"): C/S*1000
+                 # against single-digit-ms service times.  The config
+                 # defaults are sized for TPU-class nodes and would
+                 # over-admit here.
+                 "--point-concurrency", "4", "--heavy-concurrency", "2",
+                 "--write-concurrency", "2", "--queue-depth", "4"],
+                env=env, capture_output=True, timeout=900, text=True,
+            )
+            if out.returncode == 0 and out.stdout.strip():
+                for line in out.stderr.strip().splitlines():
+                    log(line)
+                admission_storm = json.loads(
+                    out.stdout.strip().splitlines()[-1]
+                )
+                log(
+                    "admission_storm tier: max sustained "
+                    f"{admission_storm['max_sustained_qps_at_p99_slo']} qps "
+                    "at p99 SLO"
+                )
+            else:
+                log(f"admission tier failed: rc={out.returncode} "
+                    f"stderr={out.stderr.strip()[-300:]!r}")
+        except Exception as e:
+            log(f"admission tier failed: {e}")
 
     total_columns = int(os.environ.get("BENCH_COLUMNS", 1_000_000_000))
     n_slices = (total_columns + SLICE_WIDTH - 1) // SLICE_WIDTH  # 954
@@ -522,6 +574,22 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — the artifact must survive
             log(f"bsi tier FAILED ({e!r:.300})")
 
+    # --- tier 6b: multi-node Intersect+Count, device-resident planes ---
+    # BASELINE configs[4]'s distributed query (the reference's whole
+    # point, executor.go:1149-1243) finally on the headline bench: real
+    # in-process HTTP nodes sharing this process's accelerator, planes
+    # device-resident, per-node-count throughput.
+    cluster_tpu = None
+    if os.environ.get("BENCH_SKIP_CLUSTER_TIER") != "1":
+        try:
+            cluster_tpu = with_retries(
+                "cluster-tpu tier",
+                lambda: run_cluster_tpu_tier(leaves, cpu_fallback),
+                attempts=2,
+            )
+        except Exception as e:  # noqa: BLE001 — the artifact must survive
+            log(f"cluster-tpu tier FAILED ({e!r:.300})")
+
     # --- tier 7: cold restart (time-to-first-answer while staging) -----
     cold_restart = None
     if os.environ.get("BENCH_SKIP_COLD_TIER") != "1":
@@ -598,6 +666,12 @@ def main() -> None:
         out["bsi"] = bsi_tier
     if cold_restart is not None:
         out["cold_restart"] = cold_restart
+    if cluster_reduce is not None:
+        out["cluster_reduce"] = cluster_reduce
+    if cluster_tpu is not None:
+        out["cluster_tpu"] = cluster_tpu
+    if admission_storm is not None:
+        out["admission_storm"] = admission_storm
     out["program_cache"] = {
         "entries": plan.program_cache_stats(),
         "bounds": plan.program_cache_bounds(),
@@ -758,6 +832,119 @@ def run_hbm_pressure_tier(rng, cpu_fb=False) -> dict:
             )
         holder.close()
         return out
+
+
+def run_cluster_tpu_tier(leaves, cpu_fb=False) -> dict:
+    """``cluster_tpu`` tier: BASELINE configs[4]'s multi-node
+    Intersect+Count with device-resident planes.  Boots 1/2/4 real
+    in-process servers (own HTTP listener, holder, executor; static
+    hash-identical placement) sharing THIS process's accelerator,
+    primes each node's owned slices, warms the mirrors onto the device,
+    and measures the same PQL through the coordinator — sync p50 plus
+    concurrent ms/query and Gcols/s per node count."""
+    import tempfile
+    from concurrent.futures import ThreadPoolExecutor
+
+    from pilosa_tpu.cluster.topology import Cluster
+    from pilosa_tpu.net.client import InternalClient
+    from pilosa_tpu.net.server import Server
+    from pilosa_tpu.ops import bitplane as bp
+    from pilosa_tpu.ops.bitplane import SLICE_WIDTH
+
+    n_slices = min(
+        len(leaves), int(os.environ.get("BENCH_CLUSTER_TPU_SLICES", "128"))
+    )
+    rows = leaves[:n_slices]
+    want = int(np.bitwise_count(rows[:, 0] & rows[:, 1]).sum())
+    q = 'Count(Intersect(Bitmap(rowID=0, frame="f"), Bitmap(rowID=1, frame="f")))'
+    out: dict = {"slices": n_slices, "per_node": {}}
+    quiet = dict(
+        anti_entropy_interval=3600,
+        polling_interval=3600,
+        cache_flush_interval=3600,
+        prewarm=False,
+    )
+    for n_nodes in (1, 2, 4):
+        with tempfile.TemporaryDirectory() as td:
+            servers = []
+            clusters = []
+            try:
+                for i in range(n_nodes):
+                    cluster = Cluster(replica_n=1)
+                    s = Server(
+                        data_dir=os.path.join(td, f"n{i}"),
+                        cluster=cluster,
+                        **quiet,
+                    )
+                    s.open()
+                    servers.append(s)
+                    clusters.append(cluster)
+                hosts = sorted(s.host for s in servers)
+                for c in clusters:
+                    for h in hosts:
+                        if c.node_by_host(h) is None:
+                            c.add_node(h)
+                    c.nodes.sort(key=lambda n: n.host)
+                for s in servers:
+                    holder = s.holder
+                    holder.create_index_if_not_exists("i")
+                    holder.index("i").create_frame_if_not_exists("f")
+                    view = holder.frame("i", "f").create_view_if_not_exists(
+                        "standard"
+                    )
+                    for sl in s.cluster.owns_slices(
+                        "i", n_slices - 1, s.host
+                    ):
+                        prime_fragment(
+                            view.create_fragment_if_not_exists(sl),
+                            rows[sl],
+                            bp.pad_rows,
+                        )
+                    holder.index("i").set_remote_max_slice(n_slices - 1)
+                coord = servers[0].host
+                client = InternalClient(coord, timeout=120.0)
+                # Warm: compiles + host->device mirror uploads; planes
+                # stay device-resident for the measured queries.
+                got = int(client.execute_query("i", q)[0])
+                assert got == want, f"cluster bit-exactness: {got} != {want}"
+                times = []
+                for _ in range(9):
+                    t0 = time.perf_counter()
+                    client.execute_query("i", q)
+                    times.append(time.perf_counter() - t0)
+                times.sort()
+                p50 = times[len(times) // 2]
+                n_conc, threads = 48, 16
+                clients = [
+                    InternalClient(coord, timeout=120.0)
+                    for _ in range(threads)
+                ]
+                t0 = time.perf_counter()
+                with ThreadPoolExecutor(max_workers=threads) as pool:
+                    list(
+                        pool.map(
+                            lambda i: clients[i % threads].execute_query(
+                                "i", q
+                            ),
+                            range(n_conc),
+                        )
+                    )
+                conc_s = (time.perf_counter() - t0) / n_conc
+                gcols = n_slices * SLICE_WIDTH / conc_s / 1e9
+                out["per_node"][str(n_nodes)] = {
+                    "sync_p50_ms": round(p50 * 1e3, 3),
+                    "concurrent_ms_per_query": round(conc_s * 1e3, 3),
+                    "gcols_per_s": round(gcols, 3),
+                }
+                log(
+                    f"cluster_tpu {n_nodes} node(s): sync p50 "
+                    f"{p50*1e3:.2f} ms, concurrent {conc_s*1e3:.2f} "
+                    f"ms/query, {gcols:.2f} Gcols/s"
+                )
+            finally:
+                for s in servers:
+                    s.close()
+    return out
 
 
 def run_bsi_tier(rng, n_slices, cpu_fb=False) -> dict:

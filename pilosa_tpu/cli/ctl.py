@@ -124,6 +124,12 @@ def build_server(cfg: config_mod.Config):
         retry_backoff_ms=cfg.net.retry_backoff_ms,
         breaker_failure_threshold=cfg.net.breaker_failure_threshold,
         breaker_open_ms=cfg.net.breaker_open_ms,
+        admission=cfg.net.admission,
+        admission_point_concurrency=cfg.net.admission_point_concurrency,
+        admission_heavy_concurrency=cfg.net.admission_heavy_concurrency,
+        admission_write_concurrency=cfg.net.admission_write_concurrency,
+        admission_internal_concurrency=cfg.net.admission_internal_concurrency,
+        admission_queue_depth=cfg.net.admission_queue_depth,
     )
 
 

@@ -455,6 +455,15 @@ class Executor:
             column_label = idx.column_label
             computed_lists = True
 
+        # Cost-class accounting (exec/plan.py cost_class): the same
+        # classification the admission layer gates on, counted here so
+        # the executor-side mix is visible even for direct library use
+        # (no HTTP front) — dashboards correlate exec.class.* against
+        # net.admission.* to see what the gates actually passed.
+        self.holder.stats.count_with_custom_tags(
+            "exec.class", 1, [f"class:{plan.cost_class(q.calls)}"]
+        )
+
         # Bulk attribute-insert fast path (reference: executor.go:119-122).
         if q.calls and all(c.name == "SetRowAttrs" for c in q.calls):
             return self._execute_bulk_set_row_attrs(index, q.calls, opt)

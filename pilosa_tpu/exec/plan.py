@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 
 from pilosa_tpu.bsi import ripple
-from pilosa_tpu.pql.parser import Call
+from pilosa_tpu.pql.parser import WRITE_CALLS, Call
 
 # Calls that fetch rows (leaves of a bitmap expression).  The Bsi*
 # leaves are synthetic calls the executor's BSI rewrite produces:
@@ -106,6 +106,41 @@ def collect_leaf_calls(call: Call) -> list[Call]:
 
     rec(call)
     return out
+
+
+# ---------------------------------------------------------------------------
+# cost classes (net/admission.py): the admission layer's view of a plan
+# ---------------------------------------------------------------------------
+
+COST_POINT = "point"
+COST_HEAVY = "heavy"
+COST_WRITE = "write"
+
+# Calls whose execution fans past a single fused row program: TopN's
+# two-phase candidate walk, the BSI aggregates' per-slice partial
+# vectors, and Range (time-view union / ~depth-many plane leaves per
+# BSI comparison) all cost an order of magnitude more device and host
+# work per slice than a point Count/Bitmap tree.
+_HEAVY_CALLS = frozenset({"TopN", "Sum", "Min", "Max", "Range"})
+
+
+def cost_class(calls: "list[Call]") -> str:
+    """The admission cost class of a parsed query: ``write`` when any
+    call mutates, else ``heavy`` when any call (at any depth) is a
+    TopN/aggregate/Range, else ``point``.  Derived purely from the
+    parsed plan — classification must stay cheap enough to run before
+    any admission decision, let alone device work."""
+
+    def heavy(c: Call) -> bool:
+        if c.name in _HEAVY_CALLS:
+            return True
+        return any(heavy(ch) for ch in c.children)
+
+    if any(c.name in WRITE_CALLS for c in calls):
+        return COST_WRITE
+    if any(heavy(c) for c in calls):
+        return COST_HEAVY
+    return COST_POINT
 
 
 def _popcount32(row):

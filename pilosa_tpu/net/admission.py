@@ -1,0 +1,293 @@
+"""Server-side admission control: cost classes, bounded queues,
+deadline-aware load shedding.
+
+The HTTP adapter (``ThreadingHTTPServer``) admits every connection
+unconditionally, so under overload a node queues work it can never
+finish inside its deadline and answers 504 *after* burning device time
+— the classic overload failure mode (see Facebook's "Fail at Scale"
+adaptive-LIFO/CoDel design).  This layer sits in FRONT of the
+executor/coalescer and decides, per request, in microseconds:
+
+* **Cost classes.**  Every query is classified from its parsed plan
+  (``exec.plan.cost_class``): ``point`` (Count/Bitmap algebra),
+  ``heavy`` (TopN / Sum / Min / Max / Range), ``write`` (PQL writes and
+  bulk imports) — plus ``internal`` for the remote legs of another
+  node's map/reduce (``QueryRequest.Remote``) and anti-entropy repair.
+  Each class gets its own concurrency gate and bounded queue, so a
+  storm of TopNs cannot starve point lookups and vice versa.
+
+* **Deadline-aware shedding.**  A request that cannot be served within
+  its remaining ``X-Deadline-Ms`` budget — the queue is full, or the
+  predicted queue wait (queue position x EWMA service time / gate
+  width) exceeds the budget — is answered ``429 + Retry-After``
+  immediately, BEFORE any coalescer/device work.  The Retry-After hint
+  is the predicted drain time of the queue ahead.
+
+* **Internal priority.**  The ``internal`` lane is a separate gate:
+  client traffic can never occupy its slots, so a saturated cluster
+  cannot distributed-livelock (every node's client gates full, every
+  node's map legs starving behind them).  The lane is still *bounded* —
+  a truly saturated node sheds internal legs too, which the
+  coordinator's failover treats as a node failure (try a replica, or
+  degrade under ``allowPartial``) rather than a breaker trip.
+
+Observability: ``net.admission.admitted|shed|queueTimeout`` counters
+(``class:`` tag), ``net.admission.queueWaitMs`` histogram, scrape-time
+``net.admission.active|queueDepth|ewmaServiceMs`` gauges on /metrics,
+the per-class queue state on ``GET /debug/health``, and an
+``admission`` span in every query trace.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from pilosa_tpu.net import resilience as rz
+
+# Class names (the first three mirror exec.plan.COST_*; admission owns
+# the internal lane, which is a transport property, not a plan one).
+CLASS_POINT = "point"
+CLASS_HEAVY = "heavy"
+CLASS_WRITE = "write"
+CLASS_INTERNAL = "internal"
+
+CLASSES = (CLASS_POINT, CLASS_HEAVY, CLASS_WRITE, CLASS_INTERNAL)
+
+# EWMA smoothing for observed service times: new = a*obs + (1-a)*old.
+_EWMA_ALPHA = 0.2
+# Service-time estimate before the first observation (ms).  Deliberately
+# modest: the first storm against a cold gate should shed on queue
+# depth, not on a wild wait prediction.
+_EWMA_INIT_MS = 25.0
+# Retry-After hints are clamped to this window.
+_MIN_RETRY_AFTER_S = 0.05
+_MAX_RETRY_AFTER_S = 30.0
+
+
+class Ticket:
+    """One admitted request's slot in a class gate.  ``release()``
+    returns the slot and feeds the observed service time back into the
+    gate's EWMA (which drives the NEXT request's wait prediction)."""
+
+    __slots__ = ("_gate", "wait_ms", "_t_admit", "_released")
+
+    def __init__(self, gate: "_ClassGate", wait_ms: float):
+        self._gate = gate
+        self.wait_ms = wait_ms
+        self._t_admit = time.monotonic()
+        self._released = False
+
+    def release(self) -> None:
+        if self._released:  # idempotent — finally blocks may race close
+            return
+        self._released = True
+        self._gate._release(time.monotonic() - self._t_admit)
+
+
+class _ClassGate:
+    """Concurrency gate + bounded FIFO-ish queue for one cost class."""
+
+    def __init__(
+        self,
+        name: str,
+        concurrency: int,
+        queue_depth: int,
+        stats,
+    ):
+        from pilosa_tpu.obs.stats import NopStatsClient
+
+        self.name = name
+        self.concurrency = max(1, int(concurrency))
+        self.queue_depth = max(0, int(queue_depth))
+        self.stats = stats or NopStatsClient()
+        self._cv = threading.Condition()
+        self._active = 0
+        self._queued = 0
+        self._ewma_ms = _EWMA_INIT_MS
+        # Lifetime counters for snapshot() — kept locally so
+        # /debug/health reports them even without a stats backend.
+        self.admitted = 0
+        self.shed = 0
+
+    # -- prediction ----------------------------------------------------
+
+    def _predicted_wait_ms(self, ahead: int) -> float:
+        """Expected queue wait for a request with ``ahead`` requests in
+        front of it: the gate drains ``concurrency`` requests per EWMA
+        service time."""
+        return ahead * self._ewma_ms / self.concurrency
+
+    def _retry_after_s(self, predicted_ms: float) -> float:
+        return min(
+            max(predicted_ms / 1000.0, _MIN_RETRY_AFTER_S),
+            _MAX_RETRY_AFTER_S,
+        )
+
+    def _shed_locked(self, predicted_ms: float, reason: str) -> "rz.ShedError":
+        self.shed += 1
+        return rz.ShedError(
+            f"admission: {self.name} {reason} "
+            f"(active={self._active}/{self.concurrency} "
+            f"queued={self._queued}/{self.queue_depth} "
+            f"predicted_wait_ms={predicted_ms:.0f})",
+            retry_after_s=self._retry_after_s(predicted_ms),
+            cost_class=self.name,
+        )
+
+    # -- admission -----------------------------------------------------
+
+    def acquire(self, deadline: "rz.Deadline | None") -> Ticket:
+        """Admit (possibly after a bounded, deadline-clamped queue wait)
+        or raise :class:`ShedError` without blocking on anything but
+        this gate's own lock.  Stats emit OUTSIDE the critical section
+        — this lock sits on every request's path (same treatment the
+        PlanePool got in PR 8)."""
+        t0 = time.monotonic()
+        try:
+            wait_ms = self._acquire_locked(deadline, t0)
+        except rz.ShedError:
+            self.stats.count_with_custom_tags(
+                "net.admission.shed", 1, [f"class:{self.name}"]
+            )
+            raise
+        self.stats.count_with_custom_tags(
+            "net.admission.admitted", 1, [f"class:{self.name}"]
+        )
+        if wait_ms > 0:
+            self.stats.histogram("net.admission.queueWaitMs", wait_ms)
+        return Ticket(self, wait_ms)
+
+    def _acquire_locked(
+        self, deadline: "rz.Deadline | None", t0: float
+    ) -> float:
+        """The lock-held admission decision; returns the queue wait in
+        ms or raises :class:`ShedError`."""
+        with self._cv:
+            if self._active < self.concurrency and self._queued == 0:
+                self._active += 1
+                self.admitted += 1
+                return 0.0
+            ahead = self._queued
+            predicted_ms = self._predicted_wait_ms(ahead + 1)
+            if self._queued >= self.queue_depth:
+                raise self._shed_locked(predicted_ms, "queue full")
+            if (
+                deadline is not None
+                and deadline.remaining_ms() < predicted_ms + self._ewma_ms
+            ):
+                # Queuing would only produce a 504 after the fact —
+                # answer 429 now, before any work happens.
+                raise self._shed_locked(
+                    predicted_ms, "predicted wait exceeds deadline"
+                )
+            self._queued += 1
+            try:
+                while self._active >= self.concurrency:
+                    timeout = None
+                    if deadline is not None:
+                        timeout = deadline.remaining()
+                        if timeout <= 0:
+                            raise self._shed_locked(
+                                self._predicted_wait_ms(self._queued),
+                                "deadline expired in queue",
+                            )
+                    self._cv.wait(timeout)
+            finally:
+                self._queued -= 1
+            self._active += 1
+            self.admitted += 1
+            return (time.monotonic() - t0) * 1000.0
+
+    def _release(self, service_s: float) -> None:
+        with self._cv:
+            self._active -= 1
+            self._ewma_ms = (
+                _EWMA_ALPHA * service_s * 1000.0
+                + (1.0 - _EWMA_ALPHA) * self._ewma_ms
+            )
+            self._cv.notify()
+
+    # -- introspection -------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._cv:
+            return {
+                "concurrency": self.concurrency,
+                "queueDepth": self.queue_depth,
+                "active": self._active,
+                "queued": self._queued,
+                "ewmaServiceMs": round(self._ewma_ms, 3),
+                "admitted": self.admitted,
+                "shed": self.shed,
+            }
+
+
+class AdmissionController:
+    """Per-class gates behind one handle.  The Handler acquires a
+    ticket per request (query routes classify from the parsed plan;
+    import routes are ``write``; remote legs are ``internal``) and
+    releases it when the response is computed."""
+
+    def __init__(
+        self,
+        point_concurrency: int = 32,
+        heavy_concurrency: int = 8,
+        write_concurrency: int = 16,
+        internal_concurrency: int = 128,
+        queue_depth: int = 64,
+        stats=None,
+    ):
+        self._gates = {
+            CLASS_POINT: _ClassGate(
+                CLASS_POINT, point_concurrency, queue_depth, stats
+            ),
+            CLASS_HEAVY: _ClassGate(
+                CLASS_HEAVY, heavy_concurrency, queue_depth, stats
+            ),
+            CLASS_WRITE: _ClassGate(
+                CLASS_WRITE, write_concurrency, queue_depth, stats
+            ),
+            # The internal lane's queue is as wide as its gate: a map
+            # leg briefly over the limit should wait (its coordinator
+            # holds budget), but a pile-up twice the gate deep means
+            # the node is genuinely saturated and must shed so the
+            # coordinator can fail over.
+            CLASS_INTERNAL: _ClassGate(
+                CLASS_INTERNAL,
+                internal_concurrency,
+                max(1, int(internal_concurrency)),
+                stats,
+            ),
+        }
+
+    def gate(self, cls: str) -> _ClassGate:
+        return self._gates[cls]
+
+    def acquire(
+        self, cls: str, deadline: "rz.Deadline | None" = None
+    ) -> Ticket:
+        """Admit a request of class ``cls`` or raise
+        :class:`resilience.ShedError`.  ``deadline`` defaults to the
+        contextvar-current one (the handler's deadline scope)."""
+        if deadline is None:
+            deadline = rz.current_deadline()
+        return self._gates[cls].acquire(deadline)
+
+    def snapshot(self) -> dict:
+        return {name: g.snapshot() for name, g in self._gates.items()}
+
+    def gauges(self) -> dict[str, float]:
+        """Scrape-time gauges for /metrics (net.admission.* per class)."""
+        out: dict[str, float] = {}
+        for name, g in self._gates.items():
+            snap = g.snapshot()
+            out[f"net.admission.active[class:{name}]"] = snap["active"]
+            out[f"net.admission.queued[class:{name}]"] = snap["queued"]
+            out[f"net.admission.concurrency[class:{name}]"] = snap[
+                "concurrency"
+            ]
+            out[f"net.admission.ewmaServiceMs[class:{name}]"] = snap[
+                "ewmaServiceMs"
+            ]
+        return out

@@ -127,7 +127,15 @@ class InternalClient:
             return self._attempt(method, bare, path, body, headers)
 
         if idempotent and self.retry is not None:
-            return self.retry.call(attempt)
+            # Sheds (429) retry alongside transport failures: the policy
+            # honors the server's Retry-After hint, and a shed that
+            # cannot be waited out within the deadline propagates so the
+            # executor can fail over to a replica (net/resilience.py).
+            return self.retry.call(
+                attempt,
+                retryable=resilience.TRANSPORT_ERRORS
+                + (resilience.ShedError,),
+            )
         return attempt()
 
     def _attempt(
@@ -153,8 +161,12 @@ class InternalClient:
                 raise
             resp_headers = {k.lower(): v for k, v in resp.getheaders()}
             # A 5xx means the node answered but is unhealthy — count it
-            # against the breaker like a transport failure.
+            # against the breaker like a transport failure.  A 429 shed
+            # is the opposite: a healthy-but-busy node answering fast
+            # and deliberately — it must NOT trip the breaker open.
             self._record_breaker(resp.status < 500)
+            if resp.status == 429:
+                raise _shed_error(self.host, data, resp_headers)
             return resp.status, data, resp_headers
         finally:
             if conn is not None:
@@ -229,6 +241,10 @@ class InternalClient:
                 self._record_breaker(False)
                 raise
             self._record_breaker(resp.status < 500)
+            if resp.status == 429:
+                raise _shed_error(
+                    self.host, data, {k.lower(): v for k, v in resp.getheaders()}
+                )
             return resp.status, data
         finally:
             if conn is not None:
@@ -276,6 +292,10 @@ class InternalClient:
     def _check(self, status: int, data: bytes) -> bytes:
         if status == 412:
             raise PreconditionFailedError(_err_text(data))
+        if status == 429:
+            # Paths that didn't go through _attempt (stream opens);
+            # headers are gone here, so the hint defaults.
+            raise resilience.ShedError(_err_text(data))
         if status == 504:
             # The peer's deadline expired — surface it as a deadline
             # failure so the coordinator 504s too instead of treating
@@ -480,7 +500,8 @@ class InternalClient:
                 if resp.Err:
                     errs.append(f"{node['host']}: {resp.Err}")
             except (
-                (ClientError, resilience.BreakerOpenError)
+                (ClientError, resilience.BreakerOpenError,
+                 resilience.ShedError)
                 + resilience.TRANSPORT_ERRORS
             ) as e:
                 errs.append(f"{node['host']}: {e}")
@@ -522,7 +543,8 @@ class InternalClient:
                 )
                 client._check(status, data)
             except (
-                (ClientError, resilience.BreakerOpenError)
+                (ClientError, resilience.BreakerOpenError,
+                 resilience.ShedError)
                 + resilience.TRANSPORT_ERRORS
             ) as e:
                 errs.append(f"{node['host']}: {e}")
@@ -785,6 +807,28 @@ def _err_text(data: bytes) -> str:
         return json.loads(data).get("error", data.decode(errors="replace"))
     except (json.JSONDecodeError, AttributeError):
         return data.decode(errors="replace")
+
+
+def _shed_error(
+    host: str, data: bytes, headers: dict[str, str]
+) -> resilience.ShedError:
+    """A 429 response as a ShedError carrying the server's Retry-After
+    hint — the precise millisecond figure from the JSON body when
+    present, else the whole-seconds header, else 1 s."""
+    retry_after = 1.0
+    try:
+        retry_after = float(headers.get("retry-after", "") or 1.0)
+    except ValueError:
+        pass
+    try:
+        ms = json.loads(data).get("retryAfterMs")
+        if ms is not None:
+            retry_after = float(ms) / 1000.0
+    except (json.JSONDecodeError, AttributeError, TypeError, ValueError):
+        pass
+    return resilience.ShedError(
+        _err_text(data), retry_after_s=retry_after, host=host
+    )
 
 
 def client_factory(node) -> InternalClient:

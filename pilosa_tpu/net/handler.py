@@ -55,7 +55,9 @@ from pilosa_tpu import stream as stream_mod
 from pilosa_tpu.core import attr as attr_mod
 from pilosa_tpu.core import timequantum as tq
 from pilosa_tpu.core.bitmap import RowBitmap
+from pilosa_tpu.exec import plan as plan_mod
 from pilosa_tpu.exec.executor import ExecOptions, TooManyWritesError
+from pilosa_tpu.net import admission as adm
 from pilosa_tpu.net import codec
 from pilosa_tpu.net import resilience as rz
 from pilosa_tpu.net import wire_pb2 as wire
@@ -156,6 +158,7 @@ class Handler:
         tracer=None,
         slow_query_ms: float = 0.0,
         resilience=None,
+        admission=None,
     ):
         self.holder = holder
         self.executor = executor
@@ -176,6 +179,12 @@ class Handler:
         # query deadline and the breaker registry behind
         # GET /debug/health.  None = no deadlines, no health detail.
         self.resilience = resilience
+        # Admission control (net/admission.py): per-cost-class
+        # concurrency gates + bounded queues in front of the executor.
+        # A request the node cannot serve within its deadline answers
+        # 429 + Retry-After BEFORE any coalescer/device work.  None =
+        # admit everything (bare handler / tests).
+        self.admission = admission
         # Chunk size for streamed (chunked transfer encoding) bodies:
         # CSV export and fragment archives move in writes of this size.
         self.stream_chunk_bytes = stream_chunk_bytes or stream_mod.DEFAULT_CHUNK_BYTES
@@ -651,6 +660,16 @@ class Handler:
         — one value per column, written as vectorized plane set+clear
         passes through Frame.import_value.  Ownership-guarded like
         /import; the client fans a slice's payload to every replica."""
+        ticket, shed = self._admit(adm.CLASS_WRITE, req)
+        if shed is not None:
+            return shed
+        try:
+            return self._handle_post_import_value(req)
+        finally:
+            if ticket is not None:
+                ticket.release()
+
+    def _handle_post_import_value(self, req: Request) -> Response:
         try:
             payload = json.loads(req.body)
         except json.JSONDecodeError as e:
@@ -772,6 +791,26 @@ class Handler:
                 or req.header("X-Allow-Partial") in ("1", "true")
             ),
         )
+        # Admission gate: classify from the parsed plan (remote map
+        # legs ride the internal priority lane — a saturated node must
+        # never starve another coordinator's fan-out behind its own
+        # client queue), then admit or shed 429 BEFORE the executor,
+        # coalescer, or device see the query.
+        ticket = None
+        if self.admission is not None:
+            cls = (
+                adm.CLASS_INTERNAL
+                if qreq["remote"]
+                else plan_mod.cost_class(q.calls)
+            )
+            root.annotate(cost_class=cls)
+            try:
+                with self.tracer.span("admission", cost_class=cls) as sp:
+                    ticket = self.admission.acquire(cls)
+                    sp.annotate(wait_ms=round(ticket.wait_ms, 3))
+            except rz.ShedError as e:
+                root.annotate(shed=True)
+                return self._shed_response(req, e)
         try:
             rz.check_deadline("before execute")
             with self.tracer.span("execute"):
@@ -786,6 +825,9 @@ class Handler:
             return self._query_error(req, f"{e} [trace {trace_id}]", 504)
         except Exception as e:  # noqa: BLE001 — executor boundary
             return self._query_error(req, str(e), 500)
+        finally:
+            if ticket is not None:
+                ticket.release()
 
         column_attr_sets = None
         if qreq["column_attrs"]:
@@ -871,12 +913,55 @@ class Handler:
             return Response.proto(wire.QueryResponse(Err=message), status=status)
         return Response.json({"error": message}, status=status)
 
+    def _shed_response(self, req: Request, e: rz.ShedError) -> Response:
+        """429 + Retry-After: the node is healthy but at capacity, and
+        the request was answered before any executor/device work.  The
+        header carries whole seconds (HTTP contract, floored at 1);
+        the JSON body carries the precise millisecond hint."""
+        import math
+
+        if PROTOBUF in req.header("Accept"):
+            resp = Response.proto(wire.QueryResponse(Err=str(e)), status=429)
+        else:
+            resp = Response.json(
+                {
+                    "error": str(e),
+                    "retryAfterMs": round(e.retry_after_s * 1000.0, 1),
+                },
+                status=429,
+            )
+        resp.headers["Retry-After"] = str(max(1, math.ceil(e.retry_after_s)))
+        return resp
+
+    def _admit(self, cls: str, req: Request):
+        """Admission for non-query routes (imports, repair pushes):
+        returns ``(ticket, None)`` or ``(None, 429 response)``.  The
+        deadline comes straight off the request header — these routes
+        run outside the query path's deadline scope."""
+        if self.admission is None:
+            return None, None
+        dl = rz.Deadline.from_header(req.header(rz.DEADLINE_HEADER))
+        try:
+            return self.admission.acquire(cls, deadline=dl), None
+        except rz.ShedError as e:
+            return None, self._shed_response(req, e)
+
     # ------------------------------------------------------------------
     # import / export
     # ------------------------------------------------------------------
 
     def handle_post_import(self, req: Request) -> Response:
         """reference: handler.go:969-1046"""
+        ticket, shed = self._admit(adm.CLASS_WRITE, req)
+        if shed is not None:
+            return shed
+        try:
+            return self._handle_post_import(req)
+        finally:
+            if ticket is not None:
+                ticket.release()
+
+    def _handle_post_import(self, req: Request) -> Response:
         pb = wire.ImportRequest()
         try:
             pb.ParseFromString(req.body)
@@ -913,7 +998,19 @@ class Handler:
         """View-scoped raw sets/clears — the anti-entropy repair path
         for derived (inverse/time) views, which the PQL write fan-out
         cannot target individually (pilosa_tpu extension; the reference
-        only repairs the standard view, fragment.go:1443)."""
+        only repairs the standard view, fragment.go:1443).  Rides the
+        internal admission lane: anti-entropy repair is cluster-internal
+        traffic and must not starve behind a client-write storm."""
+        ticket, shed = self._admit(adm.CLASS_INTERNAL, req)
+        if shed is not None:
+            return shed
+        try:
+            return self._handle_post_import_view(req)
+        finally:
+            if ticket is not None:
+                ticket.release()
+
+    def _handle_post_import_view(self, req: Request) -> Response:
         pb = wire.ImportViewRequest()
         try:
             pb.ParseFromString(req.body)
@@ -1084,6 +1181,10 @@ class Handler:
             ]
         if self.resilience is not None:
             out.update(self.resilience.snapshot())
+        if self.admission is not None:
+            # Per-class gate state: concurrency/queue bounds, live
+            # occupancy, EWMA service time, admitted/shed totals.
+            out["admission"] = self.admission.snapshot()
         return Response.json(out)
 
     def handle_get_hbm(self, req: Request) -> Response:
@@ -1114,6 +1215,14 @@ class Handler:
             except Exception:  # noqa: BLE001 — stats must not fail the scrape
                 snap = {}
         self._inject_program_cache_gauges(snap)
+        if self.admission is not None:
+            # Scrape-time admission gauges (active/queued/concurrency/
+            # EWMA per class) — like the program-cache gauges, they
+            # must render even without a stats backend.
+            try:
+                snap.setdefault("gauges", {}).update(self.admission.gauges())
+            except Exception:  # noqa: BLE001 — stats must not fail the scrape
+                pass
         body = prom.render(
             snap,
             extra_gauges={

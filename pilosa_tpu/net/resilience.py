@@ -63,6 +63,33 @@ class DeadlineExceeded(RuntimeError):
         super().__init__(message)
 
 
+class ShedError(RuntimeError):
+    """The request was shed by admission control (HTTP 429): the server
+    is healthy but at capacity, and predicted queue wait would not fit
+    the request's remaining deadline budget — so it answered before
+    burning any executor/coalescer/device work.
+
+    Carries the server's ``Retry-After`` hint in seconds.  A shed must
+    NOT count against the host's circuit breaker (the node answered,
+    quickly and deliberately), but IS a node failure for the purposes
+    of replica failover: another replica may have capacity right now.
+    """
+
+    status = 429
+
+    def __init__(
+        self,
+        message: str = "request shed",
+        retry_after_s: float = 1.0,
+        host: str = "",
+        cost_class: str = "",
+    ):
+        super().__init__(message)
+        self.retry_after_s = max(float(retry_after_s), 0.0)
+        self.host = host
+        self.cost_class = cost_class
+
+
 class BreakerOpenError(RuntimeError):
     """Fast-fail for a host whose circuit breaker is open.  Deliberately
     NOT a transport error: retrying against an open breaker is pointless
@@ -84,6 +111,11 @@ def is_node_failure(exc: BaseException) -> bool:
         return True
     if isinstance(exc, DeadlineExceeded):
         return False
+    # A shed leg (429) indicts the node only in the failover sense:
+    # this replica is at capacity, another may not be.  It never counts
+    # against the breaker (see InternalClient._attempt).
+    if isinstance(exc, ShedError):
+        return True
     if isinstance(exc, TRANSPORT_ERRORS):
         return True
     status = getattr(exc, "status", None)
@@ -233,6 +265,17 @@ class RetryPolicy:
                     ) from e
                 sleep_s = min(delay, self.max_backoff)
                 sleep_s *= 1.0 - self.jitter * self._rng.random()
+                # A shed (429) carries the server's Retry-After hint:
+                # honor it — retrying sooner would just be shed again.
+                # When the hint outlives the remaining budget, surface
+                # the shed NOW so the caller can fail over to a replica
+                # instead of sleeping into a guaranteed 504.
+                retry_after = getattr(e, "retry_after_s", None)
+                if retry_after is not None:
+                    sleep_s = max(sleep_s, float(retry_after))
+                    self.stats.count("net.retry.shed")
+                    if dl is not None and dl.remaining() < sleep_s:
+                        raise
                 if dl is not None:
                     sleep_s = dl.clamp(sleep_s)
                 self.stats.count("net.retry.attempt")

@@ -67,6 +67,12 @@ class Server:
         retry_backoff_ms: float = 100.0,
         breaker_failure_threshold: int = 5,
         breaker_open_ms: float = 10_000.0,
+        admission: bool = True,
+        admission_point_concurrency: int = 32,
+        admission_heavy_concurrency: int = 8,
+        admission_write_concurrency: int = 16,
+        admission_internal_concurrency: int = 128,
+        admission_queue_depth: int = 64,
     ):
         self.data_dir = data_dir
         self.host = host
@@ -128,6 +134,24 @@ class Server:
             ),
             query_timeout_ms=query_timeout_ms,
         )
+        # Admission control ([net] admission-*, net/admission.py):
+        # per-cost-class concurrency gates + bounded queues in front of
+        # the executor, shedding 429 + Retry-After when predicted queue
+        # wait exceeds the request's remaining deadline.  Remote map
+        # legs ride a separate internal priority lane so a saturated
+        # cluster cannot distributed-livelock.
+        self.admission = None
+        if admission:
+            from pilosa_tpu.net.admission import AdmissionController
+
+            self.admission = AdmissionController(
+                point_concurrency=admission_point_concurrency,
+                heavy_concurrency=admission_heavy_concurrency,
+                write_concurrency=admission_write_concurrency,
+                internal_concurrency=admission_internal_concurrency,
+                queue_depth=admission_queue_depth,
+                stats=stats,
+            )
 
         self.holder = Holder(data_dir)
         self.executor: Executor | None = None
@@ -243,6 +267,7 @@ class Server:
             tracer=self.tracer,
             slow_query_ms=self.slow_query_ms,
             resilience=self.resilience,
+            admission=self.admission,
         )
         # ONE provider feeds both /state (the stream fallback's pull
         # endpoint, any cluster type) and gossip's piggybacked state —

@@ -1,0 +1,460 @@
+"""Admission control under storm (net/admission.py).
+
+Covers the three contracts the ISSUE demands:
+
+* a saturated class gate answers 429 + Retry-After BEFORE any
+  coalescer/device work (asserted via the exec.coalesce.launches
+  counter staying flat across a shed);
+* remote map legs ride the internal priority lane and are never shed
+  behind client traffic (livelock regression over 2 real HTTP nodes);
+* a node that sheds even internal traffic degrades an ``allowPartial``
+  query correctly — and never trips the coordinator's breaker.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from pilosa_tpu import config as config_mod
+from pilosa_tpu.cluster import broadcast as bc
+from pilosa_tpu.cluster.topology import Cluster
+from pilosa_tpu.exec import plan
+from pilosa_tpu.net import admission as adm
+from pilosa_tpu.net import resilience as rz
+from pilosa_tpu.net.client import InternalClient
+from pilosa_tpu.net.server import Server
+from pilosa_tpu.obs.stats import ExpvarStatsClient
+from pilosa_tpu.pql.parser import parse_string
+
+
+# ---------------------------------------------------------------------------
+# cost classes
+# ---------------------------------------------------------------------------
+
+
+class TestCostClass:
+    @pytest.mark.parametrize(
+        "pql,want",
+        [
+            ('Count(Bitmap(frame="f", rowID=1))', plan.COST_POINT),
+            ('Bitmap(frame="f", rowID=1)', plan.COST_POINT),
+            (
+                'Intersect(Bitmap(rowID=1), Union(Bitmap(rowID=2), Bitmap(rowID=3)))',
+                plan.COST_POINT,
+            ),
+            ('TopN(frame="f", n=5)', plan.COST_HEAVY),
+            ('Sum(frame="f", field="v")', plan.COST_HEAVY),
+            ('Min(frame="f", field="v")', plan.COST_HEAVY),
+            # Range nested anywhere makes the tree heavy.
+            ('Count(Range(frame="f", v > 3))', plan.COST_HEAVY),
+            (
+                'Count(Intersect(Bitmap(rowID=1), Range(frame="f", v > 3)))',
+                plan.COST_HEAVY,
+            ),
+            ('SetBit(frame="f", rowID=1, columnID=2)', plan.COST_WRITE),
+            # write wins over heavy in a mixed batch
+            (
+                'SetBit(frame="f", rowID=1, columnID=2) TopN(frame="f", n=5)',
+                plan.COST_WRITE,
+            ),
+        ],
+    )
+    def test_classification(self, pql, want):
+        assert plan.cost_class(parse_string(pql).calls) == want
+
+
+# ---------------------------------------------------------------------------
+# gate unit behavior
+# ---------------------------------------------------------------------------
+
+
+class TestGate:
+    def test_fast_path_admit_and_release(self):
+        ac = adm.AdmissionController(point_concurrency=2, queue_depth=4)
+        t1 = ac.acquire(adm.CLASS_POINT)
+        t2 = ac.acquire(adm.CLASS_POINT)
+        snap = ac.snapshot()[adm.CLASS_POINT]
+        assert snap["active"] == 2 and snap["admitted"] == 2
+        t1.release()
+        t2.release()
+        t2.release()  # idempotent
+        assert ac.snapshot()[adm.CLASS_POINT]["active"] == 0
+
+    def test_queue_full_sheds_with_retry_after(self):
+        ac = adm.AdmissionController(point_concurrency=1, queue_depth=0)
+        t = ac.acquire(adm.CLASS_POINT)
+        with pytest.raises(rz.ShedError) as ei:
+            ac.acquire(adm.CLASS_POINT)
+        assert ei.value.retry_after_s > 0
+        assert ei.value.cost_class == adm.CLASS_POINT
+        assert ac.snapshot()[adm.CLASS_POINT]["shed"] == 1
+        t.release()
+        ac.acquire(adm.CLASS_POINT).release()
+
+    def test_deadline_aware_shed_before_queueing(self):
+        ac = adm.AdmissionController(point_concurrency=1, queue_depth=64)
+        gate = ac.gate(adm.CLASS_POINT)
+        gate._ewma_ms = 1000.0  # pretend service takes a second
+        t = ac.acquire(adm.CLASS_POINT)
+        # 50 ms of budget cannot cover a ~1 s predicted wait: shed NOW.
+        with pytest.raises(rz.ShedError):
+            ac.acquire(adm.CLASS_POINT, deadline=rz.Deadline.after_ms(50))
+        t.release()
+
+    def test_queue_wait_then_admit(self):
+        ac = adm.AdmissionController(point_concurrency=1, queue_depth=4)
+        t = ac.acquire(adm.CLASS_POINT)
+        got = {}
+
+        def waiter():
+            tk = ac.acquire(adm.CLASS_POINT)
+            got["wait_ms"] = tk.wait_ms
+            tk.release()
+
+        th = threading.Thread(target=waiter)
+        th.start()
+        deadline = time.time() + 2.0
+        while time.time() < deadline:
+            if ac.snapshot()[adm.CLASS_POINT]["queued"] == 1:
+                break
+            time.sleep(0.005)
+        time.sleep(0.05)
+        t.release()
+        th.join(timeout=2.0)
+        assert not th.is_alive()
+        assert got["wait_ms"] >= 40.0
+
+    def test_deadline_expiry_in_queue_sheds(self):
+        ac = adm.AdmissionController(point_concurrency=1, queue_depth=4)
+        t = ac.acquire(adm.CLASS_POINT)
+        gate = ac.gate(adm.CLASS_POINT)
+        gate._ewma_ms = 0.1  # prediction says the wait is tiny...
+        t0 = time.monotonic()
+        with pytest.raises(rz.ShedError):
+            # ...but nobody releases: the waiter sheds at ITS deadline,
+            # not after burning any work.
+            ac.acquire(adm.CLASS_POINT, deadline=rz.Deadline.after_ms(80))
+        assert time.monotonic() - t0 < 1.0
+        assert ac.snapshot()[adm.CLASS_POINT]["queued"] == 0
+        t.release()
+
+    def test_ewma_feedback(self):
+        ac = adm.AdmissionController(point_concurrency=1, queue_depth=0)
+        gate = ac.gate(adm.CLASS_POINT)
+        before = gate._ewma_ms
+        t = ac.acquire(adm.CLASS_POINT)
+        time.sleep(0.05)
+        t.release()
+        assert gate._ewma_ms != before  # observed service time folded in
+
+
+# ---------------------------------------------------------------------------
+# config knobs
+# ---------------------------------------------------------------------------
+
+
+class TestConfig:
+    def test_toml_roundtrip(self):
+        cfg = config_mod.from_toml(
+            "[net]\n"
+            "admission = false\n"
+            "admission-point-concurrency = 3\n"
+            "admission-heavy-concurrency = 2\n"
+            "admission-write-concurrency = 4\n"
+            "admission-internal-concurrency = 9\n"
+            "admission-queue-depth = 7\n"
+        )
+        assert cfg.net.admission is False
+        assert cfg.net.admission_point_concurrency == 3
+        assert cfg.net.admission_internal_concurrency == 9
+        assert "admission-queue-depth = 7" in cfg.to_toml()
+        cfg.validate()
+
+    def test_validation(self):
+        cfg = config_mod.Config()
+        cfg.net.admission_point_concurrency = 0
+        with pytest.raises(config_mod.ConfigError):
+            cfg.validate()
+
+    def test_env_overlay(self):
+        cfg = config_mod.apply_env(
+            config_mod.Config(),
+            environ={"PILOSA_NET_ADMISSION_QUEUE_DEPTH": "5"},
+        )
+        assert cfg.net.admission_queue_depth == 5
+
+
+# ---------------------------------------------------------------------------
+# single node over HTTP: shed before any device work
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def tight_server(tmp_path):
+    """One-slot gates, zero queue: the second concurrent request of any
+    client class MUST shed."""
+    s = Server(
+        data_dir=str(tmp_path / "data"),
+        host="127.0.0.1:0",
+        anti_entropy_interval=3600,
+        polling_interval=3600,
+        cache_flush_interval=3600,
+        stats=ExpvarStatsClient(),
+        admission_point_concurrency=1,
+        admission_heavy_concurrency=1,
+        admission_write_concurrency=1,
+        admission_queue_depth=0,
+    )
+    s.open()
+    s.holder.create_index_if_not_exists("i")
+    s.holder.index("i").create_frame_if_not_exists("f")
+    s.holder.frame("i", "f").set_bit("standard", 1, 10)
+    yield s
+    s.close()
+
+
+def _raw_query(host: str, pql: str, headers: dict | None = None):
+    """(status, headers, parsed-json-body) without the client's
+    ShedError translation — tests assert the raw HTTP contract."""
+    req = urllib.request.Request(
+        f"http://{host}/index/i/query", data=pql.encode(), method="POST",
+        headers=headers or {},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, dict(resp.headers), json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read())
+
+
+class TestServerShedding:
+    def _counts(self, server) -> dict:
+        return server.stats.snapshot()["counts"]
+
+    def test_saturated_sheds_429_before_coalescer(self, tight_server):
+        s = tight_server
+        q = 'Count(Bitmap(frame="f", rowID=1))'
+        # Warm once so the coalescer counter is live.
+        status, _, body = _raw_query(s.host, q)
+        assert status == 200 and body["results"] == [1]
+        launches_before = self._counts(s).get("exec.coalesce.launches", 0)
+
+        ticket = s.admission.acquire(adm.CLASS_POINT)
+        try:
+            status, headers, body = _raw_query(s.host, q)
+        finally:
+            ticket.release()
+        assert status == 429
+        assert int(headers["Retry-After"]) >= 1
+        assert body["retryAfterMs"] > 0
+        assert "admission" in body["error"]
+        # The shed happened BEFORE the executor/coalescer: no launch.
+        counts = self._counts(s)
+        assert counts.get("exec.coalesce.launches", 0) == launches_before
+        assert counts.get("net.admission.shed[class:point]", 0) == 1
+
+        # Slot free again: the same query succeeds.
+        status, _, body = _raw_query(s.host, q)
+        assert status == 200 and body["results"] == [1]
+
+    def test_classes_gate_independently(self, tight_server):
+        s = tight_server
+        ticket = s.admission.acquire(adm.CLASS_POINT)
+        try:
+            # point saturated; heavy still admits
+            status, _, _ = _raw_query(s.host, 'TopN(frame="f", n=2)')
+            assert status == 200
+        finally:
+            ticket.release()
+
+    def test_import_value_sheds_write_class(self, tight_server):
+        s = tight_server
+        payload = json.dumps(
+            {
+                "index": "i", "frame": "f", "field": "x",
+                "slice": 0, "columnIDs": [1], "values": [2],
+            }
+        ).encode()
+        ticket = s.admission.acquire(adm.CLASS_WRITE)
+        try:
+            req = urllib.request.Request(
+                f"http://{s.host}/import-value", data=payload, method="POST"
+            )
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=10)
+            assert ei.value.code == 429
+            assert "Retry-After" in dict(ei.value.headers)
+        finally:
+            ticket.release()
+
+    def test_health_and_metrics_surface_queue_state(self, tight_server):
+        s = tight_server
+        with urllib.request.urlopen(
+            f"http://{s.host}/debug/health", timeout=10
+        ) as resp:
+            health = json.loads(resp.read())
+        assert set(health["admission"]) == set(adm.CLASSES)
+        assert health["admission"]["point"]["concurrency"] == 1
+        with urllib.request.urlopen(
+            f"http://{s.host}/metrics", timeout=10
+        ) as resp:
+            metrics = resp.read().decode()
+        assert 'net_admission_active{class="point"}' in metrics
+        assert 'net_admission_queued{class="heavy"}' in metrics
+
+    def test_admission_span_in_trace(self, tight_server):
+        s = tight_server
+        _raw_query(s.host, 'Count(Bitmap(frame="f", rowID=1))')
+        names = {
+            sp["name"]
+            for tr in s.tracer.traces()
+            for sp in tr["spans"]
+        }
+        assert "admission" in names
+
+    def test_shed_does_not_trip_breaker(self, tight_server):
+        """A healthy-but-busy host answering 429 must stay breaker-
+        closed on the caller side, even with a hair-trigger breaker."""
+        s = tight_server
+        breakers = rz.BreakerRegistry(failure_threshold=1)
+        client = InternalClient(s.host, timeout=10.0, breakers=breakers)
+        ticket = s.admission.acquire(adm.CLASS_POINT)
+        try:
+            for _ in range(3):
+                with pytest.raises(rz.ShedError) as ei:
+                    client.execute_query(
+                        "i", 'Count(Bitmap(frame="f", rowID=1))'
+                    )
+                assert ei.value.retry_after_s > 0
+            assert breakers.state(s.host) == rz.STATE_CLOSED
+        finally:
+            ticket.release()
+        # And the host still serves: shed never poisoned anything.
+        assert client.execute_pql(
+            "i", 'Count(Bitmap(frame="f", rowID=1))'
+        ) == 1
+
+
+# ---------------------------------------------------------------------------
+# two real HTTP nodes: internal priority + degraded reads
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def two_tight_servers(tmp_path):
+    recv0, recv1 = bc.HTTPBroadcastReceiver(), bc.HTTPBroadcastReceiver()
+    b0, b1 = bc.HTTPBroadcaster([]), bc.HTTPBroadcaster([])
+    servers = []
+    for i, (recv, b) in enumerate(((recv0, b0), (recv1, b1))):
+        s = Server(
+            data_dir=str(tmp_path / f"n{i}"),
+            cluster=Cluster(replica_n=1),
+            broadcaster=b,
+            broadcast_receiver=recv,
+            anti_entropy_interval=3600,
+            polling_interval=3600,
+            cache_flush_interval=3600,
+            stats=ExpvarStatsClient(),
+            retry_backoff_ms=10,
+            admission_point_concurrency=1,
+            admission_heavy_concurrency=1,
+            admission_write_concurrency=1,
+            admission_queue_depth=0,
+            admission_internal_concurrency=2,
+        )
+        s.open()
+        servers.append(s)
+    s0, s1 = servers
+    b0.internal_hosts.append(recv1.bound_host)
+    b1.internal_hosts.append(recv0.bound_host)
+    for s in servers:
+        for host in sorted([s0.host, s1.host]):
+            if s.cluster.node_by_host(host) is None:
+                s.cluster.add_node(host)
+        s.cluster.nodes.sort(key=lambda n: n.host)
+    yield s0, s1
+    s0.close()
+    s1.close()
+
+
+def _seed_distributed(s0, s1, n_slices=6):
+    from pilosa_tpu.ops.bitplane import SLICE_WIDTH
+
+    for s in (s0, s1):
+        s.holder.create_index_if_not_exists("i")
+        s.holder.index("i").create_frame_if_not_exists("f")
+    for sl in range(n_slices):
+        owner = s0.cluster.fragment_nodes("i", sl)[0].host
+        srv = s0 if owner == s0.host else s1
+        srv.holder.frame("i", "f").set_bit("standard", 1, sl * SLICE_WIDTH)
+    for s in (s0, s1):
+        s.holder.index("i").set_remote_max_slice(n_slices - 1)
+    # sanity: both nodes own something
+    owned1 = [
+        sl for sl in range(n_slices)
+        if s0.cluster.fragment_nodes("i", sl)[0].host == s1.host
+    ]
+    assert owned1, "placement gave node 1 nothing; widen n_slices"
+    return n_slices, owned1
+
+
+class TestInternalPriority:
+    def test_map_legs_never_shed_behind_client_traffic(
+        self, two_tight_servers
+    ):
+        """Livelock regression: every CLIENT gate on the remote node is
+        saturated, yet a coordinator fan-out still answers — remote map
+        legs ride the internal lane."""
+        s0, s1 = two_tight_servers
+        n_slices, _ = _seed_distributed(s0, s1)
+        tickets = [
+            s1.admission.acquire(cls)
+            for cls in (adm.CLASS_POINT, adm.CLASS_HEAVY, adm.CLASS_WRITE)
+        ]
+        try:
+            c0 = InternalClient(s0.host, timeout=15.0)
+            got = c0.execute_pql("i", 'Count(Bitmap(frame="f", rowID=1))')
+            assert got == n_slices
+        finally:
+            for t in tickets:
+                t.release()
+        # the remote legs really did admit through the internal lane
+        counts = s1.stats.snapshot()["counts"]
+        assert counts.get("net.admission.admitted[class:internal]", 0) >= 1
+
+    def test_internal_shed_degrades_allow_partial(self, two_tight_servers):
+        """A node saturated PAST its internal lane sheds map legs; the
+        coordinator treats that as a node failure: allowPartial reduces
+        over the survivors, and the shed never trips s1's breaker."""
+        s0, s1 = two_tight_servers
+        n_slices, owned1 = _seed_distributed(s0, s1)
+        # Saturate the internal lane for an immediate shed (no queue).
+        s1.admission.gate(adm.CLASS_INTERNAL).queue_depth = 0
+        tickets = [
+            s1.admission.acquire(adm.CLASS_INTERNAL) for _ in range(2)
+        ]
+        try:
+            status, headers, body = _raw_query(
+                s0.host,
+                'Count(Bitmap(frame="f", rowID=1))',
+                headers={"X-Allow-Partial": "true"},
+            )
+            assert status == 200
+            assert body["partial"] is True
+            assert sorted(body["missingSlices"]) == sorted(owned1)
+            assert body["results"] == [n_slices - len(owned1)]
+            # shedding is not a breaker event on the coordinator
+            assert (
+                s0.resilience.breakers.state(s1.host) == rz.STATE_CLOSED
+            )
+        finally:
+            for t in tickets:
+                t.release()
+        # Lane free again: the same query is whole.
+        status, _, body = _raw_query(
+            s0.host, 'Count(Bitmap(frame="f", rowID=1))'
+        )
+        assert status == 200 and body["results"] == [n_slices]

@@ -170,6 +170,52 @@ class TestRetryPolicy:
         # A 5 s base backoff must have been clamped to the ~0.1 s budget.
         assert time.monotonic() - t0 < 1.0
 
+    def test_shed_retried_honoring_retry_after(self):
+        """A 429 shed retries like a transport failure, but never
+        sooner than the server's Retry-After hint."""
+        calls = []
+
+        def busy():
+            calls.append(time.monotonic())
+            if len(calls) < 3:
+                raise rz.ShedError("busy", retry_after_s=0.05)
+            return "ok"
+
+        policy = rz.RetryPolicy(attempts=3, backoff=0.001, jitter=0.0)
+        t0 = time.monotonic()
+        assert policy.call(
+            busy, retryable=rz.TRANSPORT_ERRORS + (rz.ShedError,)
+        ) == "ok"
+        assert len(calls) == 3
+        # Two waits, each at least the 50 ms hint.
+        assert time.monotonic() - t0 >= 0.09
+
+    def test_shed_beyond_budget_propagates_for_failover(self):
+        """Retry-After longer than the remaining deadline: propagate
+        the ShedError immediately (the caller fails over to a replica)
+        instead of sleeping into a guaranteed 504."""
+        calls = []
+
+        def busy():
+            calls.append(1)
+            raise rz.ShedError("busy", retry_after_s=10.0)
+
+        policy = rz.RetryPolicy(attempts=5, backoff=0.001, jitter=0.0)
+        t0 = time.monotonic()
+        with rz.deadline_scope(rz.Deadline.after_ms(200)):
+            with pytest.raises(rz.ShedError):
+                policy.call(
+                    busy, retryable=rz.TRANSPORT_ERRORS + (rz.ShedError,)
+                )
+        assert len(calls) == 1
+        assert time.monotonic() - t0 < 1.0
+
+    def test_shed_is_node_failure_but_not_5xx(self):
+        e = rz.ShedError("busy", retry_after_s=0.5)
+        assert rz.is_node_failure(e)  # eligible for replica failover
+        assert e.status == 429
+        assert e.retry_after_s == 0.5
+
 
 # ---------------------------------------------------------------------------
 # circuit breakers

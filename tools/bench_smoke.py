@@ -35,6 +35,8 @@ def main() -> int:
         BENCH_SKIP_RESTART_PROBE="1",
         BENCH_SKIP_CLUSTER_TIER="1",
         BENCH_SKIP_HBM_TIER="1",
+        # The open-loop storm tier has its own smoke (make load-smoke).
+        BENCH_SKIP_ADMISSION_TIER="1",
     )
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py")],
