@@ -5,7 +5,7 @@
 
 PYTHON ?= python
 
-.PHONY: default test lint analyze typecheck check bench bench-smoke chaos-smoke load-smoke install build docker clean generate
+.PHONY: default test lint analyze typecheck check bench bench-smoke chaos-smoke load-smoke resize-smoke churn-soak install build docker clean generate
 
 default: build test
 
@@ -82,6 +82,22 @@ chaos-smoke:
 # artifact).  Non-blocking in CI (.github/workflows/check.yml).
 load-smoke:
 	$(PYTHON) tools/load_smoke.py
+
+# Tiny CPU live-resize pass (tools/resize_smoke.py): two real nodes
+# grow to three under a concurrent writer; asserts checksummed query
+# results before == after, zero dropped writes, the new node owns
+# slices, and the sources released theirs.  BLOCKING in CI
+# (.github/workflows/check.yml), alongside chaos-smoke.
+resize-smoke:
+	$(PYTHON) tools/resize_smoke.py
+
+# Gossip churn soak (tools/churn_soak.py): 20-50 virtual members under
+# seeded datagram loss + member flapping; asserts membership converges
+# on exactly the live set each cycle with zero false-DOWNs of
+# reachable members.  The deterministic tier-1 slice lives in
+# tests/test_churn.py; this is the big dial-a-size soak.
+churn-soak:
+	$(PYTHON) tools/churn_soak.py
 
 docker:
 	docker build -t pilosa-tpu .

@@ -367,6 +367,43 @@ def main() -> None:
         except Exception as e:
             log(f"admission tier failed: {e}")
 
+    # Rebalance tier: live 2->3 grow under sustained load, one process
+    # per node (tools/rebalance_bench.py) — read p50/p99 during the
+    # background slice migration vs steady state, migration seconds,
+    # zero-lost-writes and byte-identical-results checks.  Host-side
+    # like the other cluster tiers; runs before this process touches
+    # the device.
+    rebalance_tier = None
+    if os.environ.get("BENCH_SKIP_REBALANCE_TIER") != "1":
+        import subprocess
+
+        rbt = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "tools",
+            "rebalance_bench.py",
+        )
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+        try:
+            out = subprocess.run(
+                [sys.executable, rbt], env=env, capture_output=True,
+                timeout=900, text=True,
+            )
+            if out.returncode == 0 and out.stdout.strip():
+                for line in out.stderr.strip().splitlines():
+                    log(line)
+                rebalance_tier = json.loads(out.stdout.strip().splitlines()[-1])
+                log(
+                    "rebalance tier: migration "
+                    f"{rebalance_tier['migration_s']}s, read p99 "
+                    f"{rebalance_tier['p99_ratio']}x steady, "
+                    f"{rebalance_tier['writes_lost']} writes lost"
+                )
+            else:
+                log(f"rebalance tier failed: rc={out.returncode} "
+                    f"stderr={out.stderr.strip()[-300:]!r}")
+        except Exception as e:
+            log(f"rebalance tier failed: {e}")
+
     total_columns = int(os.environ.get("BENCH_COLUMNS", 1_000_000_000))
     n_slices = (total_columns + SLICE_WIDTH - 1) // SLICE_WIDTH  # 954
     log(f"backend={jax.default_backend()} devices={jax.devices()}")
@@ -672,6 +709,8 @@ def main() -> None:
         out["cluster_tpu"] = cluster_tpu
     if admission_storm is not None:
         out["admission_storm"] = admission_storm
+    if rebalance_tier is not None:
+        out["rebalance"] = rebalance_tier
     out["program_cache"] = {
         "entries": plan.program_cache_stats(),
         "bounds": plan.program_cache_bounds(),

@@ -130,6 +130,11 @@ def build_server(cfg: config_mod.Config):
         admission_write_concurrency=cfg.net.admission_write_concurrency,
         admission_internal_concurrency=cfg.net.admission_internal_concurrency,
         admission_queue_depth=cfg.net.admission_queue_depth,
+        rebalance_throttle_mbps=cfg.cluster.rebalance_throttle_mbps,
+        rebalance_verify_rounds=cfg.cluster.rebalance_verify_rounds,
+        rebalance_delta_cap=cfg.cluster.rebalance_delta_cap,
+        rebalance_release_delay_ms=cfg.cluster.rebalance_release_delay_ms,
+        rebalance_on_join=cfg.cluster.rebalance_on_join,
     )
 
 
@@ -614,6 +619,59 @@ def run_bench(args) -> int:
         f" p95 {p95*1e3:.2f} ms (result: {shown})"
     )
     return 0
+
+
+# ---------------------------------------------------------------------------
+# resize — live cluster grow/drain (pilosa_tpu/rebalance)
+# ---------------------------------------------------------------------------
+
+
+def run_resize(args) -> int:
+    """Drive a live topology change: POST /cluster/resize with the
+    complete target host list (grow = current + joiners, drain =
+    current - leavers), then optionally poll /debug/rebalance until the
+    background migration completes."""
+    import json as _json
+
+    client = _client(args.host)
+
+    def status() -> dict:
+        st, data = client._request("GET", "/debug/rebalance")
+        return _json.loads(client._check(st, data))
+
+    if args.status:
+        print(_json.dumps(status(), indent=2, sort_keys=True))
+        return 0
+    if args.abort:
+        st, data = client._request("POST", "/cluster/resize/abort")
+        client._check(st, data)
+        print("resize aborted", file=sys.stderr)
+        return 0
+    hosts = [h.strip() for h in (args.hosts or "").split(",") if h.strip()]
+    if not hosts:
+        raise CommandError("--hosts required (the complete target host list)")
+    st, data = client._request(
+        "POST", "/cluster/resize", body=_json.dumps({"hosts": hosts}).encode()
+    )
+    client._check(st, data)
+    print(f"resize to {hosts} started", file=sys.stderr)
+    if not args.wait:
+        print("poll with: pilosa-tpu resize --status", file=sys.stderr)
+        return 0
+    while True:
+        snap = status()
+        if not snap.get("running"):
+            coord = snap.get("coordinator") or {}
+            if coord.get("error") or snap.get("lastError"):
+                raise CommandError(
+                    f"migration stopped: {coord.get('error') or snap['lastError']}"
+                )
+            if snap.get("transition") is None:
+                print("resize complete", file=sys.stderr)
+                return 0
+        states = (snap.get("coordinator") or {}).get("sliceStates", {})
+        print(f"migrating: {states}", file=sys.stderr)
+        time.sleep(1.0)
 
 
 # ---------------------------------------------------------------------------

@@ -125,6 +125,31 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--topn-n", type=int, default=100, help="topn result size")
     p.set_defaults(fn=ctl.run_bench)
 
+    p = sub.add_parser(
+        "resize",
+        help="live cluster resize: grow/drain the ring with background "
+        "slice migration (--hosts = the COMPLETE target host list)",
+    )
+    _add_host(p)
+    p.add_argument(
+        "--hosts",
+        default="",
+        help="comma-separated target host list (omit with --status/--abort)",
+    )
+    p.add_argument(
+        "--abort", action="store_true",
+        help="abort the in-flight resize (reverse-migrates flipped slices)",
+    )
+    p.add_argument(
+        "--status", action="store_true",
+        help="print the /debug/rebalance migration status and exit",
+    )
+    p.add_argument(
+        "--wait", action="store_true",
+        help="block until the migration completes (polls /debug/rebalance)",
+    )
+    p.set_defaults(fn=ctl.run_resize)
+
     p = sub.add_parser("sort", help="sort a CSV file by slice for import")
     p.add_argument("path", help="CSV file ('-' = stdin)")
     p.set_defaults(fn=ctl.run_sort)

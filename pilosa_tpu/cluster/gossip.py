@@ -55,6 +55,8 @@ import time
 import uuid
 from collections import OrderedDict
 
+from pilosa_tpu.testing import faults
+
 # State blobs up to this many raw bytes inline in PING/ACK datagrams;
 # larger ones are advertised by digest and fetched chunked (a single UDP
 # datagram tops out at ~65507 bytes and base64 inflates 4/3).
@@ -236,7 +238,10 @@ class GossipNodeSet:
             t.start()
             self._threads.append(t)
         if self.seed:
-            self._send(
+            # Best-effort: a join datagram lost to the network is
+            # re-sent by the tick loop for as long as this node knows
+            # only itself (memberlist likewise retries joins).
+            self._send_logged(
                 _parse_addr(self.seed),
                 {"t": "join", "from": self.host, "gaddr": _fmt_addr(self.advertise)},
             )
@@ -340,27 +345,47 @@ class GossipNodeSet:
         with self._mu:
             return {h: dict(m) for h, m in self._members.items()}
 
-    def _register(self, host: str, addr) -> None:
+    def _register(self, host: str, addr, age_s: float = 0.0) -> None:
+        """Record a liveness report for ``host``.  ``age_s`` is how old
+        the report is: 0 for direct contact (a datagram from the member
+        itself), the reporter's time-since-last-heard for third-party
+        vouches (_merge_members).  last_seen only moves FORWARD to
+        ``now - age_s`` — a stale vouch can never refresh a member past
+        fresher local evidence, so a dead member's silence accumulates
+        cluster-wide instead of peers mutually resurrecting it with
+        stale 'UP' reports forever (the false-ALIVE dual of a
+        false-DOWN storm; caught by the churn soak)."""
+        now = time.monotonic()
+        seen = now - max(age_s, 0.0)
         changed = False
         with self._mu:
             m = self._members.get(host)
             if m is None:
+                fresh = age_s <= self.suspect_after
                 self._members[host] = {
                     "addr": tuple(addr),
-                    "last_seen": time.monotonic(),
-                    "state": "UP",
+                    "last_seen": seen,
+                    # A member discovered through an already-stale vouch
+                    # starts SUSPECT: it must prove liveness within a
+                    # probe window rather than being presumed UP.
+                    "state": "UP" if fresh else "SUSPECT",
                 }
-                changed = True
+                changed = fresh
             else:
                 m["addr"] = tuple(addr)
-                m["last_seen"] = time.monotonic()
-                if m["state"] != "UP":
-                    # Only DOWN->UP is externally visible: SUSPECT
-                    # collapses to UP at the _notify boundary, so a
-                    # SUSPECT->UP refresh must not fire a spurious
-                    # membership callback every probe cycle.
-                    changed = m["state"] == "DOWN"
-                    m["state"] = "UP"
+                if seen > m["last_seen"]:
+                    m["last_seen"] = seen
+                    if (
+                        m["state"] != "UP"
+                        and now - m["last_seen"] <= self.suspect_after
+                    ):
+                        # Only DOWN->UP is externally visible: SUSPECT
+                        # collapses to UP at the _notify boundary, so a
+                        # SUSPECT->UP refresh must not fire a spurious
+                        # membership callback every probe cycle.
+                        changed = m["state"] == "DOWN"
+                        m["state"] = "UP"
+                        m.pop("suspect_since", None)
         if changed:
             self._notify()
 
@@ -380,6 +405,12 @@ class GossipNodeSet:
 
     def _send(self, addr, obj: dict) -> None:
         if self._sock is not None:
+            # Chaos hook (testing/faults.py): the datagram-send
+            # boundary.  ``mode=drop``/``error`` with seeded ``prob``
+            # injects deterministic datagram loss per SENDER (host =
+            # this node's identity, path = the message type) — the
+            # churn-soak's lossy network.
+            faults.check("gossip.send", host=self.host, path=obj.get("t"))
             data = json.dumps(obj).encode()
             self._sock.sendto(data, tuple(addr))
             self.stats.count("gossip.sent")
@@ -398,19 +429,33 @@ class GossipNodeSet:
             )
 
     def _member_list(self) -> list[dict]:
+        now = time.monotonic()
         return [
-            {"host": h, "gaddr": _fmt_addr(m["addr"]), "state": m["state"]}
+            {
+                "host": h,
+                "gaddr": _fmt_addr(m["addr"]),
+                "state": m["state"],
+                # Age of this liveness report: receivers refresh
+                # last_seen to (their now - age), never backwards.
+                "age": round(now - m["last_seen"], 3),
+            }
             for h, m in self._snapshot().items()
         ]
 
     def _merge_members(self, members: list[dict]) -> None:
         """Adopt third-party liveness reports: a peer vouching UP for a
-        member refreshes its last_seen, so liveness scales with cluster
-        size instead of requiring direct contact with every node each
-        suspect window (memberlist-style indirect confirmation)."""
+        member refreshes its last_seen BY THE REPORT'S AGE, so liveness
+        scales with cluster size (memberlist-style indirect
+        confirmation) while a dead member's growing silence still
+        accumulates everywhere — stale vouches cannot keep a corpse
+        alive."""
         for m in members:
             if m.get("state") == "UP" and m["host"] != self.host:
-                self._register(m["host"], _parse_addr(m["gaddr"]))
+                try:
+                    age = max(float(m.get("age", 0.0)), 0.0)
+                except (TypeError, ValueError):
+                    age = 0.0
+                self._register(m["host"], _parse_addr(m["gaddr"]), age_s=age)
 
     def _rx_loop(self) -> None:
         while not self._closing.is_set():
@@ -854,12 +899,24 @@ class GossipNodeSet:
 
     def _tick_loop(self) -> None:
         while not self._closing.wait(self.gossip_interval):
-            # probe a random live peer
+            # A node that still knows only itself re-sends its join —
+            # the original datagram may have been lost (memberlist
+            # retries joins the same way).
             peers = [
                 (h, m)
                 for h, m in self._snapshot().items()
                 if h != self.host
             ]
+            if not peers and self.seed:
+                self._send_logged(
+                    _parse_addr(self.seed),
+                    {
+                        "t": "join",
+                        "from": self.host,
+                        "gaddr": _fmt_addr(self.advertise),
+                    },
+                )
+            # probe a random live peer
             if peers:
                 host, member = random.choice(peers)
                 self._send_logged(

@@ -18,7 +18,7 @@ compatible while intra-node reduces ride ICI collectives.
 from __future__ import annotations
 
 import threading
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 # reference: cluster.go:22-31
 DEFAULT_PARTITION_N = 256
@@ -55,6 +55,26 @@ def jump_hash(key: int, n: int) -> int:
     return b
 
 
+class TopologyError(RuntimeError):
+    """Illegal topology mutation (membership change outside the
+    versioned-transition API, conflicting transitions, ...)."""
+
+
+class MixedEpochError(TopologyError):
+    """A query observed two different topology epochs while routing —
+    the ring changed under it.  Queries must fail loudly here instead of
+    silently reducing over a half-old, half-new placement."""
+
+    def __init__(self, expected: int, actual: int):
+        super().__init__(
+            f"query observed a mixed-epoch route: routing started at "
+            f"topology epoch {expected}, cluster is now at {actual}; "
+            "retry the query"
+        )
+        self.expected = expected
+        self.actual = actual
+
+
 @dataclass
 class Node:
     """One cluster member (reference: cluster.go:40-45)."""
@@ -70,8 +90,31 @@ class Node:
         return {"host": self.host, "internalHost": self.internal_host}
 
 
+@dataclass
+class Transition:
+    """A topology change in flight: the old ring (``Cluster.nodes``) and
+    the new ring coexist; reads route on the old ring until a slice is
+    flipped (checksum-verified on its new owner), writes go to BOTH
+    rings' owners, and ``moved`` records the slices whose ownership has
+    already cut over.  Both rings stay valid until commit — a crashed
+    coordinator mid-copy strands nothing."""
+
+    epoch: int
+    old_hosts: list[str]
+    new_hosts: list[str]
+    new_nodes: list[Node]
+    moved: set = field(default_factory=set)  # {(index, slice)}
+
+
 class Cluster:
-    """Node list + placement functions (reference: cluster.go:122-258)."""
+    """Node list + placement functions (reference: cluster.go:122-258).
+
+    Membership is VERSIONED: every ring mutation (``add_node`` at boot,
+    transition begin/commit) bumps ``epoch``, and per-slice ownership
+    flips during a transition bump ``routing_version``.  Routing caches
+    key on ``routing_version``; a query captures ``epoch`` once and
+    fails loudly (:class:`MixedEpochError`) if the ring moved under it.
+    """
 
     def __init__(
         self,
@@ -86,6 +129,144 @@ class Cluster:
         self.long_query_time = long_query_time
         self.node_set = None  # membership backend; wired by the server
         self._mu = threading.Lock()
+        self._epoch = 0
+        self._routing_version = 0
+        self._transition: Transition | None = None
+
+    # --- versioned topology --------------------------------------------
+
+    @property
+    def epoch(self) -> int:
+        """Ring version: bumped on every node-list mutation (boot-time
+        add_node, transition begin, transition commit/abort)."""
+        return self._epoch
+
+    @property
+    def routing_version(self) -> int:
+        """Placement version: bumps with ``epoch`` AND on every
+        per-slice ownership flip — the cache key for slice->node maps."""
+        return self._routing_version
+
+    @property
+    def transition(self) -> Transition | None:
+        return self._transition
+
+    def begin_transition(
+        self, new_hosts: list[str], epoch: int | None = None
+    ) -> Transition:
+        """Install a topology transition: the current node list stays
+        the read ring, ``new_hosts`` becomes the target ring.  Epoch is
+        the coordinator-assigned transition token (fanned to every node
+        so all members agree on the transition identity); re-applying
+        the same transition is idempotent."""
+        new_hosts = sorted(dict.fromkeys(new_hosts))
+        if not new_hosts:
+            raise TopologyError("transition needs at least one host")
+        with self._mu:
+            t = self._transition
+            if t is not None:
+                if t.new_hosts == new_hosts:
+                    return t  # idempotent re-apply (coordinator resume)
+                raise TopologyError(
+                    f"transition to {t.new_hosts} already in flight "
+                    f"(epoch {t.epoch}); abort it before starting another"
+                )
+            e = epoch if epoch is not None else self._epoch + 1
+            by_host = {n.host: n for n in self.nodes}
+            new_nodes = []
+            for h in new_hosts:
+                n = by_host.get(h)
+                if n is None:
+                    n = Node(host=h, state=NODE_STATE_UP)
+                new_nodes.append(n)
+            t = Transition(
+                epoch=e,
+                old_hosts=[n.host for n in self.nodes],
+                new_hosts=new_hosts,
+                new_nodes=new_nodes,
+            )
+            self._transition = t
+            self._epoch = max(self._epoch + 1, e)
+            self._routing_version += 1
+            return t
+
+    def flip_slice(self, index: str, slice_i: int, epoch: int) -> bool:
+        """Atomically cut one slice's ownership over to the new ring.
+        Returns False (idempotent no-op) when no matching transition is
+        active — a replayed flip after commit must not error."""
+        with self._mu:
+            t = self._transition
+            if t is None or t.epoch != epoch:
+                return False
+            t.moved.add((index, slice_i))
+            self._routing_version += 1
+            return True
+
+    def unflip_slice(self, index: str, slice_i: int, epoch: int) -> bool:
+        """Reverse one slice's cutover (abort path)."""
+        with self._mu:
+            t = self._transition
+            if t is None or t.epoch != epoch:
+                return False
+            t.moved.discard((index, slice_i))
+            self._routing_version += 1
+            return True
+
+    def commit_transition(self, epoch: int) -> None:
+        """Swap the new ring in as THE ring and end the transition."""
+        with self._mu:
+            t = self._transition
+            if t is None:
+                return  # idempotent (replayed commit)
+            if t.epoch != epoch:
+                raise TopologyError(
+                    f"commit for epoch {epoch} but transition is {t.epoch}"
+                )
+            self.nodes = sorted(t.new_nodes, key=lambda n: n.host)
+            self._transition = None
+            self._epoch = max(self._epoch + 1, epoch + 1)
+            self._routing_version += 1
+
+    def abort_transition(self, epoch: int | None = None) -> None:
+        """Drop the transition, keeping the OLD ring authoritative.
+        Refuses while flipped slices exist — they route to the new ring
+        and must be migrated back (unflipped) first, or the abort would
+        orphan their data."""
+        with self._mu:
+            t = self._transition
+            if t is None:
+                return
+            if epoch is not None and t.epoch != epoch:
+                return
+            if t.moved:
+                raise TopologyError(
+                    f"cannot abort transition {t.epoch}: "
+                    f"{len(t.moved)} slice(s) already flipped to the new "
+                    "ring; reverse-migrate them first"
+                )
+            self._transition = None
+            self._epoch += 1
+            self._routing_version += 1
+
+    def transition_snapshot(self) -> dict | None:
+        """JSON-able transition state (persisted across restarts so a
+        crashed node rejoins with both rings intact)."""
+        with self._mu:
+            t = self._transition
+            if t is None:
+                return None
+            return {
+                "epoch": t.epoch,
+                "old": list(t.old_hosts),
+                "new": list(t.new_hosts),
+                "moved": sorted([i, s] for i, s in t.moved),
+            }
+
+    def restore_transition(self, snap: dict) -> None:
+        """Re-install a persisted transition (crash recovery)."""
+        self.begin_transition(list(snap["new"]), epoch=int(snap["epoch"]))
+        for idx, s in snap.get("moved", []):
+            self.flip_slice(str(idx), int(s), int(snap["epoch"]))
 
     # --- membership -----------------------------------------------------
 
@@ -96,15 +277,28 @@ class Cluster:
         return None
 
     def add_node(self, host: str) -> Node:
-        """Idempotently register a host, keeping the list sorted so every
-        member computes the same ring (reference: cluster.go:176-187)."""
+        """Idempotently register a host at BOOT time, keeping the list
+        sorted so every member computes the same ring (reference:
+        cluster.go:176-187).  This is part of the versioned-topology
+        API: an actual mutation bumps the epoch, and any membership
+        change while a rebalance transition is in flight is rejected
+        loudly — the transition machinery (begin/flip/commit) is the
+        only legal way to reshape a serving ring."""
         with self._mu:
             n = self.node_by_host(host)
             if n is not None:
                 return n
+            if self._transition is not None:
+                raise TopologyError(
+                    f"cannot add node {host!r}: rebalance transition "
+                    f"(epoch {self._transition.epoch}) in flight — "
+                    "membership changes go through /cluster/resize"
+                )
             n = Node(host=host)
             self.nodes.append(n)
             self.nodes.sort(key=lambda x: x.host)
+            self._epoch += 1
+            self._routing_version += 1
             return n
 
     def node_states(self) -> dict[str, str]:
@@ -130,28 +324,89 @@ class Cluster:
     def hosts(self) -> list[str]:
         return [n.host for n in self.nodes]
 
+    def route_nodes(self) -> list[Node]:
+        """Every node a query may route to right now: the read ring
+        plus, during a transition, the new ring's additional nodes
+        (flipped slices already route to them)."""
+        t = self._transition
+        if t is None:
+            return list(self.nodes)
+        seen = {n.host for n in self.nodes}
+        return list(self.nodes) + [
+            n for n in t.new_nodes if n.host not in seen
+        ]
+
     # --- placement (reference: cluster.go:200-258) ----------------------
 
     def partition(self, index: str, slice_i: int) -> int:
         data = index.encode() + slice_i.to_bytes(8, "big")
         return fnv64a(data) % self.partition_n
 
-    def partition_nodes(self, partition_id: int) -> list[Node]:
+    def partition_nodes_over(
+        self, partition_id: int, nodes: list[Node]
+    ) -> list[Node]:
+        """Jump-hash owner list over an EXPLICIT ring — the one
+        placement implementation both rings of a transition share."""
+        if not nodes:
+            return []
         replica_n = self.replica_n
-        if replica_n > len(self.nodes):
-            replica_n = len(self.nodes)
+        if replica_n > len(nodes):
+            replica_n = len(nodes)
         elif replica_n == 0:
             replica_n = 1
-        node_index = jump_hash(partition_id, len(self.nodes))
+        node_index = jump_hash(partition_id, len(nodes))
         return [
-            self.nodes[(node_index + i) % len(self.nodes)] for i in range(replica_n)
+            nodes[(node_index + i) % len(nodes)] for i in range(replica_n)
         ]
 
+    def partition_nodes(self, partition_id: int) -> list[Node]:
+        return self.partition_nodes_over(partition_id, self.nodes)
+
     def fragment_nodes(self, index: str, slice_i: int) -> list[Node]:
-        return self.partition_nodes(self.partition(index, slice_i))
+        """READ owners of a slice: the old ring until the slice's
+        cutover flips (its fragment is checksum-verified on the new
+        owner), the new ring after."""
+        t = self._transition
+        ring = self.nodes
+        if t is not None and (index, slice_i) in t.moved:
+            ring = t.new_nodes
+        return self.partition_nodes_over(self.partition(index, slice_i), ring)
+
+    def new_ring_nodes(self, index: str, slice_i: int) -> list[Node]:
+        """Owners of a slice on the transition's NEW ring ([] when no
+        transition is active)."""
+        t = self._transition
+        if t is None:
+            return []
+        return self.partition_nodes_over(
+            self.partition(index, slice_i), t.new_nodes
+        )
+
+    def write_nodes(self, index: str, slice_i: int) -> list[Node]:
+        """WRITE targets of a slice: during a transition every write is
+        applied on BOTH rings' owners (the old ring keeps serving reads,
+        the new owner accumulates state ahead of its cutover), so no
+        write is lost whichever ring ultimately serves it."""
+        t = self._transition
+        out = self.fragment_nodes(index, slice_i)
+        if t is None:
+            return out
+        seen = {n.host for n in out}
+        for n in self.partition_nodes_over(
+            self.partition(index, slice_i), t.new_nodes
+        ):
+            if n.host not in seen:
+                seen.add(n.host)
+                out = out + [n]
+        return out
 
     def owns_fragment(self, host: str, index: str, slice_i: int) -> bool:
         return any(n.host == host for n in self.fragment_nodes(index, slice_i))
+
+    def is_write_owner(self, host: str, index: str, slice_i: int) -> bool:
+        """Ownership guard for the write/import paths: during a
+        transition the new ring's owners accept writes too."""
+        return any(n.host == host for n in self.write_nodes(index, slice_i))
 
     def split_by_owner(
         self, index: str, slices, hosts: set[str]
@@ -168,23 +423,32 @@ class Cluster:
 
     def owns_slices(self, index: str, max_slice: int, host: str) -> list[int]:
         """Slices whose *primary* owner is ``host`` (reference:
-        cluster.go:246-258)."""
+        cluster.go:246-258) — transition-aware: a flipped slice's
+        primary comes from the new ring."""
         out = []
         for i in range(max_slice + 1):
-            p = self.partition(index, i)
-            node_index = jump_hash(p, len(self.nodes))
-            if self.nodes[node_index].host == host:
+            owners = self.fragment_nodes(index, i)
+            if owners and owners[0].host == host:
                 out.append(i)
         return out
 
     def status_dict(self) -> dict:
         self.node_states()
-        return {
+        out = {
             "nodes": [
                 {"host": n.host, "internalHost": n.internal_host, "state": n.state}
                 for n in self.nodes
-            ]
+            ],
+            "epoch": self._epoch,
         }
+        t = self._transition
+        if t is not None:
+            out["transition"] = {
+                "epoch": t.epoch,
+                "newHosts": list(t.new_hosts),
+                "movedSlices": len(t.moved),
+            }
+        return out
 
 
 def new_cluster(n: int) -> Cluster:
@@ -192,5 +456,5 @@ def new_cluster(n: int) -> Cluster:
     nodes (reference: cluster_test.go:146-176)."""
     c = Cluster()
     for i in range(n):
-        c.nodes.append(Node(host=f"host{i}:0"))
+        c.add_node(f"host{i}:0")
     return c

@@ -134,6 +134,35 @@ def _notify_close(frag) -> None:
             pass
 
 
+# Fragment WRITE listeners: called with (fragment, set_rows, set_cols,
+# clear_rows, clear_cols) — absolute column ids — after every
+# successful content change (point writes, bulk imports, sync merges).
+# The rebalance delta log rides this hook to capture the write stream
+# of a migrating slice; when nothing is registered the cost is one
+# list-truthiness check per write.
+_write_listeners: list = []
+_write_listeners_mu = threading.Lock()
+
+
+def register_write_listener(fn) -> None:
+    with _write_listeners_mu:
+        if fn not in _write_listeners:
+            _write_listeners.append(fn)
+
+
+def unregister_write_listener(fn) -> None:
+    with _write_listeners_mu:
+        _write_listeners[:] = [f for f in _write_listeners if f is not fn]
+
+
+def _notify_write(frag, set_rows, set_cols, clear_rows, clear_cols) -> None:
+    for fn in list(_write_listeners):
+        try:
+            fn(frag, set_rows, set_cols, clear_rows, clear_cols)
+        except Exception:  # noqa: BLE001 — listeners must not break writes
+            pass
+
+
 def _apply_pending(dev, pending):
     """Fold queued point writes into one device scatter.
 
@@ -1259,6 +1288,8 @@ class Fragment:
                     # reference: fragment.go:421-423
                     self.stats.gauge("rows", float(self._max_row_id))
                 self._maybe_promote(row_id)
+                if _write_listeners:
+                    _notify_write(self, (row_id,), (column_id,), (), ())
             return changed
 
     def clear_bit(self, row_id: int, column_id: int) -> bool:
@@ -1278,6 +1309,8 @@ class Fragment:
                 self._append_op(roaring.OP_REMOVE, pos)
                 self._after_write(row_id, -1)
                 self.stats.count("clearBit")  # reference: fragment.go:470
+                if _write_listeners:
+                    _notify_write(self, (), (), (row_id,), (column_id,))
             return changed
 
     def _sparse_insert(self, row_id: int, offset: int) -> bool:
@@ -1484,6 +1517,10 @@ class Fragment:
             self.cache.invalidate()
             self.cache.recalculate()
             self.stats.count("ImportBit", len(row_ids))  # ref: fragment.go:969
+            if _write_listeners:
+                _notify_write(
+                    self, row_ids, column_ids, clear_row_ids, clear_column_ids
+                )
             self.snapshot()
 
     def snapshot(self) -> None:

@@ -146,6 +146,25 @@ class View:
             self.on_create_slice(self.index, self.name, slice_i)
         return frag
 
+    def remove_fragment(self, slice_i: int) -> bool:
+        """Drop one fragment from service and DELETE its backing files
+        — the rebalance source-release path: the fragment's device
+        mirror/sparse rows deregister from the HBM pool (close), and
+        its disk footprint returns.  Returns False when the slice has
+        no fragment here."""
+        with self._mu:
+            frag = self._fragments.pop(slice_i, None)
+        if frag is None:
+            return False
+        # close() outside the view lock (it notifies close listeners).
+        frag.close()
+        for path in (frag.path, frag.cache_path):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        return True
+
     # --- writes (reference: view.go:262-279) ---
 
     def set_bit(self, row_id: int, column_id: int) -> bool:

@@ -42,6 +42,7 @@ import numpy as np
 from pilosa_tpu import bsi
 from pilosa_tpu import device as device_mod
 from pilosa_tpu.bsi import ripple
+from pilosa_tpu.cluster import topology as topo
 from pilosa_tpu.cluster.topology import Cluster, Node
 from pilosa_tpu.parallel import mesh as pmesh
 from pilosa_tpu.core import cache as cache_mod
@@ -1803,8 +1804,10 @@ class Executor:
         return [s for s in slices if s in have]
 
     def _all_slices_local(self, index: str, slices: list[int]) -> bool:
+        rn = getattr(self.cluster, "route_nodes", None)
+        nodes = rn() if rn is not None else list(self.cluster.nodes)
         try:
-            m = self._slices_by_node(list(self.cluster.nodes), index, slices)
+            m = self._slices_by_node(nodes, index, slices)
         except SliceUnavailableError:
             return False
         return set(m.keys()) == {self.host}
@@ -2026,6 +2029,14 @@ class Executor:
             parts.append(
                 (frag, topt, cand_ids, cand_mask, st, sub_ref, srcw, src_slot)
             )
+        # "scores" memoizes the fetched count vectors for as long as
+        # the ENTRY validates (fragments unchanged since build =>
+        # scores unchanged); "score_event" single-flights the fused
+        # scorer across concurrent queries of this entry (leader
+        # scores, everyone else waits on the event — never on a lock),
+        # so a 32-query storm of one TopN shape pays ONE
+        # dispatch+fetch, not 32 — the topn.fetch residual ROADMAP 5
+        # names.
         return {"parts": parts}
 
     def _execute_topn_folded(
@@ -2060,13 +2071,53 @@ class Executor:
             st = replace(st_proto, counts=None, dev_counts=None)
             states.append((frag, topt, cand_ids, cand_mask, st))
             score_parts.append((st, sub_ref, srcw, src_slot))
-        # Pin the prep entry and every scored fragment's mirror for the
-        # fused scorer's dispatch+fetch: the pool may evict none of the
-        # planes this program reads mid-query.
-        pin_keys = [self._topn_pool_key((index, str(c), tuple(slices)))]
-        pin_keys += [p[0]._pool_key for p in ent["parts"]]
-        with device_mod.pool().pinned(*pin_keys):
-            self._score_topn_parts(score_parts)
+        # Score ONCE per validated entry: concurrent queries of the
+        # same TopN shape single-flight (one leader dispatches +
+        # fetches; everyone else waits on an Event — never on a lock —
+        # and reuses the fetched count vectors).  Scores stay valid
+        # exactly as long as the entry does: entry validation already
+        # proved the scored fragments unchanged since build.
+        with self.tracer.span("topn.score", parts=len(score_parts)) as sp:
+            scores = None
+            leader = False
+            ev = None
+            with self._batch_mu:
+                scores = ent.get("scores")
+                if scores is None:
+                    ev = ent.get("score_event")
+                    if ev is None:
+                        ev = ent["score_event"] = threading.Event()
+                        leader = True
+            if scores is None and not leader:
+                # A leader is scoring right now; its fetched vectors
+                # arrive with the event.  A failed leader leaves
+                # scores unset — fall through and score directly.
+                ev.wait(timeout=coalesce_mod.RESULT_TIMEOUT_S)
+                with self._batch_mu:
+                    scores = ent.get("scores")
+            if scores is None:
+                try:
+                    # Pin the prep entry and every scored fragment's
+                    # mirror for the fused scorer's dispatch+fetch: the
+                    # pool may evict none of the planes this program
+                    # reads mid-query.
+                    pin_keys = [
+                        self._topn_pool_key((index, str(c), tuple(slices)))
+                    ]
+                    pin_keys += [p[0]._pool_key for p in ent["parts"]]
+                    with device_mod.pool().pinned(*pin_keys):
+                        self._score_topn_parts(score_parts)
+                    with self._batch_mu:
+                        ent["scores"] = [p[0].counts for p in score_parts]
+                    sp.annotate(score_cache="computed")
+                finally:
+                    if leader:
+                        ev.set()
+            else:
+                for part, cnts in zip(score_parts, scores):
+                    part[0].counts = cnts
+                sp.annotate(score_cache="shared")
+                self.holder.stats.count("exec.topn.scoreShared")
 
         # Phase-1 winner selection per slice, from the same scores the
         # two-phase protocol's first round would have produced for the
@@ -2352,9 +2403,19 @@ class Executor:
     def _write_one_view(
         self, index, c, opt, view, write_fn, row_id, col_id
     ) -> bool:
+        # write_nodes: the read owners plus, during a rebalance
+        # transition, the slice's NEW-ring owners — every write is
+        # applied on both rings so no write is lost whichever ring
+        # ultimately serves it (the delta log covers the copy race).
         slice_i = col_id // bp.SLICE_WIDTH
         ret = False
-        for node in self.cluster.fragment_nodes(index, slice_i):
+        wn = getattr(self.cluster, "write_nodes", None)
+        targets = (
+            wn(index, slice_i)
+            if wn is not None
+            else self.cluster.fragment_nodes(index, slice_i)
+        )
+        for node in targets:
             if node.host == self.host:
                 if write_fn(view, row_id, col_id):
                     ret = True
@@ -2436,8 +2497,12 @@ class Executor:
 
     def _broadcast_query(self, index: str, q: Query, opt: ExecOptions) -> None:
         """Forward a query to every other node in parallel; first error
-        wins (reference: executor.go:966-985)."""
-        others = [n for n in self.cluster.nodes if n.host != self.host]
+        wins (reference: executor.go:966-985).  During a rebalance
+        transition the new ring's joining nodes receive the broadcast
+        too (attribute state must be complete there at cutover)."""
+        rn = getattr(self.cluster, "route_nodes", None)
+        all_nodes = rn() if rn is not None else self.cluster.nodes
+        others = [n for n in all_nodes if n.host != self.host]
         if not others:
             return
         futures = [
@@ -2452,14 +2517,31 @@ class Executor:
     # ------------------------------------------------------------------
 
     def _slices_by_node(
-        self, nodes: list[Node], index: str, slices: list[int]
+        self,
+        nodes: list[Node],
+        index: str,
+        slices: list[int],
+        epoch: int | None = None,
     ) -> dict[str, tuple[Node, list[int]]]:
-        """Group slices by owning node, CACHED per (node set, index,
-        slice list): placement is pure in those inputs (fnv + jump hash,
-        reference: cluster.go:202-244), and at bench scale re-hashing
-        ~1000 slices per query costs more host time than the compiled
-        query program.  Callers treat the result as read-only."""
-        key = (tuple(n.host for n in nodes), index, tuple(slices))
+        """Group slices by owning node, CACHED per (routing version,
+        node set, index, slice list): placement is pure in those inputs
+        (fnv + jump hash, reference: cluster.go:202-244), and at bench
+        scale re-hashing ~1000 slices per query costs more host time
+        than the compiled query program.  Callers treat the result as
+        read-only.
+
+        The cluster's ``routing_version`` keys the cache (per-slice
+        cutover flips during a rebalance change placement without an
+        epoch bump), and ``epoch`` — when the caller captured one at
+        query start — is verified here: a ring mutation mid-query
+        raises :class:`~pilosa_tpu.cluster.topology.MixedEpochError`
+        loudly instead of reducing over a half-old, half-new route."""
+        rv = getattr(self.cluster, "routing_version", 0)
+        if epoch is not None:
+            cur = getattr(self.cluster, "epoch", 0)
+            if cur != epoch:
+                raise topo.MixedEpochError(epoch, cur)
+        key = (rv, tuple(n.host for n in nodes), index, tuple(slices))
         with self._batch_mu:
             hit = self._slice_group_cache.get(key)
             if hit is not None:
@@ -2489,9 +2571,19 @@ class Executor:
         A slow or dead node therefore never delays reducing the fast
         nodes' results: completion order drives the reduce loop
         (FIRST_COMPLETED waits), and failover work is resubmitted while
-        the healthy nodes' mappers are still in flight."""
+        the healthy nodes' mappers are still in flight.
+
+        Routing is EPOCH-GUARDED: the topology epoch is captured once
+        here, and every (re)grouping — including failover re-placement
+        — verifies it, so a ring mutation mid-query fails loudly
+        instead of mixing epochs."""
+        epoch0 = getattr(self.cluster, "epoch", None)
         if not opt.remote:
-            nodes = list(self.cluster.nodes)
+            # route_nodes = the read ring plus, during a rebalance
+            # transition, the new ring's joining nodes (flipped slices
+            # already route to them).
+            rn = getattr(self.cluster, "route_nodes", None)
+            nodes = rn() if rn is not None else list(self.cluster.nodes)
         else:
             me = self.cluster.node_by_host(self.host)
             nodes = [me] if me is not None else [Node(host=self.host)]
@@ -2512,7 +2604,7 @@ class Executor:
         missing: list[int] = []
 
         def _submit(avail_nodes, want) -> None:
-            m = self._slices_by_node(avail_nodes, index, want)
+            m = self._slices_by_node(avail_nodes, index, want, epoch=epoch0)
             for _, (node, node_slices) in m.items():
                 fut = self._pool.submit(
                     self._map_node, node, node_slices, index, c, opt, map_fn
@@ -2545,7 +2637,7 @@ class Executor:
             if placeable:
                 _submit(remaining, placeable)
 
-        m = self._slices_by_node(nodes, index, slices)
+        m = self._slices_by_node(nodes, index, slices, epoch=epoch0)
         if len(m) == 1:
             # Single target (the whole single-node case): run the
             # mapper inline.  A pool hop would add a context switch

@@ -428,10 +428,17 @@ class InternalClient:
         )
         return json.loads(self._check(status, data))["fields"]
 
-    def fragment_nodes(self, index: str, slice_i: int) -> list[dict]:
-        status, data = self._request(
-            "GET", "/fragment/nodes", query={"index": index, "slice": slice_i}
-        )
+    def fragment_nodes(
+        self, index: str, slice_i: int, write: bool = False
+    ) -> list[dict]:
+        """Owners of a slice; ``write=True`` asks for the WRITE target
+        set — during a rebalance transition that includes the new
+        ring's owners, so import fan-outs dual-write migrating
+        slices."""
+        query: dict = {"index": index, "slice": slice_i}
+        if write:
+            query["write"] = "true"
+        status, data = self._request("GET", "/fragment/nodes", query=query)
         return json.loads(self._check(status, data))
 
     # ------------------------------------------------------------------
@@ -477,7 +484,7 @@ class InternalClient:
                     [b[2] if len(b) > 2 and b[2] else 0 for b in bits]
                 )
         payload = pb.SerializeToString()
-        nodes = self.fragment_nodes(index, slice_i)
+        nodes = self.fragment_nodes(index, slice_i, write=True)
         if not nodes:
             raise ClientError(500, f"no nodes for slice {slice_i}")
         errs = []
@@ -531,7 +538,7 @@ class InternalClient:
                 "values": np.asarray(values, dtype=np.int64).tolist(),
             }
         ).encode()
-        nodes = self.fragment_nodes(index, slice_i)
+        nodes = self.fragment_nodes(index, slice_i, write=True)
         if not nodes:
             raise ClientError(500, f"no nodes for slice {slice_i}")
         errs = []
@@ -629,15 +636,20 @@ class InternalClient:
             return src.read()
 
     def restore_slice_from(
-        self, index: str, frame: str, view: str, slice_i: int, reader
+        self, index: str, frame: str, view: str, slice_i: int, reader,
+        stage: bool = False,
     ) -> None:
         """POST one fragment archive off ``reader`` with a chunked body
-        — constant memory on both ends."""
+        — constant memory on both ends.  ``stage=True`` (the rebalance
+        bulk-copy path) asks the receiver to register the restored
+        fragment's HBM mirror through its background staging lane."""
+        query: dict = {
+            "index": index, "frame": frame, "view": view, "slice": slice_i,
+        }
+        if stage:
+            query["stage"] = "true"
         status, data = self._request_chunked(
-            "POST",
-            "/fragment/data",
-            reader,
-            query={"index": index, "frame": frame, "view": view, "slice": slice_i},
+            "POST", "/fragment/data", reader, query=query
         )
         self._check(status, data)
 

@@ -159,6 +159,7 @@ class Handler:
         slow_query_ms: float = 0.0,
         resilience=None,
         admission=None,
+        rebalance=None,
     ):
         self.holder = holder
         self.executor = executor
@@ -185,6 +186,15 @@ class Handler:
         # 429 + Retry-After BEFORE any coalescer/device work.  None =
         # admit everything (bare handler / tests).
         self.admission = admission
+        # Elastic-cluster rebalancer (pilosa_tpu/rebalance): topology
+        # events, resize coordination, delta-log/copy/release
+        # endpoints, /debug/rebalance.  None = static cluster surface
+        # (the endpoints answer 501).
+        self.rebalance = rebalance
+        # Staging-lane prefetcher (device/prefetch.py), wired by the
+        # Server: fragments restored with ?stage=true (migration
+        # arrivals) register their HBM mirrors through it.
+        self.prefetcher = None
         # Chunk size for streamed (chunked transfer encoding) bodies:
         # CSV export and fragment archives move in writes of this size.
         self.stream_chunk_bytes = stream_chunk_bytes or stream_mod.DEFAULT_CHUNK_BYTES
@@ -228,6 +238,12 @@ class Handler:
             ("GET", r"/fragment/blocks", self.handle_get_fragment_blocks),
             ("POST", r"/fragment/import-view", self.handle_post_import_view),
             ("GET", r"/fragment/block/data", self.handle_get_fragment_block_data),
+            ("POST", r"/cluster/resize", self.handle_post_resize),
+            ("POST", r"/cluster/resize/abort", self.handle_post_resize_abort),
+            ("POST", r"/cluster/topology", self.handle_post_topology),
+            ("POST", r"/rebalance/delta", self.handle_post_rebalance_delta),
+            ("POST", r"/rebalance/release", self.handle_post_rebalance_release),
+            ("GET", r"/debug/rebalance", self.handle_get_rebalance),
             ("GET", r"/debug/vars", self.handle_get_vars),
             ("GET", r"/debug/health", self.handle_get_health),
             ("GET", r"/debug/hbm", self.handle_get_hbm),
@@ -685,10 +701,11 @@ class Handler:
         ) != len(vals):
             return Response.error("columnIDs/values must be equal-length lists", 400)
         if self.cluster is not None and self.executor is not None:
-            owners = {
-                n.host for n in self.cluster.fragment_nodes(index, slice_i)
-            }
-            if self.executor.host not in owners:
+            # Write-ownership guard: during a rebalance transition the
+            # new ring's owners accept imports too (dual-write cutover).
+            if not self.cluster.is_write_owner(
+                self.executor.host, index, slice_i
+            ):
                 return Response.error(
                     f"host does not own slice {self.executor.host}"
                     f" slice={slice_i}",
@@ -967,12 +984,12 @@ class Handler:
             pb.ParseFromString(req.body)
         except Exception as e:  # noqa: BLE001
             return Response.error(str(e), 400)
-        # Ownership guard (reference: handler.go:1004).
+        # Ownership guard (reference: handler.go:1004) — write-ring
+        # aware: a migration target accepts imports before its cutover.
         if self.cluster is not None and self.executor is not None:
-            owners = {
-                n.host for n in self.cluster.fragment_nodes(pb.Index, pb.Slice)
-            }
-            if self.executor.host not in owners:
+            if not self.cluster.is_write_owner(
+                self.executor.host, pb.Index, pb.Slice
+            ):
                 return Response.error(
                     f"host does not own slice {self.executor.host}"
                     f" slice={pb.Slice}",
@@ -1017,10 +1034,11 @@ class Handler:
         except Exception as e:  # noqa: BLE001
             return Response.error(str(e), 400)
         if self.cluster is not None and self.executor is not None:
-            owners = {
-                n.host for n in self.cluster.fragment_nodes(pb.Index, pb.Slice)
-            }
-            if self.executor.host not in owners:
+            # Write-ring aware: delta-log replay pushes land on the
+            # migration target before (and after) its cutover.
+            if not self.cluster.is_write_owner(
+                self.executor.host, pb.Index, pb.Slice
+            ):
                 return Response.error(
                     f"host does not own slice {self.executor.host}"
                     f" slice={pb.Slice}",
@@ -1077,12 +1095,18 @@ class Handler:
     # ------------------------------------------------------------------
 
     def handle_get_fragment_nodes(self, req: Request) -> Response:
+        """Owners of a slice.  ``?write=true`` answers the WRITE target
+        set instead — during a rebalance transition that is both rings'
+        owners, so import fan-outs dual-write migrating slices."""
         index = req.query.get("index", "")
         try:
             slice_i = int(req.query.get("slice", ""))
         except ValueError:
             return Response.error("invalid slice", 400)
-        nodes = self.cluster.fragment_nodes(index, slice_i)
+        if req.query.get("write") == "true":
+            nodes = self.cluster.write_nodes(index, slice_i)
+        else:
+            nodes = self.cluster.fragment_nodes(index, slice_i)
         return Response.json([n.to_dict() for n in nodes])
 
     def _fragment_from_query(self, req: Request):
@@ -1111,21 +1135,36 @@ class Handler:
 
     @stream_body
     def handle_post_fragment_data(self, req: Request) -> Response:
-        index = req.query.get("index", "")
-        frame = req.query.get("frame", "")
-        view = req.query.get("view", "")
-        slice_s = req.query.get("slice", "")
-        if not slice_s.isdigit():
-            return Response.error("slice required", 400)
-        f = self.holder.frame(index, frame)
-        if f is None:
-            return Response.error("frame not found", 404)
-        vw = f.create_view_if_not_exists(view)
-        frag = vw.create_fragment_if_not_exists(int(slice_s))
-        # The tar reader pulls straight off the request body stream —
-        # a chunked restore applies archive entries as they arrive.
-        frag.read_from(req.body_reader())
-        return Response.json({})
+        """Fragment restore — operator backup/restore AND the rebalance
+        bulk-copy arrival path.  Rides the internal admission lane
+        (cluster data-plane traffic must not starve behind a client
+        write storm); ``?stage=true`` (migration arrivals) hands the
+        restored fragment to the HBM staging lane so its mirror
+        registers with the PlanePool in the background."""
+        ticket, shed = self._admit(adm.CLASS_INTERNAL, req)
+        if shed is not None:
+            return shed
+        try:
+            index = req.query.get("index", "")
+            frame = req.query.get("frame", "")
+            view = req.query.get("view", "")
+            slice_s = req.query.get("slice", "")
+            if not slice_s.isdigit():
+                return Response.error("slice required", 400)
+            f = self.holder.frame(index, frame)
+            if f is None:
+                return Response.error("frame not found", 404)
+            vw = f.create_view_if_not_exists(view)
+            frag = vw.create_fragment_if_not_exists(int(slice_s))
+            # The tar reader pulls straight off the request body stream —
+            # a chunked restore applies archive entries as they arrive.
+            frag.read_from(req.body_reader())
+            if req.query.get("stage") == "true" and self.prefetcher is not None:
+                self.prefetcher.stage([frag])
+            return Response.json({})
+        finally:
+            if ticket is not None:
+                ticket.release()
 
     def handle_get_fragment_blocks(self, req: Request) -> Response:
         frag, err = self._fragment_from_query(req)
@@ -1152,6 +1191,112 @@ class Handler:
         out.RowIDs.extend(int(r) for r in ps.row_ids)
         out.ColumnIDs.extend(int(c) for c in ps.column_ids)
         return Response.proto(out)
+
+    # ------------------------------------------------------------------
+    # elastic cluster: resize / topology events / migration data plane
+    # ------------------------------------------------------------------
+
+    def handle_post_resize(self, req: Request) -> Response:
+        """Operator entry: start (or resume) a live resize.  Body:
+        ``{"hosts": ["h1:p", "h2:p", ...]}`` — the COMPLETE target host
+        list (grow = current + new, drain = current - leaving).  The
+        receiving node becomes the migration coordinator; progress at
+        GET /debug/rebalance."""
+        if self.rebalance is None:
+            return Response.error("rebalance not configured", 501)
+        try:
+            payload = json.loads(req.body or b"{}")
+        except json.JSONDecodeError as e:
+            return Response.error(str(e), 400)
+        hosts = payload.get("hosts")
+        if not isinstance(hosts, list) or not all(
+            isinstance(h, str) and h for h in hosts
+        ):
+            return Response.error("hosts must be a non-empty string list", 400)
+        try:
+            return Response.json(self.rebalance.start_resize(hosts))
+        except Exception as e:  # noqa: BLE001 — operator boundary
+            return Response.error(str(e), 409)
+
+    def handle_post_resize_abort(self, req: Request) -> Response:
+        if self.rebalance is None:
+            return Response.error("rebalance not configured", 501)
+        try:
+            return Response.json(self.rebalance.abort())
+        except Exception as e:  # noqa: BLE001 — operator boundary
+            return Response.error(str(e), 409)
+
+    def handle_post_topology(self, req: Request) -> Response:
+        """Internal fan-out target for topology events (begin / flip /
+        unflip / commit / abort) — rides the internal admission lane so
+        cutover control can never starve behind client traffic."""
+        if self.rebalance is None:
+            return Response.error("rebalance not configured", 501)
+        ticket, shed = self._admit(adm.CLASS_INTERNAL, req)
+        if shed is not None:
+            return shed
+        try:
+            payload = json.loads(req.body or b"{}")
+            return Response.json(self.rebalance.apply_event(payload))
+        except Exception as e:  # noqa: BLE001 — peer boundary
+            return Response.error(str(e), 400)
+        finally:
+            if ticket is not None:
+                ticket.release()
+
+    def handle_post_rebalance_delta(self, req: Request) -> Response:
+        """Internal migration control on a SOURCE (or checksum on any
+        node): start/stop the slice's delta log, bulk-copy the slice's
+        fragments to a target, replay the drained log, or report
+        per-view checksums.  Internal admission lane."""
+        if self.rebalance is None:
+            return Response.error("rebalance not configured", 501)
+        ticket, shed = self._admit(adm.CLASS_INTERNAL, req)
+        if shed is not None:
+            return shed
+        try:
+            payload = json.loads(req.body or b"{}")
+            return Response.json(self.rebalance.delta_action(payload))
+        except Exception as e:  # noqa: BLE001 — peer boundary
+            return Response.error(str(e), 400)
+        finally:
+            if ticket is not None:
+                ticket.release()
+
+    def handle_post_rebalance_release(self, req: Request) -> Response:
+        """Internal: drop a migrated-away slice's fragments (HBM + disk
+        returned).  Refused while this node still owns the slice."""
+        if self.rebalance is None:
+            return Response.error("rebalance not configured", 501)
+        ticket, shed = self._admit(adm.CLASS_INTERNAL, req)
+        if shed is not None:
+            return shed
+        try:
+            payload = json.loads(req.body or b"{}")
+            return Response.json(
+                self.rebalance.release_slice(
+                    str(payload.get("index", "")), int(payload.get("slice", 0))
+                )
+            )
+        except Exception as e:  # noqa: BLE001 — peer boundary
+            return Response.error(str(e), 409)
+        finally:
+            if ticket is not None:
+                ticket.release()
+
+    def handle_get_rebalance(self, req: Request) -> Response:
+        """Migration observability: topology epoch + transition, the
+        coordinator's per-slice state machine, delta-log occupancy, and
+        gossip join candidates."""
+        if self.rebalance is None:
+            return Response.json(
+                {
+                    "transition": None,
+                    "running": False,
+                    "note": "rebalance not configured",
+                }
+            )
+        return Response.json(self.rebalance.snapshot())
 
     # ------------------------------------------------------------------
     # debug

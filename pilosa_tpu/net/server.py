@@ -73,6 +73,11 @@ class Server:
         admission_write_concurrency: int = 16,
         admission_internal_concurrency: int = 128,
         admission_queue_depth: int = 64,
+        rebalance_throttle_mbps: float = 0.0,
+        rebalance_verify_rounds: int = 3,
+        rebalance_delta_cap: int = 50_000,
+        rebalance_release_delay_ms: float = 200.0,
+        rebalance_on_join: bool = False,
     ):
         self.data_dir = data_dir
         self.host = host
@@ -154,6 +159,21 @@ class Server:
             )
 
         self.holder = Holder(data_dir)
+        # Elastic-cluster rebalancer ([cluster] rebalance-*,
+        # pilosa_tpu/rebalance): applies fanned-out topology events on
+        # every node and coordinates background slice migration on the
+        # node that receives POST /cluster/resize.  The bandwidth
+        # throttle keeps bulk copies from starving client traffic; the
+        # release delay lets in-flight old-ring reads drain before a
+        # migrated-away slice's data goes.
+        self.rebalance_throttle_mbps = rebalance_throttle_mbps
+        self.rebalance_verify_rounds = rebalance_verify_rounds
+        self.rebalance_delta_cap = rebalance_delta_cap
+        self.rebalance_release_delay_ms = rebalance_release_delay_ms
+        self.rebalance_on_join = rebalance_on_join
+        from pilosa_tpu.rebalance import Rebalancer
+
+        self.rebalance = Rebalancer(self)
         self.executor: Executor | None = None
         self.handler: Handler | None = None
         self._http = None
@@ -268,7 +288,16 @@ class Server:
             slow_query_ms=self.slow_query_ms,
             resilience=self.resilience,
             admission=self.admission,
+            rebalance=self.rebalance,
         )
+        # Migration arrivals (?stage=true restores) register their HBM
+        # mirrors through the background staging lane.
+        self.handler.prefetcher = device_mod.prefetcher()
+        # The rebalance delta log captures the write stream of every
+        # actively-migrating slice from the fragment write hook.
+        from pilosa_tpu.core import fragment as fragment_mod
+
+        fragment_mod.register_write_listener(self.rebalance.delta_log.record)
         # ONE provider feeds both /state (the stream fallback's pull
         # endpoint, any cluster type) and gossip's piggybacked state —
         # the digest gossip advertises must be of the exact blob /state
@@ -283,9 +312,26 @@ class Server:
         if port == 0:
             self.host = f"{bind_host or addr[0]}:{addr[1]}"
 
-        # Self-register in the cluster (reference: server.go:117-125).
+        # Self-register in the cluster (reference: server.go:117-125) —
+        # UNLESS a ring is already configured that this host is not
+        # part of: that is a JOINING node (it would fork placement if
+        # it inserted itself), which receives ownership only through a
+        # rebalance transition (POST /cluster/resize).
         if self.cluster.node_by_host(self.host) is None:
-            self.cluster.add_node(self.host)
+            if self.cluster.nodes:
+                self.logger(
+                    f"host {self.host} is not in the configured ring "
+                    f"({len(self.cluster.nodes)} nodes); joining — slice "
+                    "ownership arrives via /cluster/resize"
+                )
+            else:
+                self.cluster.add_node(self.host)
+
+        # Crash recovery: a persisted in-flight topology transition
+        # (both rings + flipped slices) restores BEFORE the first query
+        # routes; migration resumes when the operator re-issues the
+        # resize.
+        self.rebalance.resume_from_disk()
 
         self.broadcast_receiver.start(self)
         ns = getattr(self.cluster, "node_set", None)
@@ -374,6 +420,12 @@ class Server:
 
     def close(self) -> None:
         self._closing.set()
+        self.rebalance.close()
+        from pilosa_tpu.core import fragment as fragment_mod
+
+        fragment_mod.unregister_write_listener(
+            self.rebalance.delta_log.record
+        )
         if self._http is not None:
             self._http.shutdown()
             self._http.server_close()
@@ -488,14 +540,21 @@ class Server:
             return {}
 
     def _on_membership_change(self, items) -> None:
-        """Merge NodeSet membership into cluster node *states*.  The node
-        list itself stays static from config — placement (jump hash over
-        the node count) must not reshard when liveness flaps
-        (reference: cluster.go:161-173)."""
+        """Merge NodeSet membership into cluster node *states*.  The
+        node list itself never reshards on liveness flaps (reference:
+        cluster.go:161-173) — placement changes ONLY through the
+        versioned rebalance transition.  A gossip-announced host that
+        is not in the ring is surfaced as a JOIN CANDIDATE (and, with
+        [cluster] rebalance-on-join, auto-admitted via resize)."""
         for host, state in items:
             node = self.cluster.node_by_host(host)
             if node is not None:
                 node.set_state(state)
+            else:
+                try:
+                    self.rebalance.note_membership(host, state)
+                except Exception as e:  # noqa: BLE001 — advisory path
+                    self.logger(f"join-candidate tracking error: {e}")
 
     def _on_create_slice(self, index: str, view_name: str, slice_i: int) -> None:
         from pilosa_tpu.core.view import is_inverse_view

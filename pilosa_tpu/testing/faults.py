@@ -9,7 +9,11 @@ boundaries (via :func:`check` calls compiled into the hot paths):
 * ``rpc.recv`` — in ``Handler.dispatch``, as a request arrives at a
   node (an injected error surfaces to the caller as HTTP 500);
 * ``device.launch`` — in the executor, before a fused device program
-  dispatches (direct and coalesced paths).
+  dispatches (direct and coalesced paths);
+* ``gossip.send`` — in ``GossipNodeSet._send``, before each UDP
+  datagram leaves (``host`` = the SENDING member's identity, ``path``
+  = the message type, e.g. ``ping``/``ack``) — seeded ``prob`` +
+  ``mode=drop`` is the churn-soak's deterministic lossy network.
 
 The plan comes from the ``PILOSA_FAULTS`` environment variable (read
 lazily on first check) or from :func:`install` (tests, soak drivers).
@@ -46,7 +50,7 @@ import socket
 import threading
 import time
 
-STAGES = ("rpc.send", "rpc.recv", "device.launch")
+STAGES = ("rpc.send", "rpc.recv", "device.launch", "gossip.send")
 MODES = ("delay", "error", "drop")
 
 

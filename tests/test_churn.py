@@ -1,0 +1,104 @@
+"""Gossip churn under injected datagram loss and member flapping —
+the deterministic tier-1 slice of `make churn-soak` (ROADMAP 3's
+paired demand): membership must CONVERGE (every live member sees every
+live member) without false-DOWN storms (a reachable member never
+confirmed DOWN despite the loss)."""
+
+from __future__ import annotations
+
+import time
+
+from pilosa_tpu.cluster.gossip import GossipNodeSet
+from pilosa_tpu.testing import faults
+from tests.conftest import free_udp_port
+
+N_NODES = 8
+LOSS = 0.20  # seeded per-rule, fully deterministic
+INTERVAL = 0.05
+SUSPECT = 0.6
+
+
+def _mk(i: int, port: int, seed_addr: str = "") -> GossipNodeSet:
+    ns = GossipNodeSet(
+        host=f"127.0.0.1:{9000 + i}",  # HTTP identity (never dialed here)
+        seed=seed_addr,
+        gossip_interval=INTERVAL,
+        suspect_after=SUSPECT,
+    )
+    ns.bind = ("127.0.0.1", port)
+    ns.advertise = ("127.0.0.1", port)
+    return ns
+
+
+def _live_view_converged(nodes: dict[str, GossipNodeSet]) -> bool:
+    want = set(nodes)
+    return all(set(ns.nodes()) == want for ns in nodes.values())
+
+
+def test_churn_converges_without_false_down_storm():
+    faults.install(f"gossip.send:prob={LOSS},seed=42,mode=drop")
+    ports = {i: free_udp_port() for i in range(N_NODES)}
+    nodes: dict[str, GossipNodeSet] = {}
+    try:
+        seed_addr = ""
+        for i in range(N_NODES):
+            ns = _mk(i, ports[i], seed_addr)
+            ns.open()
+            if not seed_addr:
+                seed_addr = f"127.0.0.1:{ports[i]}"
+            nodes[ns.host] = ns
+
+        # Phase 1 — lossy but stable: full membership converges and NO
+        # live member is ever confirmed DOWN (SWIM's indirect probes
+        # must absorb 20% datagram loss).
+        deadline = time.time() + 20.0
+        while time.time() < deadline and not _live_view_converged(nodes):
+            time.sleep(0.1)
+        assert _live_view_converged(nodes), {
+            h: ns.nodes() for h, ns in nodes.items()
+        }
+        t_end = time.time() + 4 * SUSPECT
+        while time.time() < t_end:
+            for h, ns in nodes.items():
+                downs = [
+                    m
+                    for m, st in ns.member_states().items()
+                    if st == "DOWN" and m in nodes
+                ]
+                assert not downs, (
+                    f"false-DOWN storm: {h} marked live members {downs} DOWN"
+                )
+            time.sleep(0.1)
+
+        # Phase 2 — flap: two members die; the survivors must confirm
+        # them DOWN (and only them).
+        flapped = sorted(nodes)[-2:]
+        flap_ports = {}
+        for h in flapped:
+            ns = nodes.pop(h)
+            flap_ports[h] = ns.bind[1]
+            ns.close()
+        deadline = time.time() + 20.0
+        while time.time() < deadline and not _live_view_converged(nodes):
+            time.sleep(0.1)
+        assert _live_view_converged(nodes), {
+            h: ns.nodes() for h, ns in nodes.items()
+        }
+
+        # Phase 3 — rejoin on the same identities: membership heals to
+        # the full set again.
+        for h in flapped:
+            i = int(h.rsplit(":", 1)[1]) - 9000
+            ns = _mk(i, flap_ports[h], seed_addr)
+            ns.open()
+            nodes[h] = ns
+        deadline = time.time() + 20.0
+        while time.time() < deadline and not _live_view_converged(nodes):
+            time.sleep(0.1)
+        assert _live_view_converged(nodes), {
+            h: ns.nodes() for h, ns in nodes.items()
+        }
+    finally:
+        faults.reset()
+        for ns in nodes.values():
+            ns.close()

@@ -37,6 +37,8 @@ def main() -> int:
         BENCH_SKIP_HBM_TIER="1",
         # The open-loop storm tier has its own smoke (make load-smoke).
         BENCH_SKIP_ADMISSION_TIER="1",
+        # The live-resize tier has its own smoke (make resize-smoke).
+        BENCH_SKIP_REBALANCE_TIER="1",
     )
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py")],

@@ -1,0 +1,158 @@
+"""Gossip churn soak — 20-50 virtual nodes under injected datagram
+loss and member flapping (`make churn-soak`).
+
+Boots N in-process GossipNodeSets (no HTTP servers — pure membership),
+installs a seeded deterministic datagram-loss plan at the gossip.send
+boundary (testing/faults.py), and runs flap cycles: kill a random
+subset, wait for the survivors to converge on exactly the live set
+(no false-DOWN of reachable members along the way), revive the dead on
+their old identities, wait for the full set to heal.  Exits non-zero
+on any convergence failure; prints a JSON report.
+
+    python tools/churn_soak.py [--nodes 24] [--loss 0.25] [--cycles 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import socket
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from pilosa_tpu.cluster.gossip import GossipNodeSet  # noqa: E402
+from pilosa_tpu.testing import faults  # noqa: E402
+
+INTERVAL = 0.05
+SUSPECT = 0.8
+
+
+def free_udp_port() -> int:
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def mk(i: int, port: int, seed_addr: str) -> GossipNodeSet:
+    ns = GossipNodeSet(
+        host=f"127.0.0.1:{20000 + i}",
+        seed=seed_addr,
+        gossip_interval=INTERVAL,
+        suspect_after=SUSPECT,
+    )
+    ns.bind = ("127.0.0.1", port)
+    ns.advertise = ("127.0.0.1", port)
+    return ns
+
+
+def converged(nodes: dict[str, GossipNodeSet]) -> bool:
+    want = set(nodes)
+    return all(set(ns.nodes()) == want for ns in nodes.values())
+
+
+def wait_converged(nodes, timeout: float, label: str, report: dict) -> float:
+    t0 = time.time()
+    deadline = t0 + timeout
+    while time.time() < deadline:
+        if converged(nodes):
+            dt = time.time() - t0
+            report.setdefault("convergence_s", []).append(
+                {"phase": label, "seconds": round(dt, 2)}
+            )
+            return dt
+        time.sleep(0.1)
+    views = {h: sorted(ns.nodes()) for h, ns in nodes.items()}
+    raise SystemExit(
+        f"FAIL: {label}: no convergence within {timeout}s: "
+        + json.dumps(views, indent=2)
+    )
+
+
+def assert_no_false_down(nodes, window_s: float, report: dict) -> None:
+    t_end = time.time() + window_s
+    while time.time() < t_end:
+        for h, ns in nodes.items():
+            downs = [
+                m
+                for m, st in ns.member_states().items()
+                if st == "DOWN" and m in nodes
+            ]
+            if downs:
+                raise SystemExit(
+                    f"FAIL: false-DOWN storm: {h} marked live members "
+                    f"{downs} DOWN under loss"
+                )
+        time.sleep(0.1)
+    report["false_down_observations"] = 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=24)
+    ap.add_argument("--loss", type=float, default=0.25)
+    ap.add_argument("--cycles", type=int, default=3)
+    ap.add_argument("--flap", type=int, default=0, help="nodes per flap (default n//6)")
+    ap.add_argument("--seed", type=int, default=42)
+    args = ap.parse_args()
+    flap_n = args.flap or max(2, args.nodes // 6)
+
+    faults.install(
+        f"gossip.send:prob={args.loss},seed={args.seed},mode=drop"
+    )
+    report: dict = {
+        "nodes": args.nodes,
+        "loss": args.loss,
+        "cycles": args.cycles,
+        "flap_per_cycle": flap_n,
+    }
+    nodes: dict[str, GossipNodeSet] = {}
+    ports: dict[str, int] = {}
+    try:
+        seed_addr = ""
+        for i in range(args.nodes):
+            port = free_udp_port()
+            ns = mk(i, port, seed_addr)
+            ns.open()
+            if not seed_addr:
+                seed_addr = f"127.0.0.1:{port}"
+            nodes[ns.host] = ns
+            ports[ns.host] = port
+        print(
+            f"booted {args.nodes} virtual members, loss={args.loss}",
+            file=sys.stderr,
+        )
+        wait_converged(nodes, 60.0, "boot", report)
+        assert_no_false_down(nodes, 4 * SUSPECT, report)
+
+        import random
+
+        rng = random.Random(args.seed)
+        for cycle in range(args.cycles):
+            flapped = rng.sample(sorted(nodes), flap_n)
+            for h in flapped:
+                nodes.pop(h).close()
+            print(f"cycle {cycle}: flapped {flapped}", file=sys.stderr)
+            wait_converged(nodes, 60.0, f"cycle{cycle}-down", report)
+            for h in flapped:
+                i = int(h.rsplit(":", 1)[1]) - 20000
+                ns = mk(i, ports[h], seed_addr)
+                ns.open()
+                nodes[h] = ns
+            wait_converged(nodes, 60.0, f"cycle{cycle}-heal", report)
+
+        report["ok"] = True
+        print(json.dumps(report))
+        print("churn soak OK", file=sys.stderr)
+        return 0
+    finally:
+        faults.reset()
+        for ns in nodes.values():
+            ns.close()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
