@@ -611,6 +611,24 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — the artifact must survive
             log(f"bsi tier FAILED ({e!r:.300})")
 
+    # --- tier 6a: mixed DISTINCT-query storm, fusion on vs off --------
+    # The plane-major multi-query fusion headline: a weighted mix of
+    # distinct Count/Range/TopN trees under concurrent load, measured
+    # with the interpreter fusion tier enabled and disabled.  The
+    # kernel is memory-bound (47.7% of HBM peak raw), so every further
+    # Gcols/s must come from amortizing passes across queries — this
+    # tier is where that shows up or doesn't.
+    mixed_storm = None
+    if os.environ.get("BENCH_SKIP_MIXED_TIER") != "1":
+        try:
+            mixed_storm = with_retries(
+                "mixed-storm tier",
+                lambda: run_mixed_storm_tier(rng, cpu_fallback),
+                attempts=2,
+            )
+        except Exception as e:  # noqa: BLE001 — the artifact must survive
+            log(f"mixed-storm tier FAILED ({e!r:.300})")
+
     # --- tier 6b: multi-node Intersect+Count, device-resident planes ---
     # BASELINE configs[4]'s distributed query (the reference's whole
     # point, executor.go:1149-1243) finally on the headline bench: real
@@ -701,6 +719,8 @@ def main() -> None:
         out["hbm_pressure"] = hbm_pressure
     if bsi_tier is not None:
         out["bsi"] = bsi_tier
+    if mixed_storm is not None:
+        out["mixed_storm"] = mixed_storm
     if cold_restart is not None:
         out["cold_restart"] = cold_restart
     if cluster_reduce is not None:
@@ -1098,6 +1118,230 @@ def run_bsi_tier(rng, n_slices, cpu_fb=False) -> dict:
             ex.close()
             co.close()
             holder.close()
+        return out
+
+
+def run_mixed_storm_tier(rng, cpu_fb=False) -> dict:
+    """``mixed_storm`` tier: a weighted mix of DISTINCT Count / Range /
+    TopN queries under concurrent load, fusion ON vs OFF.
+
+    Before this tier, the concurrent figures all measured storms of
+    ONE query shape — exactly what the per-compile-key coalescer
+    batches.  A realistic mix of distinct trees never shared a launch:
+    this tier boots the same executor twice (CoalesceScheduler with
+    ``fuse`` enabled/disabled), runs the identical mix at each
+    concurrency step, and records Gcols/s, coalesce launches, fused
+    queries per launch, and the interpreter program-cache entries —
+    including after a second, more-diverse mix, which must NOT grow
+    them (opcode tables are data; the jit key is pure geometry).
+    Every worker checks its result against the direct (uncoalesced)
+    executor's answer, so the speedup is anchored to byte-identical
+    results."""
+    import concurrent.futures
+
+    from pilosa_tpu import bsi
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.exec import plan
+    from pilosa_tpu.exec.coalesce import CoalesceScheduler
+    from pilosa_tpu.exec.executor import Executor
+    from pilosa_tpu.ops import bitplane as bpl
+    from pilosa_tpu.pql.parser import parse_string
+
+    n_slices = 8 if cpu_fb else 64
+    depth = 8
+    total_columns = n_slices * bpl.SLICE_WIDTH
+    with tempfile.TemporaryDirectory() as d:
+        holder = Holder(d)
+        holder.open()
+        idx = holder.create_index("ms")
+        fr = idx.create_frame("f", cache_size=256)
+        view = fr.create_view_if_not_exists("standard")
+        rows = rng.integers(
+            0, 2**32, size=(n_slices, 8, bpl.WORDS_PER_SLICE), dtype=np.uint32
+        )
+        for s in range(n_slices):
+            prime_fragment(
+                view.create_fragment_if_not_exists(s), rows[s], bpl.pad_rows
+            )
+        fr.set_options(range_enabled=True)
+        fr.create_field("v", 0, (1 << depth) - 1)
+        bview = fr.create_view_if_not_exists(bsi.field_view_name("v"))
+        planes = rng.integers(
+            0, 2**32, size=(n_slices, depth, bpl.WORDS_PER_SLICE),
+            dtype=np.uint32,
+        )
+        ones = np.full((1, bpl.WORDS_PER_SLICE), 0xFFFFFFFF, np.uint32)
+        zeros = np.zeros((1, bpl.WORDS_PER_SLICE), np.uint32)
+        for s in range(n_slices):
+            prime_fragment(
+                bview.create_fragment_if_not_exists(s),
+                np.concatenate([ones, zeros, planes[s]]),
+                bpl.pad_rows,
+            )
+        ft = idx.create_frame("t", cache_size=512)
+        tview = ft.create_view_if_not_exists("standard")
+        trows = rng.integers(
+            0, 2**32, size=(n_slices, 32, bpl.WORDS_PER_SLICE),
+            dtype=np.uint32,
+        )
+        for s in range(n_slices):
+            prime_fragment(
+                tview.create_fragment_if_not_exists(s), trows[s], bpl.pad_rows
+            )
+
+        # The weighted mix: ~60% point counts, ~25% BSI ranges, ~15%
+        # TopN(src) — every entry a DISTINCT tree or predicate.
+        count_qs = [
+            f"Count(Intersect(Bitmap(rowID={i}, frame=f),"
+            f" Bitmap(rowID={j}, frame=f)))"
+            for i, j in ((0, 1), (1, 2), (2, 3), (3, 4))
+        ] + [
+            f"Count(Union(Bitmap(rowID={i}, frame=f),"
+            f" Bitmap(rowID={j}, frame=f)))"
+            for i, j in ((4, 5), (5, 6))
+        ]
+        range_qs = [
+            f"Count(Range(frame=f, v > {p}))" for p in (20, 80, 140, 200)
+        ]
+        topn_qs = [
+            f"TopN(Bitmap(rowID={i}, frame=t), frame=t, n=8)"
+            for i in (0, 1, 2)
+        ]
+        mix = count_qs * 2 + range_qs + topn_qs
+        parsed = {q: parse_string(q) for q in {*mix}}
+
+        def canon(res):
+            if hasattr(res, "bits"):
+                return ("bits", tuple(res.bits()))
+            if isinstance(res, list):
+                return ("pairs", tuple((p.id, p.count) for p in res))
+            return ("val", int(res))
+
+        direct = Executor(holder, host="localhost:0")
+        try:
+            want = {
+                q: canon(direct.execute("ms", pq)[0])
+                for q, pq in parsed.items()
+            }
+        finally:
+            direct.close()
+
+        tiers = (8,) if cpu_fb else (16, 64, 128)
+        per_tier_mult = 3 if cpu_fb else 6
+
+        def run_setting(fuse_on: bool) -> dict:
+            co = CoalesceScheduler(fuse=fuse_on)
+            ex = Executor(holder, host="localhost:0", coalescer=co)
+            setting: dict = {}
+            try:
+                # Warm serial (batch caches + direct program compiles),
+                # then one untimed mini-storm so the fused interpreter
+                # geometries compile OUTSIDE the measured windows.
+                for q, pq in parsed.items():
+                    got = canon(ex.execute("ms", pq)[0])
+                    assert got == want[q], f"mixed_storm identity: {q}"
+
+                def one(i):
+                    q = mix[i % len(mix)]
+                    got = canon(ex.execute("ms", parsed[q])[0])
+                    assert got == want[q], f"mixed_storm identity: {q}"
+
+                with concurrent.futures.ThreadPoolExecutor(8) as pool:
+                    list(pool.map(one, range(2 * len(mix))))
+                for conc in tiers:
+                    n_q = conc * per_tier_mult
+                    before = co.snapshot()
+                    t0 = time.perf_counter()
+                    with concurrent.futures.ThreadPoolExecutor(conc) as pool:
+                        list(pool.map(one, range(n_q)))
+                    wall = time.perf_counter() - t0
+                    snap = co.snapshot()
+                    launches = snap["launches"] - before["launches"]
+                    fused_q = snap["fused_queries"] - before["fused_queries"]
+                    fused_l = (
+                        snap["fused_launches"] - before["fused_launches"]
+                    )
+                    gcols = n_q * total_columns / wall / 1e9
+                    setting[str(conc)] = {
+                        "queries": n_q,
+                        "gcols_s": round(gcols, 3),
+                        "ms_per_query": round(wall / n_q * 1e3, 3),
+                        "launches": launches,
+                        "fused_launches": fused_l,
+                        "fused_queries": fused_q,
+                        "fused_per_launch": (
+                            round(fused_q / fused_l, 2) if fused_l else None
+                        ),
+                    }
+                    log(
+                        f"mixed_storm fuse={'on' if fuse_on else 'off'}"
+                        f" conc={conc}: {gcols:.2f} Gcols/s,"
+                        f" {launches} launches for {n_q} queries"
+                        f" ({fused_q} fused over {fused_l} interp launches)"
+                    )
+                setting["fetch_launches"] = co.snapshot()["fetch_launches"]
+            finally:
+                ex.close()
+                co.close()
+            return setting
+
+        out: dict = {
+            "slices": n_slices,
+            "columns": total_columns,
+            "distinct_queries": len(parsed),
+            "errors": 0,
+        }
+        out["fusion_on"] = run_setting(True)
+        entries_on = plan.program_cache_stats()["interp"]
+        out["fusion_off"] = run_setting(False)
+        out["speedup"] = {
+            str(c): round(
+                out["fusion_on"][str(c)]["gcols_s"]
+                / out["fusion_off"][str(c)]["gcols_s"],
+                3,
+            )
+            for c in tiers
+            if out["fusion_off"][str(c)]["gcols_s"]
+        }
+        out["interp_entries"] = entries_on
+
+        # Diversity probe: a SECOND fused storm over a strictly more
+        # diverse mix (new predicates, new row pairs, new TopN rows)
+        # must leave the interpreter's compiled-entry count unchanged —
+        # expression tables are data, geometry is the only jit key.
+        div_qs = [
+            f"Count(Range(frame=f, v > {p}))"
+            for p in (5, 33, 77, 111, 155, 199, 233, 250)
+        ] + [
+            f"Count(Intersect(Bitmap(rowID={i}, frame=f),"
+            f" Bitmap(rowID={j}, frame=f)))"
+            for i, j in ((0, 7), (1, 6), (2, 5), (3, 7))
+        ]
+        div_parsed = [parse_string(q) for q in div_qs]
+        co = CoalesceScheduler(fuse=True)
+        ex = Executor(holder, host="localhost:0", coalescer=co)
+        try:
+            with concurrent.futures.ThreadPoolExecutor(8) as pool:
+                list(
+                    pool.map(
+                        lambda i: ex.execute(
+                            "ms", div_parsed[i % len(div_parsed)]
+                        ),
+                        range(3 * len(div_parsed)),
+                    )
+                )
+        finally:
+            ex.close()
+            co.close()
+        out["interp_entries_after_diversity"] = plan.program_cache_stats()[
+            "interp"
+        ]
+        log(
+            f"mixed_storm: speedup {out['speedup']}; interp program-cache"
+            f" entries {entries_on} ->"
+            f" {out['interp_entries_after_diversity']} after diversity"
+        )
+        holder.close()
         return out
 
 

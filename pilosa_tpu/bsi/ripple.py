@@ -141,6 +141,88 @@ def minmax_vec(which, exists, sign, planes, filt, xp, popcount, where):
     return xp.stack(bits + [negative, xp.asarray(popcount(cand), dtype=xp.int32)])
 
 
+# ---------------------------------------------------------------------------
+# ripple as interpreter ops (exec/plan.py fused multi-query programs)
+# ---------------------------------------------------------------------------
+#
+# The third backend: instead of an array module, ``em`` is an opcode
+# emitter (plan.FuseEmitter) and every ``xp`` operation becomes one
+# packed int32 instruction row.  The emitted stream reproduces the
+# array functions above operation for operation — same OR-accumulation
+# order, same andnot/xor factoring — so a fused interpreter launch is
+# byte-identical to the direct compiled ripple.  Value numbering inside
+# the emitter shares subterms (pos/neg/ripple state) across the two
+# ripples of a ``between`` and across queries lowered into one table.
+
+
+def lower_magnitude_cmp(em, exists, planes, pred):
+    """Emit :func:`magnitude_cmp` as interpreter ops; ``exists`` /
+    ``planes[k]`` / ``pred`` are register ids, the return is the
+    ``(lt, eq, gt)`` register triple.  ``m_k`` comes from the MASKW op
+    (broadcast of predicate word ``k``), so the predicate stays DATA —
+    one lowered stream serves every constant of its depth bucket."""
+    eq = exists
+    lt = gt = None
+    for k in reversed(range(len(planes))):
+        b = planes[k]
+        m = em.maskw(pred, k)
+        lt_term = em.and_(em.andnot(eq, b), m)
+        lt = lt_term if lt is None else em.or_(lt, lt_term)
+        gt_term = em.andnot(em.and_(eq, b), m)
+        gt = gt_term if gt is None else em.or_(gt, gt_term)
+        # eq & (b ^ ~m)  ==  eq & ~(b ^ m)
+        eq = em.andnot(eq, em.xor(b, m))
+    # BSI depths bucket to multiples of 8 (bsi.pad_depth), so planes is
+    # never empty and lt/gt are always materialized.
+    return lt, eq, gt
+
+
+def lower_signed_cmp(em, op, exists, sign, planes, pred):
+    """Emit :func:`signed_cmp` as interpreter ops; returns the result
+    row's register id.  Same sign-magnitude composition, with the
+    predicate's sign mask (word ``depth``) selecting between the
+    positive- and negative-predicate cases as data."""
+    depth = len(planes)
+    lt, eq, gt = lower_magnitude_cmp(em, exists, planes, pred)
+    nm = em.maskw(pred, depth)
+    pos = em.andnot(exists, sign)
+    neg = em.and_(exists, sign)
+
+    eq_row = em.or_(
+        em.andnot(em.and_(pos, eq), nm), em.and_(em.and_(neg, eq), nm)
+    )
+    if op == "eq":
+        return eq_row
+    if op == "ne":
+        return em.andnot(exists, eq_row)
+    lt_row = em.or_(
+        em.andnot(em.or_(neg, em.and_(pos, lt)), nm),
+        em.and_(em.and_(neg, gt), nm),
+    )
+    if op == "lt":
+        return lt_row
+    if op == "le":
+        return em.or_(lt_row, eq_row)
+    gt_row = em.or_(
+        em.andnot(em.and_(pos, gt), nm),
+        em.and_(em.or_(pos, em.and_(neg, lt)), nm),
+    )
+    if op == "gt":
+        return gt_row
+    if op == "ge":
+        return em.or_(gt_row, eq_row)
+    raise ValueError(f"unknown BSI comparison op {op!r}")
+
+
+def lower_between(em, exists, sign, planes, pred_lo, pred_hi):
+    """``lo <= v <= hi`` as two lowered ripples; the emitter's value
+    numbering shares the pos/neg sign-group rows between them."""
+    return em.and_(
+        lower_signed_cmp(em, "ge", exists, sign, planes, pred_lo),
+        lower_signed_cmp(em, "le", exists, sign, planes, pred_hi),
+    )
+
+
 def decode_minmax(vec, depth: int) -> tuple[int, int] | None:
     """One slice's ``minmax_vec`` output -> ``(value, count)`` in
     Python ints, or None when the slice holds no valued column."""

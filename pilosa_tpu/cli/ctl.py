@@ -118,6 +118,8 @@ def build_server(cfg: config_mod.Config):
         coalesce=cfg.exec.coalesce,
         coalesce_max_batch=cfg.exec.coalesce_max_batch,
         coalesce_max_wait_us=cfg.exec.coalesce_max_wait_us,
+        fuse=cfg.exec.fuse,
+        fuse_max_programs=cfg.exec.fuse_max_programs,
         query_timeout_ms=cfg.net.query_timeout_ms,
         broadcast_timeout_ms=cfg.net.broadcast_timeout_ms,
         retry_attempts=cfg.net.retry_attempts,

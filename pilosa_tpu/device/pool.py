@@ -286,6 +286,40 @@ class PlanePool:
                 for d, n in ent.bytes_by_device.items():
                     self._pinned[d] = max(0, self._pinned.get(d, 0) - n)
 
+    def pin_many(self, keys) -> list:
+        """Pin every present key under ONE lock acquisition; returns
+        the keys actually pinned (for the matching :meth:`unpin_many`).
+        A fused multi-query launch pins the UNION plane set of its
+        whole drained batch — per-key lock round trips would scale the
+        pool's hottest lock with batch occupancy."""
+        held = []
+        with self._mu:
+            for k in keys:
+                if k is None:
+                    continue
+                ent = self._entries.get(k)
+                if ent is None:
+                    continue
+                ent.pins += 1
+                if ent.pins == 1:
+                    for d, n in ent.bytes_by_device.items():
+                        self._pinned[d] = self._pinned.get(d, 0) + n
+                held.append(k)
+        return held
+
+    def unpin_many(self, keys) -> None:
+        with self._mu:
+            for k in keys:
+                ent = self._entries.get(k)
+                if ent is None or ent.pins == 0:
+                    continue
+                ent.pins -= 1
+                if ent.pins == 0:
+                    for d, n in ent.bytes_by_device.items():
+                        self._pinned[d] = max(
+                            0, self._pinned.get(d, 0) - n
+                        )
+
     class _PinLease:
         def __init__(self, pool: "PlanePool", keys):
             self._pool = pool
@@ -293,14 +327,12 @@ class PlanePool:
             self._held: list = []
 
         def __enter__(self):
-            for k in self._keys:
-                if k is not None and self._pool.pin(k):
-                    self._held.append(k)
+            # One lock acquisition however many keys the launch pins.
+            self._held = self._pool.pin_many(self._keys)
             return self
 
         def __exit__(self, *exc):
-            for k in self._held:
-                self._pool.unpin(k)
+            self._pool.unpin_many(self._held)
 
     def pinned(self, *keys) -> "PlanePool._PinLease":
         """Context manager pinning every present key for the block —

@@ -40,6 +40,25 @@ Batch construction, per drained key:
   hosts) still amortize duplicate waiters over one launch, but distinct
   sharded arrays are never concatenated — cross-sharding concatenation
   would move shards between devices mid-query.
+* **Program-key fusion.**  Concatenation only merges queries sharing a
+  compile key, so a realistic mix of DISTINCT Count/Range/Bitmap trees
+  never batched and each re-streamed its planes.  With ``fuse`` on, a
+  drain additionally pulls every other queue whose entries share the
+  PROGRAM key (reduce kind, word geometry, device), lowers the distinct
+  trees into one opcode/operand table (plan.lower_expr — expressions
+  travel as DATA, like BSI predicates), and evaluates all of them in
+  ONE interpreter pass (plan.interp_exec) over the union leaf set:
+  K distinct queries, one launch, one pass over the resident planes.
+  Identical queries share a lowered program, the emitter's value
+  numbering dedups shared subtrees, and a tree that cannot lower (BSI
+  aggregates, op-budget overflow) falls back to its own concat launch.
+  Fused "count" results are the same per-slice int32 partials as the
+  concat path — byte-identical totals.
+* **Shared fetches.**  ``submit_fetch`` batches concurrent blocking
+  device->host fetches (the folded TopN scorer's dominant residual)
+  into one ``jax.device_get`` per drain, so DISTINCT concurrent TopN
+  queries share a round trip the way PR-10's single-flight shared it
+  for identical ones.
 
 Every fragment-plane-bearing pool key in a drained batch is pinned via
 the PR-3 residency pool for the launch's dispatch+fetch, so LRU eviction
@@ -67,6 +86,21 @@ from pilosa_tpu.obs.stats import NopStatsClient
 
 DEFAULT_MAX_BATCH = 64
 DEFAULT_MAX_WAIT_US = 0
+# Most DISTINCT expression programs one fused interpreter launch may
+# carry ([exec] fuse-max-programs); < 2 disables fusion entirely.
+DEFAULT_FUSE_MAX_PROGRAMS = 16
+# Leaf-row budget for one fused launch's combined array: segment sets
+# past it split into further launches (the leaf-axis analogue of
+# MAX_CONCAT_ROWS — the concat materializes a transient copy, so this
+# bounds device memory, not correctness).  64 leaves x 128 KiB = 8 MiB
+# per batch row.
+MAX_FUSE_LEAVES = 64
+# Reduce kinds the interpreter can evaluate; "agg" trees reduce inside
+# the expression (BSI aggregates) and stay on the per-compile-key path.
+_FUSABLE_REDUCES = frozenset({"count", "row"})
+# Sentinel queue key for shared device->host fetches (submit_fetch):
+# concurrent TopN score fetches drain in ONE jax.device_get round trip.
+_FETCH_KEY = ("__fetch__",)
 # Row budget for one concatenated launch: segments beyond it split into
 # further launches.  Entry batches are already pow2-padded per query, so
 # this bounds transient device memory (concatenation materializes a
@@ -90,6 +124,12 @@ class _Item:
     batch: object
     future: Future
     pin_keys: tuple
+    # Leaf identity keys (executor._cached_batch leaf_keys): one per
+    # batch column, equal keys <=> byte-identical columns.  The fused
+    # launch collapses shared columns into ONE union register, so the
+    # pass streams each distinct plane row once however many queries
+    # reference it.  None = no identities known (columns stay unique).
+    leaf_keys: "tuple | None" = None
 
 
 def _placement(batch) -> tuple:
@@ -123,9 +163,18 @@ class CoalesceScheduler:
         max_batch: int = DEFAULT_MAX_BATCH,
         max_wait_us: int = DEFAULT_MAX_WAIT_US,
         stats=None,
+        fuse: bool = True,
+        fuse_max_programs: int = DEFAULT_FUSE_MAX_PROGRAMS,
     ):
         self.max_batch = max(1, int(max_batch))
         self.max_wait_us = max(0, int(max_wait_us))
+        # Multi-query fusion ([exec] fuse): a drain additionally pulls
+        # every other queue whose entries share this key's PROGRAM key
+        # (reduce kind, word geometry, device), lowers the distinct
+        # trees to one opcode table, and evaluates them all in ONE
+        # interpreter pass over the union leaf set (plan.interp_exec).
+        self.fuse = bool(fuse) and int(fuse_max_programs) >= 2
+        self.fuse_max_programs = max(1, int(fuse_max_programs))
         self.stats = stats or NopStatsClient()
         self._mu = threading.Lock()
         self._cv = threading.Condition(self._mu)
@@ -141,6 +190,16 @@ class CoalesceScheduler:
         self._pad_rows = 0
         self._launched_rows = 0
         self._max_occupancy = 0
+        # fusion counters (exec.interp.*)
+        self._fused_launches = 0
+        self._fused_queries = 0
+        self._fused_programs = 0
+        self._fused_ops = 0
+        self._fuse_dedup_hits = 0
+        self._fuse_shared_leaves = 0
+        self._fuse_fallbacks = 0
+        self._fetch_launches = 0
+        self._fetch_arrays = 0
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="exec-coalesce"
         )
@@ -150,16 +209,22 @@ class CoalesceScheduler:
     # producer side
     # ------------------------------------------------------------------
 
-    def submit(self, expr: tuple, reduce: str, batch, pin_keys=()) -> Future:
+    def submit(
+        self, expr: tuple, reduce: str, batch, pin_keys=(), leaf_keys=None
+    ) -> Future:
         """Enqueue one assembled leaf batch (``uint32[n, n_leaves,
         words]``) for a coalesced ``compiled_batched(expr, reduce)``
-        launch."""
+        launch.  ``leaf_keys`` (optional) are per-column identity
+        tokens enabling union-leaf sharing in fused launches."""
         key = (expr, reduce, tuple(batch.shape[1:]), _placement(batch))
         fut: Future = Future()
+        if leaf_keys is not None and len(leaf_keys) != int(batch.shape[1]):
+            leaf_keys = None
         item = _Item(
             batch=batch,
             future=fut,
             pin_keys=tuple(k for k in pin_keys if k is not None),
+            leaf_keys=leaf_keys,
         )
         with self._cv:
             if self._closed:
@@ -167,6 +232,25 @@ class CoalesceScheduler:
             q = self._queues.get(key)
             if q is None:
                 q = self._queues[key] = deque()
+            q.append(item)
+            self._cv.notify()
+        return fut
+
+    def submit_fetch(self, arrays) -> Future:
+        """Enqueue a device->host fetch of ``arrays`` (a list of device
+        arrays); resolves to ``(host_arrays, info)``.  All fetch items
+        pending at a drain share ONE ``jax.device_get`` round trip —
+        the TopN(src) fetch residual folds across DISTINCT concurrent
+        queries this way (PR-10's single-flight only covered identical
+        ones)."""
+        fut: Future = Future()
+        item = _Item(batch=list(arrays), future=fut, pin_keys=())
+        with self._cv:
+            if self._closed:
+                raise CoalesceClosed("coalescer closed")
+            q = self._queues.get(_FETCH_KEY)
+            if q is None:
+                q = self._queues[_FETCH_KEY] = deque()
             q.append(item)
             self._cv.notify()
         return fut
@@ -189,6 +273,7 @@ class CoalesceScheduler:
         with self._mu:
             launches = self._launches
             queries = self._queries
+            fused_launches = self._fused_launches
             return {
                 "launches": launches,
                 "queries": queries,
@@ -198,6 +283,20 @@ class CoalesceScheduler:
                 "mean_occupancy": (
                     round(queries / launches, 3) if launches else None
                 ),
+                "fused_launches": fused_launches,
+                "fused_queries": self._fused_queries,
+                "fused_programs": self._fused_programs,
+                "fused_ops": self._fused_ops,
+                "fuse_dedup_hits": self._fuse_dedup_hits,
+                "fuse_shared_leaves": self._fuse_shared_leaves,
+                "fuse_fallbacks": self._fuse_fallbacks,
+                "mean_fused_per_launch": (
+                    round(self._fused_queries / fused_launches, 3)
+                    if fused_launches
+                    else None
+                ),
+                "fetch_launches": self._fetch_launches,
+                "fetch_arrays": self._fetch_arrays,
             }
 
     # ------------------------------------------------------------------
@@ -242,27 +341,77 @@ class CoalesceScheduler:
                         if remaining <= 0:
                             break
                         self._cv.wait(timeout=remaining)
+            # Program-key tier: a fusable drain additionally pulls every
+            # OTHER queue whose entries share this key's program key
+            # (reduce, word geometry, device) — the mixed batch of
+            # distinct trees the interpreter evaluates in one pass.
+            extra: list = []
+            fk = self._fuse_key(key)
+            if fk is not None:
+                with self._cv:
+                    for k2 in list(self._queues):
+                        if 1 + len(extra) >= self.fuse_max_programs:
+                            break
+                        if len(items) + sum(
+                            len(its) for _, its in extra
+                        ) >= self.max_batch:
+                            break
+                        if k2 == key or self._fuse_key(k2) != fk:
+                            continue
+                        its: list = []
+                        self._drain_locked(k2, its)
+                        if its:
+                            extra.append((k2, its))
             try:
                 # The launch (dispatch + fetch) runs HERE, on the
                 # dispatcher thread — while it is in flight, new
                 # arrivals queue up and the next iteration drains them
                 # in one batch.  That in-flight window IS the
                 # continuous-batching accumulation.
-                self._launch(key, items)
+                self._launch(key, items, extra)
             except BaseException as e:  # noqa: BLE001 — crosses futures
                 exc = e if isinstance(e, Exception) else RuntimeError(repr(e))
-                for it in items:
+                for it in items + [it for _, its in extra for it in its]:
                     if not it.future.done():
                         it.future.set_exception(exc)
 
-    def _launch(self, key, items: list) -> None:
+    def _fuse_key(self, key) -> tuple | None:
+        """The program-key tier's grouping token: queues whose entries
+        share it may lower into ONE interpreter launch.  None = not
+        fusable (fusion off, fetch items, "agg" reduce).  Sharded
+        batches ARE fusable with each other when their sharding token
+        matches: the fused concat runs along the LEAF axis, which
+        leaves the slice-axis sharding untouched — unlike the concat
+        path's slice-axis merge, no shard ever moves devices."""
+        if not self.fuse or key == _FETCH_KEY:
+            return None
+        _expr, reduce, tail, placement = key
+        if reduce not in _FUSABLE_REDUCES:
+            return None
+        # words + full placement token (device, or the sharding repr):
+        # the geometry every fused segment must share (the leading
+        # slice axis groups later, per launch).
+        return (reduce, tail[-1], placement)
+
+    def _launch(self, key, items: list, extra=()) -> None:
+        if key == _FETCH_KEY:
+            self._launch_fetch(items)
+            return
         expr, reduce, _tail, placement = key
-        sharded = placement[1]
-        if not sharded:
+        if extra:
+            self._launch_fused(reduce, [(key, items)] + list(extra))
+            return
+        self._fallback_launch(key, items)
+
+    def _fallback_launch(self, key, items: list) -> None:
+        """The per-compile-key launch semantics fusion falls back to:
+        concat for single-device batches, identity-dedup-only for
+        sharded ones (cross-array slice-axis concatenation would move
+        shards between devices mid-query)."""
+        expr, reduce, _tail, placement = key
+        if not placement[1]:
             self._launch_concat(expr, reduce, items)
             return
-        # Sharded batches: duplicate waiters share a launch, distinct
-        # arrays each get their own (no cross-sharding concatenation).
         groups: "OrderedDict[int, list]" = OrderedDict()
         for it in items:
             groups.setdefault(id(it.batch), []).append(it)
@@ -356,6 +505,278 @@ class CoalesceScheduler:
             start += rows
             for it in sub:
                 it.future.set_result((seg_res, info))
+
+    # ------------------------------------------------------------------
+    # multi-query fusion (plane-major interpreter launches)
+    # ------------------------------------------------------------------
+
+    def _launch_fused(self, reduce, buckets: list) -> None:
+        """Launch a mixed drain of per-compile-key buckets
+        (``[(key, items), ...]``, all sharing one program key) as
+        interpreter passes.  Queries fused into one pass must share the
+        leading slice-axis length (their result rows scatter back
+        row-for-row), so items group by it; groups that end up with
+        fewer than two distinct (tree, segment) programs — or whose
+        trees refuse to lower — fall back to the ordinary
+        per-compile-key concat launch, never fail."""
+        by_n: "OrderedDict[int, list]" = OrderedDict()
+        for key, its in buckets:
+            for it in its:
+                by_n.setdefault(int(it.batch.shape[0]), []).append((key, it))
+        for n_rows, pairs in by_n.items():
+            self._launch_interp(reduce, n_rows, pairs)
+
+    def _fallback_by_key(self, reduce, fallback: "OrderedDict") -> None:
+        for key, its in fallback.items():
+            with self._mu:
+                self._fuse_fallbacks += len(its)
+            self.stats.count("exec.interp.fallbacks", len(its))
+            self._fallback_launch(key, its)
+
+    def _launch_interp(self, reduce, n_rows: int, pairs: list) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        from pilosa_tpu.exec import plan
+        from pilosa_tpu.ops import bitplane as bp
+
+        # Segments: the distinct entry batches (identity dedup — a
+        # query storm repeating K distinct queries contributes K
+        # segments however many waiters ride them).
+        segs: list = []
+        seg_keys: list = []
+        seg_of: dict[int, int] = {}
+        for _key, it in pairs:
+            if id(it.batch) not in seg_of:
+                seg_of[id(it.batch)] = len(segs)
+                lk = it.leaf_keys
+                if lk is None:
+                    # No identities: every column is unique to this
+                    # segment (no cross-segment sharing possible).
+                    lk = tuple(
+                        ("anon", id(it.batch), j)
+                        for j in range(int(it.batch.shape[1]))
+                    )
+                seg_keys.append(lk)
+                segs.append(it.batch)
+        l_tot = sum(int(b.shape[1]) for b in segs)
+        if l_tot > MAX_FUSE_LEAVES and len(segs) > 1:
+            # Leaf budget exceeded: greedy segment chunks, each its own
+            # fused launch (a lone oversized segment proceeds whole —
+            # it would be just as big on the unfused path).
+            chunk_of: dict[int, int] = {}
+            chunk = rows = 0
+            for si, b in enumerate(segs):
+                ln = int(b.shape[1])
+                if rows and rows + ln > MAX_FUSE_LEAVES:
+                    chunk += 1
+                    rows = 0
+                chunk_of[si] = chunk
+                rows += ln
+            parts: dict[int, list] = {}
+            for key, it in pairs:
+                parts.setdefault(chunk_of[seg_of[id(it.batch)]], []).append(
+                    (key, it)
+                )
+            for sub in parts.values():
+                self._launch_interp(reduce, n_rows, sub)
+            return
+
+        # Union leaf layout: first occurrence of each identity key
+        # claims a register; later references — within one query, or
+        # across DISTINCT queries — collapse onto it, so the fused pass
+        # streams each distinct plane row ONCE per dispatch (the
+        # plane-major amortization this tier exists for).
+        union: "OrderedDict[tuple, int]" = OrderedDict()
+        src_of: list[tuple[int, int]] = []  # union register -> (seg, col)
+        for si, lk in enumerate(seg_keys):
+            for j, k in enumerate(lk):
+                if k not in union:
+                    union[k] = len(src_of)
+                    src_of.append((si, j))
+        l_union = len(src_of)
+        l_bucket = bp.pow2_bucket(l_union, 1)
+        leaf_maps = [[union[k] for k in lk] for lk in seg_keys]
+
+        # Lower each DISTINCT (tree, leaf layout) once; identical
+        # queries share the lowered program (the "identical leaf sets
+        # evaluated once" dedup), and — with shared leaf columns
+        # collapsed — the emitter's value numbering dedups shared
+        # subtrees ACROSS queries too.  A tree that cannot lower (BSI
+        # aggregate node, op budget) rolls the table back and routes
+        # its items to the concat fallback by ORIGINAL compile key.
+        em = plan.FuseEmitter(l_bucket, plan.FUSE_MAX_OPS)
+        out_of: dict[tuple, int] = {}
+        failed: set = set()
+        fused: list = []  # (item, out_reg)
+        fallback: "OrderedDict[tuple, list]" = OrderedDict()
+        for key, it in pairs:
+            expr = key[0]
+            lmap = leaf_maps[seg_of[id(it.batch)]]
+            pk = (expr, tuple(lmap))
+            reg = out_of.get(pk)
+            if reg is None and pk not in failed:
+                cp = em.checkpoint()
+                try:
+                    reg = out_of[pk] = plan.lower_expr(expr, lmap, em)
+                except plan.FuseUnsupported:
+                    em.rollback(cp)
+                    failed.add(pk)
+            if reg is None:
+                fallback.setdefault(key, []).append(it)
+            else:
+                fused.append((it, reg))
+
+        # Fewer than two distinct programs fused = nothing to fuse;
+        # the concat path handles identity dedup with zero copies.
+        if fused and len(out_of) < 2:
+            for it, _reg in fused:
+                fallback.setdefault(
+                    next(k for k, i2 in pairs if i2 is it), []
+                ).append(it)
+            fused = []
+
+        if fused:
+            # Combined leaf array: each segment contributes only the
+            # union columns it FIRST provided (duplicates — within a
+            # query or across queries — never re-copy, never
+            # re-stream).  A single full-contribution pow2 segment is
+            # used as-is: zero copies, the hot repeated-mix case.
+            parts = []
+            for si, seg in enumerate(segs):
+                cols = [j for s2, j in src_of if s2 == si]
+                if not cols:
+                    continue
+                if cols == list(range(int(seg.shape[1]))):
+                    parts.append(seg)
+                else:
+                    parts.append(seg[:, jnp.asarray(cols, dtype=jnp.int32)])
+            if l_bucket > l_union:
+                parts.append(
+                    self._leaf_pad_zeros(n_rows, l_bucket - l_union, segs[0])
+                )
+            # Leaf-axis concat: slice-axis sharding (if any) is
+            # untouched — each shard concatenates locally.
+            combined = (
+                parts[0]
+                if len(parts) == 1
+                else jnp.concatenate(parts, axis=1)
+            )
+            n_ops = len(em.rows)
+            p_bucket = bp.pow2_bucket(max(n_ops, 1), plan.FUSE_OPS_FLOOR)
+            prog = np.zeros((p_bucket, 4), dtype=np.int32)
+            if n_ops:
+                prog[:n_ops] = np.asarray(em.rows, dtype=np.int32)
+            out_regs = list(dict.fromkeys(reg for _it, reg in fused))
+            pos_of_reg = {r: i for i, r in enumerate(out_regs)}
+            k_bucket = bp.pow2_bucket(len(out_regs), 1)
+            out_idx = np.asarray(
+                out_regs + [out_regs[-1]] * (k_bucket - len(out_regs)),
+                dtype=np.int32,
+            )
+            pins = {k for it, _ in fused for k in it.pin_keys}
+            t0 = time.monotonic()
+            with device_mod.pool().pinned(*pins):
+                out = plan.interp_exec(reduce, combined, prog, out_idx)
+                res = np.asarray(jax.device_get(out))
+            launch_ms = (time.monotonic() - t0) * 1e3
+            with self._mu:
+                self._launches += 1
+                self._queries += len(fused)
+                self._launched_rows += n_rows
+                if len(fused) > self._max_occupancy:
+                    self._max_occupancy = len(fused)
+                self._fused_launches += 1
+                self._fused_queries += len(fused)
+                self._fused_programs += len(out_of)
+                self._fused_ops += n_ops
+                self._fuse_dedup_hits += em.dedup_hits
+                self._fuse_shared_leaves += l_tot - l_union
+                launch_n = self._launches
+            self.stats.count("exec.coalesce.launches")
+            self.stats.count("exec.coalesce.coalescedQueries", len(fused))
+            self.stats.histogram(
+                "exec.coalesce.batchOccupancy", float(len(fused))
+            )
+            self.stats.count("exec.interp.launches")
+            self.stats.count("exec.interp.fusedQueries", len(fused))
+            self.stats.histogram("exec.interp.opsPerLaunch", float(n_ops))
+            if l_tot > l_union:
+                self.stats.count(
+                    "exec.interp.sharedLeaves", l_tot - l_union
+                )
+            info = {
+                "launch": launch_n,
+                "fused": True,
+                "batch_queries": len(fused),
+                "programs": len(out_of),
+                "ops": n_ops,
+                "dedup_hits": em.dedup_hits,
+                "batch_rows": n_rows,
+                "leaf_rows": l_union,
+                "shared_leaves": l_tot - l_union,
+                "pad_leaves": l_bucket - l_union,
+                "launch_ms": round(launch_ms, 3),
+            }
+            for it, reg in fused:
+                it.future.set_result((res[:, pos_of_reg[reg]], info))
+
+        self._fallback_by_key(reduce, fallback)
+
+    def _leaf_pad_zeros(self, n_rows: int, pad: int, like):
+        """Cached all-zero LEAF-axis pad block matching ``like``'s
+        placement (single device, or the identical sharding for mesh
+        batches) — bucketing the combined leaf axis of a fused launch
+        (pow2 gaps, so the cache stays small like the row-pad one)."""
+        import jax
+
+        words = int(like.shape[-1])
+        devs = list(like.devices())
+        if len(devs) == 1:
+            target = devs[0]
+            token = str(target)
+        else:
+            target = like.sharding
+            token = repr(target)
+        zkey = ("leafpad", n_rows, pad, words, token)
+        z = self._zeros.get(zkey)
+        if z is None:
+            z = jax.device_put(
+                np.zeros((n_rows, pad, words), dtype=np.uint32), target
+            )
+            self._zeros[zkey] = z
+        return z
+
+    def _launch_fetch(self, items: list) -> None:
+        """Drain pending fetch items with ONE blocking device->host
+        round trip: dispatches stay with their submitters (they are
+        already async); only the value fetch — the dominant TopN(src)
+        residual — batches here."""
+        import jax
+
+        arrays: list = []
+        spans: list[tuple[int, int]] = []
+        for it in items:
+            arrs = it.batch
+            spans.append((len(arrays), len(arrs)))
+            arrays.extend(arrs)
+        t0 = time.monotonic()
+        fetched = jax.device_get(arrays)
+        fetch_ms = (time.monotonic() - t0) * 1e3
+        with self._mu:
+            self._fetch_launches += 1
+            self._fetch_arrays += len(arrays)
+            n = self._fetch_launches
+        self.stats.count("exec.interp.fetchLaunches")
+        self.stats.count("exec.interp.fetchedArrays", len(arrays))
+        info = {
+            "fetch_launch": n,
+            "fetch_items": len(items),
+            "fetch_arrays": len(arrays),
+            "fetch_ms": round(fetch_ms, 3),
+        }
+        for it, (lo, cnt) in zip(items, spans):
+            it.future.set_result((fetched[lo : lo + cnt], info))
 
     def _pad_zeros(self, pad: int, like):
         """Cached all-zero pad rows on ``like``'s device — the pad set

@@ -1060,6 +1060,21 @@ class Executor:
                     batch=jnp.stack(stacks),
                     pos_of={s: i for i, s in enumerate(kept_slices)},
                 )
+        # Per-column leaf identity keys for union-leaf fusion
+        # (coalesce._launch_interp): equal keys guarantee byte-identical
+        # columns — same leaf call, same kept-slice geometry, and the
+        # same validation epoch (entries sharing an epoch were built
+        # from the same plane state; a refresh never rewrites content).
+        # Predicate/zero columns are slice-invariant and share globally.
+        kept_sig = tuple(ent.get("kept") or ())
+        ent["leaf_keys"] = tuple(
+            ("zero",)
+            if leaf.name == "BsiZero"
+            else ("pred", leaf.args["v"], leaf.args["d"])
+            if leaf.name == "BsiPred"
+            else (index, str(leaf), kept_sig, epoch)
+            for leaf in leaves
+        )
         if cacheable:
             displaced = []
             with self._batch_mu:
@@ -1273,6 +1288,7 @@ class Executor:
                     reduce,
                     ent["batch"],
                     pin_keys=(ent.get("pool_key"),),
+                    leaf_keys=ent.get("leaf_keys"),
                 )
             except coalesce_mod.CoalesceClosed:
                 sp.annotate(fallback="closed")
@@ -1296,6 +1312,27 @@ class Executor:
                     ) from None
                 raise
             sp.annotate(**info)
+            if info.get("fused"):
+                # The `fuse` span: this query rode a multi-query
+                # interpreter launch — its batch composition (trees,
+                # ops, subtree-dedup hits) lands in the trace and the
+                # slow-query log's `fuse` block.
+                with self.tracer.span(
+                    "fuse",
+                    **{
+                        k: info[k]
+                        for k in (
+                            "batch_queries",
+                            "programs",
+                            "ops",
+                            "dedup_hits",
+                            "batch_rows",
+                            "pad_leaves",
+                        )
+                        if k in info
+                    },
+                ):
+                    pass
         return res
 
     def _eval_tree_slices(
@@ -1746,12 +1783,44 @@ class Executor:
                 dev_outs.append((out, [m[0] for m in members]))
         if not dev_outs:
             return
-        with self.tracer.span("topn.fetch", arrays=len(dev_outs)):
-            fetched = jax.device_get([o for o, _ in dev_outs])
+        with self.tracer.span("topn.fetch", arrays=len(dev_outs)) as sp:
+            fetched = self._shared_fetch([o for o, _ in dev_outs], sp)
         for arr, (_, sts) in zip(fetched, dev_outs):
             arr = np.asarray(arr)
             for i, st in enumerate(sts):
                 st.counts = arr[i]
+
+    def _shared_fetch(self, arrays, sp):
+        """Fetch device arrays to the host, batching the BLOCKING
+        device->host round trip with other queries' concurrent fetches
+        through the coalescer's fetch lane (submit_fetch) — the TopN
+        fetch residual is one round trip per drain instead of one per
+        query.  Dispatches already happened (async); only the wait
+        folds.  Falls back to a direct ``jax.device_get`` without a
+        coalescer or when it is closed."""
+        co = self.coalescer
+        if co is not None and hasattr(co, "submit_fetch"):
+            try:
+                fut = co.submit_fetch(arrays)
+            except coalesce_mod.CoalesceClosed:
+                fut = None
+            if fut is not None:
+                timeout = coalesce_mod.RESULT_TIMEOUT_S
+                dl = resilience.current_deadline()
+                if dl is not None:
+                    timeout = dl.clamp(timeout)
+                try:
+                    res, info = fut.result(timeout=timeout)
+                except FuturesTimeoutError:
+                    sp.annotate(deadline="expired")
+                    if dl is not None and dl.expired:
+                        raise resilience.DeadlineExceeded(
+                            "deadline expired waiting for shared fetch"
+                        ) from None
+                    raise
+                sp.annotate(**info)
+                return res
+        return jax.device_get(arrays)
 
     def _attach_dev_src(self, index: str, c: Call, frag, part):
         """Extend a fragment's (st, SubRef, src_words) TopN part with
@@ -2054,6 +2123,13 @@ class Executor:
         n = _uint_arg(c, "n")[0]
         if len(c.children) > 1:
             raise ExecutorError("TopN() can only have one input bitmap")
+        # Canonicalize through the parsed tree BEFORE keying the prep
+        # cache: the single-flighted score sharing keyed on the exact
+        # query string, so semantically identical TopN(src) queries
+        # whose src trees merely commute (Intersect(A,B) vs
+        # Intersect(B,A)) each paid their own dispatch+fetch.  AND/OR/
+        # XOR commute bit for bit, so results stay byte-identical.
+        c = plan.canonicalize_call(c)
         with self.tracer.span("topn.prep", slices=len(slices)):
             ent = self._topn_folded_entry(index, c, slices)
         if ent.get("empty"):

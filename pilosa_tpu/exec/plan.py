@@ -143,6 +143,27 @@ def cost_class(calls: "list[Call]") -> str:
     return COST_POINT
 
 
+def canonicalize_call(c: Call) -> Call:
+    """Reorder the children of commutative fold calls (Intersect/
+    Union/Xor — AND/OR/XOR on bitsets) into a canonical order, bottom
+    up, so semantically identical trees that differ only in argument
+    ordering produce one canonical string (``str(call)`` already sorts
+    keyword args).  This is the compile-key canonicalization the
+    single-flighted TopN score cache keys through: without it,
+    ``TopN(Intersect(A, B), ...)`` and ``TopN(Intersect(B, A), ...)``
+    each paid their own dispatch+fetch.  Returns the ORIGINAL object
+    when nothing changed.  Difference is not commutative and is left
+    alone; results are byte-identical either way."""
+    kids = [canonicalize_call(ch) for ch in c.children]
+    if c.name in ("Intersect", "Union", "Xor") and len(kids) > 1:
+        kids = sorted(kids, key=str)
+    if len(kids) == len(c.children) and all(
+        a is b for a, b in zip(kids, c.children)
+    ):
+        return c
+    return Call(name=c.name, args=dict(c.args), children=kids)
+
+
 def _popcount32(row):
     return jnp.sum(jax.lax.population_count(row).astype(jnp.int32))
 
@@ -370,6 +391,253 @@ def slice_bucket(n: int) -> int:
     return bp.pow2_bucket(n, 1)
 
 
+# ---------------------------------------------------------------------------
+# expression-as-data interpreter (plane-major multi-query fusion)
+# ---------------------------------------------------------------------------
+#
+# ``compiled_batched`` compiles one program per TREE SHAPE, so a mix of
+# DISTINCT concurrent queries never shares a launch and each re-streams
+# its resident planes.  The interpreter generalizes the PR-6
+# predicates-travel-as-data idiom (bsi.pred_row) to the expression
+# itself: a register machine whose opcode/operand table is an ordinary
+# int32 INPUT — K distinct trees lower to one table, the compiled
+# program streams the union leaf set exactly once per dispatch, and a
+# new query is a new table row, NEVER a recompile.  The jit key is pure
+# geometry — (slice bucket, leaf bucket, op bucket, out bucket, reduce)
+# — every axis pow2-bucketed, so the family's compiled-entry count is
+# O(1) in concurrent-mix diversity (program_cache_bounds "interp").
+#
+# Register file layout per slice: slots [0, n_leaves) are the stacked
+# leaf rows, slot n_leaves + i is instruction i's output.  Instruction
+# row: (opcode, a, b, aux).
+
+OP_AND = 0
+OP_OR = 1
+OP_ANDNOT = 2
+OP_XOR = 3
+# Broadcast of predicate word ``aux`` of register ``a``: all-ones iff
+# bit 0 of that word is set — the BSI ripple's per-plane predicate mask
+# (ripple.lower_magnitude_cmp), reading the packed bsi.pred_row leaf.
+OP_MASKW = 4
+
+# Opcode-table budget for one fused launch: a lowered tree past this
+# falls back to the per-compile-key coalesce path (its own concat
+# launch) rather than splintering the bucket grid.  Tables pad to pow2
+# buckets >= FUSE_OPS_FLOOR.
+FUSE_MAX_OPS = 256
+FUSE_OPS_FLOOR = 8
+
+
+class FuseUnsupported(PlanError):
+    """The expression cannot lower to the interpreter's opcode table
+    (BSI aggregates reduce inside the expression; oversized trees blow
+    the op budget) — callers fall back to the per-compile-key path."""
+
+
+class FuseEmitter:
+    """Value-numbering opcode emitter: identical instructions (with
+    commutative operand order normalized) share one register, so
+    shared subtrees within a fused batch evaluate once.  ``rollback``
+    restores a checkpoint when a tree fails to lower mid-way, keeping
+    the shared table clean for the batch's other queries."""
+
+    def __init__(self, n_leaves: int, max_ops: int = FUSE_MAX_OPS):
+        self.n_leaves = int(n_leaves)
+        self.max_ops = int(max_ops)
+        self.rows: list[tuple[int, int, int, int]] = []
+        self._memo: dict[tuple, int] = {}
+        self.dedup_hits = 0
+
+    def _emit(self, op: int, a: int, b: int, aux: int = 0) -> int:
+        if op in (OP_AND, OP_OR, OP_XOR) and b < a:
+            a, b = b, a
+        key = (op, a, b, aux)
+        reg = self._memo.get(key)
+        if reg is not None:
+            self.dedup_hits += 1
+            return reg
+        if len(self.rows) >= self.max_ops:
+            raise FuseUnsupported(
+                f"opcode table full ({self.max_ops} instructions)"
+            )
+        reg = self.n_leaves + len(self.rows)
+        self.rows.append((int(op), int(a), int(b), int(aux)))
+        self._memo[key] = reg
+        return reg
+
+    def and_(self, a: int, b: int) -> int:
+        return self._emit(OP_AND, a, b)
+
+    def or_(self, a: int, b: int) -> int:
+        return self._emit(OP_OR, a, b)
+
+    def andnot(self, a: int, b: int) -> int:
+        return self._emit(OP_ANDNOT, a, b)
+
+    def xor(self, a: int, b: int) -> int:
+        return self._emit(OP_XOR, a, b)
+
+    def maskw(self, a: int, word: int) -> int:
+        return self._emit(OP_MASKW, a, a, word)
+
+    def checkpoint(self) -> tuple:
+        return len(self.rows), dict(self._memo), self.dedup_hits
+
+    def rollback(self, cp: tuple) -> None:
+        n, memo, hits = cp
+        del self.rows[n:]
+        self._memo = memo
+        self.dedup_hits = hits
+
+
+_FOLD_EMIT = {
+    "Intersect": "and_",
+    "Union": "or_",
+    "Difference": "andnot",
+    "Xor": "xor",
+}
+
+
+def _leaf_reg(leaf_map, i: int) -> int:
+    return leaf_map + i if isinstance(leaf_map, int) else leaf_map[i]
+
+
+def lower_expr(expr: tuple, leaf_map, em: FuseEmitter) -> int:
+    """Lower one decomposed tree into ``em``'s opcode table; returns
+    the result row's register id.  ``leaf_map`` places the tree's
+    leaves in the combined register file: an int means leaves sit
+    contiguously at ``base + i``; a sequence maps leaf ordinal ``i`` to
+    its register — the fused union-leaf layout, where leaf columns
+    SHARED between queries (same fragment row, same slice geometry)
+    collapse to one register, so the emitter's value numbering dedups
+    whole subtrees across distinct queries.  The emitted stream mirrors
+    :func:`_eval_expr` operation for operation (the BSI ripple lowers
+    through bsi/ripple.py's ``lower_*``), so interpreter results are
+    byte-identical to the direct compiled tree.  Raises
+    :class:`FuseUnsupported` for BSI aggregates (they reduce inside the
+    expression) and when the op budget runs out."""
+    if expr[0] == "leaf":
+        return _leaf_reg(leaf_map, expr[1])
+    name = expr[0]
+    if name == "bsiCmp":
+        op = expr[1]
+        regs = [lower_expr(e, leaf_map, em) for e in expr[2:]]
+        npred = 2 if op == "between" else 1
+        body, preds = regs[: len(regs) - npred], regs[len(regs) - npred :]
+        exists, sign, planes = body[0], body[1], body[2:]
+        if op == "between":
+            return ripple.lower_between(
+                em, exists, sign, planes, preds[0], preds[1]
+            )
+        return ripple.lower_signed_cmp(em, op, exists, sign, planes, preds[0])
+    if name in ("bsiSum", "bsiMin", "bsiMax"):
+        raise FuseUnsupported(f"{name} reduces inside the expression")
+    children = [lower_expr(e, leaf_map, em) for e in expr[1:]]
+    if not children:
+        # Empty Union: the canonical all-zero row (x ^ x).
+        zero = _leaf_reg(leaf_map, 0)
+        return em.xor(zero, zero)
+    emit = getattr(em, _FOLD_EMIT[name])
+    acc = children[0]
+    for nxt in children[1:]:
+        acc = emit(acc, nxt)
+    return acc
+
+
+def _build_interp(reduce: str):
+    """One jitted interpreter per reduce kind: ``fn(leaves, prog,
+    out_idx)`` with ``leaves`` uint32[n_slices, n_leaves, words],
+    ``prog`` int32[n_ops, 4] instruction rows, ``out_idx`` int32[k]
+    result-register selections.  A lax.scan threads the register file
+    through the table (dynamic_update_index keeps the carry in place),
+    vmapped over slices; ``"count"`` returns int32[n_slices, k]
+    popcount partials, ``"row"`` uint32[n_slices, k, words] result
+    rows.  The table and selections are DATA — one compiled entry per
+    geometry bucket serves every expression mix."""
+
+    def fn(leaves, prog, out_idx):
+        n_leaves = leaves.shape[1]
+        steps = prog.shape[0]
+
+        def one(stack):
+            regs0 = jnp.concatenate(
+                [stack, jnp.zeros((steps, stack.shape[1]), dtype=stack.dtype)],
+                axis=0,
+            )
+
+            def step(regs, x):
+                row, i = x
+                op, a, b, aux = row[0], row[1], row[2], row[3]
+                ra = regs[a]
+                rb = regs[b]
+                val = jax.lax.switch(
+                    op,
+                    (
+                        lambda ra, rb, aux: ra & rb,
+                        lambda ra, rb, aux: ra | rb,
+                        lambda ra, rb, aux: ra & ~rb,
+                        lambda ra, rb, aux: ra ^ rb,
+                        lambda ra, rb, aux: jnp.broadcast_to(
+                            (ra[aux] & jnp.uint32(1))
+                            * jnp.uint32(0xFFFFFFFF),
+                            ra.shape,
+                        ),
+                    ),
+                    ra,
+                    rb,
+                    aux,
+                )
+                return (
+                    jax.lax.dynamic_update_index_in_dim(
+                        regs, val, n_leaves + i, 0
+                    ),
+                    None,
+                )
+
+            regs, _ = jax.lax.scan(step, regs0, (prog, jnp.arange(steps)))
+            outs = regs[out_idx]
+            if reduce == "count":
+                return jnp.sum(
+                    jax.lax.population_count(outs).astype(jnp.int32), axis=-1
+                )
+            return outs
+
+        return jax.vmap(one)(leaves)
+
+    return jax.jit(fn)
+
+
+def compiled_interp(reduce: str) -> "_Program":
+    """The interpreter program for one reduce kind ("count" | "row").
+    Callers bucket EVERY input axis to powers of two (coalescer
+    _launch_interp / warmup.prewarm_fuse) — the compiled-entry count
+    per wrapper is the product of the bucket grids, not the number of
+    distinct expression mixes ever fused."""
+    return _compiled_interp(reduce)
+
+
+# Largest bucketed (leaf, op, out) axes ever dispatched — with the
+# leading slice axis in _BUCKET_HIGHWATER["interp"], these derive the
+# interp family's hard cardinality bound.  Plain dict writes: racing
+# writers both store valid maxima.
+_INTERP_HIGHWATER: dict[str, int] = {}
+
+
+def interp_exec(reduce: str, leaves, prog, out_idx):
+    """Dispatch one fused interpreter launch, recording the bucket
+    high-waters the ``exec.programCache.bound[cache:interp]`` gauge
+    derives from.  ``prog``/``out_idx`` may be host numpy — they are
+    kilobytes of metadata riding the launch."""
+    for k, v in (
+        ("leaves", int(leaves.shape[1])),
+        ("ops", int(prog.shape[0])),
+        ("outs", int(out_idx.shape[0])),
+    ):
+        if v > _INTERP_HIGHWATER.get(k, 0):
+            _INTERP_HIGHWATER[k] = v
+    return _compiled_interp(reduce)(leaves, prog, out_idx)
+
+
 class _Program:
     """Recording proxy around one jitted wrapper: records the bucketed
     leading batch axis at call time (feeding the hard-bound gauges) and
@@ -385,9 +653,9 @@ class _Program:
         self.fn = fn
         self.family = family
 
-    def __call__(self, batch):
+    def __call__(self, batch, *args):
         _note_bucket(self.family, int(batch.shape[0]))
-        return self.fn(batch)
+        return self.fn(batch, *args)
 
     def lower(self, *args, **kwargs):
         return self.fn.lower(*args, **kwargs)
@@ -485,6 +753,7 @@ def _build_batched(expr: tuple, reduce: str):
 
 _compiled_batched = _ProgramCache(_build_batched, "plan.batched")
 _compiled_total_count = _ProgramCache(_build_total_count, "plan.totalCount")
+_compiled_interp = _ProgramCache(_build_interp, "interp")
 
 
 # ---------------------------------------------------------------------------
@@ -530,6 +799,9 @@ def program_cache_stats() -> dict[str, int]:
         "plan.totalCount": sum(
             _jit_cache_size(p.fn) for p in _compiled_total_count.programs()
         ),
+        "interp": sum(
+            _jit_cache_size(p.fn) for p in _compiled_interp.programs()
+        ),
         "bitplane.scorePlanes": (
             _jit_cache_size(bp._score_planes_self_src)
             + _jit_cache_size(bp._score_planes_host_src)
@@ -567,6 +839,19 @@ def program_cache_bounds() -> dict[str, int]:
             _compiled_total_count.cache_info().currsize
             * slice_classes("plan.totalCount")
         ),
+        # reduce-kind wrappers x slice x leaf x op-table x out classes —
+        # pure geometry: the bound does NOT grow with how many distinct
+        # expression mixes ever fused, which is the whole point.
+        "interp": (
+            _compiled_interp.cache_info().currsize
+            * slice_classes("interp")
+            * bp.bucket_classes(max(_INTERP_HIGHWATER.get("leaves", 1), 1))
+            * bp.bucket_classes(
+                max(_INTERP_HIGHWATER.get("ops", FUSE_OPS_FLOOR), FUSE_OPS_FLOOR),
+                FUSE_OPS_FLOOR,
+            )
+            * bp.bucket_classes(max(_INTERP_HIGHWATER.get("outs", 1), 1))
+        ),
         # (self-src + host-src) x fragment-group classes x plane-row
         # classes x candidate-slot classes
         "bitplane.scorePlanes": (
@@ -595,7 +880,9 @@ def clear_program_caches() -> None:
 
     _compiled_batched.cache_clear()
     _compiled_total_count.cache_clear()
+    _compiled_interp.cache_clear()
     _BUCKET_HIGHWATER.clear()
+    _INTERP_HIGHWATER.clear()
     bp._SHAPE_HIGHWATER.clear()
     for fn in (
         bp._score_planes_self_src,

@@ -114,6 +114,34 @@ def prewarm_coalesce(
     return warmed
 
 
+# Interpreter geometry buckets the first fused launches land on:
+# (leaf bucket, op-table bucket, out bucket) for the common small mixed
+# batches — 2-leaf trees fusing in pairs/quads.  Larger geometries
+# (BSI ripples push the op table toward 64-128 rows) compile on first
+# use; a batch that size is dominated by its own pass, not the compile.
+_FUSE_SHAPES = ((2, 8, 2), (4, 8, 2), (4, 8, 4), (8, 16, 8))
+
+
+def prewarm_fuse(
+    slice_buckets=(1, 2, 4, 8), shapes=_FUSE_SHAPES
+) -> int:
+    """Compile the multi-query interpreter's smallest geometry buckets
+    (plan.compiled_interp, "count" reduce — the mixed-storm hot path).
+    The program is expression-INDEPENDENT (opcode tables are data), so
+    these few compiles cover every query mix of their geometry."""
+    warmed = 0
+    for n_leaves, p_bucket, k_bucket in shapes:
+        prog = np.zeros((p_bucket, 4), dtype=np.int32)
+        out = np.zeros(k_bucket, dtype=np.int32)
+        for n in slice_buckets:
+            leaves = np.zeros(
+                (n, n_leaves, bp.WORDS_PER_SLICE), dtype=np.uint32
+            )
+            plan.interp_exec("count", leaves, prog, out).block_until_ready()
+            warmed += 1
+    return warmed
+
+
 def prewarm_topn(
     row_buckets=(bp.ROW_BLOCK, 2 * bp.ROW_BLOCK), group_buckets=(1,)
 ) -> int:
@@ -190,6 +218,7 @@ def prewarm(buckets=(1, 2, 4, 8), exprs=_STANDARD_EXPRS, coalesce=False) -> int:
     warmed += prewarm_topn()
     if coalesce:
         warmed += prewarm_coalesce()
+        warmed += prewarm_fuse()
     return warmed
 
 

@@ -784,6 +784,9 @@ class Handler:
         co = _coalesce_batch_stats(record)
         if co is not None:
             line["coalesce"] = co
+        fu = _fuse_batch_stats(record)
+        if fu is not None:
+            line["fuse"] = fu
         self.logger("slow query " + json.dumps(line, sort_keys=True))
 
     def _handle_post_query(self, req: Request, index: str, root) -> Response:
@@ -1507,6 +1510,33 @@ def _coalesce_batch_stats(record: dict) -> dict | None:
     if occ:
         out["mean_occupancy"] = round(sum(occ) / len(occ), 2)
         out["max_occupancy"] = max(occ)
+    return out
+
+
+def _fuse_batch_stats(record: dict) -> dict | None:
+    """Aggregate multi-query-fusion composition from a trace's ``fuse``
+    spans (executor._coalesce_eval emits one per fused launch the query
+    rode, tagged with tree count / op count / subtree-dedup hits) —
+    the slow-query line's evidence that a slow query shared an
+    interpreter pass, and with how many distinct trees.  None when the
+    query never fused."""
+    spans = [s for s in record.get("spans", ()) if s.get("name") == "fuse"]
+    if not spans:
+        return None
+    out: dict = {"launches": len(spans)}
+    for tag, label in (
+        ("batch_queries", "mean_fused_queries"),
+        ("programs", "mean_programs"),
+        ("ops", "mean_ops"),
+        ("dedup_hits", "mean_dedup_hits"),
+    ):
+        vals = [
+            s["tags"][tag]
+            for s in spans
+            if isinstance(s.get("tags", {}).get(tag), (int, float))
+        ]
+        if vals:
+            out[label] = round(sum(vals) / len(vals), 2)
     return out
 
 

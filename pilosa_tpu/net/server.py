@@ -61,6 +61,8 @@ class Server:
         coalesce: bool = True,
         coalesce_max_batch: int = 64,
         coalesce_max_wait_us: int = 0,
+        fuse: bool = True,
+        fuse_max_programs: int = 16,
         query_timeout_ms: float = 60_000.0,
         broadcast_timeout_ms: float = 5_000.0,
         retry_attempts: int = 3,
@@ -119,6 +121,10 @@ class Server:
         self.coalesce = coalesce
         self.coalesce_max_batch = coalesce_max_batch
         self.coalesce_max_wait_us = coalesce_max_wait_us
+        # Plane-major multi-query fusion ([exec] fuse): distinct trees
+        # sharing a program key evaluate in one interpreter pass.
+        self.fuse = fuse
+        self.fuse_max_programs = fuse_max_programs
         self.coalescer = None
         # Cluster resilience ([net] config, net/resilience.py): the
         # retry policy and per-host circuit breakers every client this
@@ -264,6 +270,8 @@ class Server:
                 max_batch=self.coalesce_max_batch,
                 max_wait_us=self.coalesce_max_wait_us,
                 stats=self.stats,
+                fuse=self.fuse,
+                fuse_max_programs=self.fuse_max_programs,
             )
         if self.prewarm:
             # With coalescing on, also compile the coalescer's

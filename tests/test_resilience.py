@@ -382,7 +382,7 @@ class _StubCoalescer:
         self.fut = Future()
         self.submits = 0
 
-    def submit(self, expr, reduce, batch, pin_keys=()):
+    def submit(self, expr, reduce, batch, pin_keys=(), leaf_keys=None):
         self.submits += 1
         return self.fut
 

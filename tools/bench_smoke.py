@@ -1,8 +1,10 @@
 """CI smoke pass over bench.py: a tiny CPU-only run that asserts the
 JSON artifact parses and carries the coalescer's counters plus the
-``bsi`` tier (Range/Sum over integer bit-planes), the ``cold_restart``
-tier (time-to-first-answer under lazy staging), and the program-cache
-entries/bounds invariant.
+``bsi`` tier (Range/Sum over integer bit-planes), the ``mixed_storm``
+tier (distinct-query fusion counters present, zero errors at trivial
+load, launches < queries), the ``cold_restart`` tier
+(time-to-first-answer under lazy staging), and the program-cache
+entries/bounds invariant — including the new ``interp`` family.
 
 Not a performance measurement — a wiring check: the bench's executor
 tiers must produce one valid JSON line on stdout with the coalesce
@@ -92,6 +94,47 @@ def main() -> int:
                     file=sys.stderr,
                 )
                 return 1
+    ms = out.get("mixed_storm")
+    if not isinstance(ms, dict):
+        print(f"FAIL: artifact missing mixed_storm tier: {out}", file=sys.stderr)
+        return 1
+    if ms.get("errors") != 0:
+        print(f"FAIL: mixed_storm recorded errors: {ms}", file=sys.stderr)
+        return 1
+    for section in ("fusion_on", "fusion_off"):
+        sec = ms.get(section)
+        if not isinstance(sec, dict) or not sec:
+            print(
+                f"FAIL: mixed_storm missing {section!r}: {ms}", file=sys.stderr
+            )
+            return 1
+    on_tiers = [
+        v for v in ms["fusion_on"].values() if isinstance(v, dict)
+    ]
+    if not on_tiers or any(t.get("launches", 0) < 1 for t in on_tiers):
+        print(
+            f"FAIL: mixed_storm fusion-on launches implausible: {ms}",
+            file=sys.stderr,
+        )
+        return 1
+    # Fusion must actually engage on the mixed storm: interpreter
+    # launches carrying >1 distinct-tree query each, and launches well
+    # under the query count.
+    total_fused = sum(t.get("fused_queries", 0) for t in on_tiers)
+    total_q = sum(t.get("queries", 0) for t in on_tiers)
+    total_launches = sum(t.get("launches", 0) for t in on_tiers)
+    if total_fused < 1 or total_launches >= total_q:
+        print(
+            f"FAIL: mixed_storm fusion counters implausible"
+            f" (fused={total_fused}, launches={total_launches},"
+            f" queries={total_q}): {ms}",
+            file=sys.stderr,
+        )
+        return 1
+    for key in ("speedup", "interp_entries", "interp_entries_after_diversity"):
+        if key not in ms:
+            print(f"FAIL: mixed_storm missing {key!r}: {ms}", file=sys.stderr)
+            return 1
     cold = out.get("cold_restart")
     if not isinstance(cold, dict):
         print(f"FAIL: artifact missing cold_restart tier: {out}", file=sys.stderr)
@@ -120,6 +163,10 @@ def main() -> int:
         f" mean_occupancy={total['mean_occupancy']};"
         f" bsi range {bsi['range']['gcols_s']} Gcols/s"
         f" / sum {bsi['sum']['gcols_s']} Gcols/s;"
+        f" mixed_storm fused={total_fused}/{total_q} queries over"
+        f" {total_launches} launches, speedup={ms['speedup']},"
+        f" interp entries {ms['interp_entries']}->"
+        f"{ms['interp_entries_after_diversity']};"
         f" cold restart first answer {cold['first_answer_ms']} ms"
     )
     return 0
