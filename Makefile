@@ -5,7 +5,7 @@
 
 PYTHON ?= python
 
-.PHONY: default test lint analyze typecheck check bench bench-smoke chaos-smoke load-smoke resize-smoke churn-soak install build docker clean generate
+.PHONY: default test lint analyze typecheck check bench bench-smoke chaos-smoke load-smoke resize-smoke multichip-smoke churn-soak install build docker clean generate
 
 default: build test
 
@@ -90,6 +90,17 @@ load-smoke:
 # (.github/workflows/check.yml), alongside chaos-smoke.
 resize-smoke:
 	$(PYTHON) tools/resize_smoke.py
+
+# Mesh data-plane smoke (tools/multichip_smoke.py): virtual 8-device
+# CPU mesh; asserts sharded execution engages BY DEFAULT with >1
+# device visible, a distinct-query Intersect+Count storm + TopN
+# through the coalescer/fusion path (incl. the ICI-reduced "total"
+# launch) answers byte-identically to the forced single-device path
+# and a numpy oracle, fragment planes spread over the shards, and
+# interp program-cache entries stay within bounds.  BLOCKING in CI
+# (.github/workflows/check.yml).
+multichip-smoke:
+	$(PYTHON) tools/multichip_smoke.py
 
 # Gossip churn soak (tools/churn_soak.py): 20-50 virtual members under
 # seeded datagram loss + member flapping; asserts membership converges

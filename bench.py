@@ -404,6 +404,44 @@ def main() -> None:
         except Exception as e:
             log(f"rebalance tier failed: {e}")
 
+    # Mesh-scaling tier (ISSUE 12 / ROADMAP 2): the mesh-sharded data
+    # plane end to end — devices-vs-Gcols/s curve at 1/2/4/8 devices,
+    # the 10B-column Intersect+Count headline over the full mesh (ICI-
+    # reduced limb total-count), and the N-nodes × M-devices grid with
+    # one process per node.  Runs on the virtual 8-device CPU mesh
+    # (tools/mesh_bench.py re-execs itself onto it) BEFORE this process
+    # touches the device — the tunnel only ever has one client.
+    mesh_scaling = None
+    if os.environ.get("BENCH_SKIP_MESH_TIER") != "1":
+        import subprocess
+
+        mb = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools", "mesh_bench.py"
+        )
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+        try:
+            out = subprocess.run(
+                [sys.executable, mb], env=env, capture_output=True,
+                timeout=1800, text=True,
+            )
+            if out.returncode == 0 and out.stdout.strip():
+                for line in out.stderr.strip().splitlines():
+                    if line.startswith("[mesh]"):
+                        log(line)
+                mesh_scaling = json.loads(out.stdout.strip().splitlines()[-1])
+                hl = mesh_scaling.get("headline") or {}
+                log(
+                    "mesh_scaling tier: headline "
+                    f"{hl.get('columns')} columns @ {hl.get('devices')} "
+                    f"devices -> {hl.get('gcols_per_s')} Gcols/s, "
+                    f"byte_identical={hl.get('byte_identical')}"
+                )
+            else:
+                log(f"mesh tier failed: rc={out.returncode} "
+                    f"stderr={out.stderr.strip()[-300:]!r}")
+        except Exception as e:
+            log(f"mesh tier failed: {e}")
+
     total_columns = int(os.environ.get("BENCH_COLUMNS", 1_000_000_000))
     n_slices = (total_columns + SLICE_WIDTH - 1) // SLICE_WIDTH  # 954
     log(f"backend={jax.default_backend()} devices={jax.devices()}")
@@ -727,6 +765,8 @@ def main() -> None:
         out["cluster_reduce"] = cluster_reduce
     if cluster_tpu is not None:
         out["cluster_tpu"] = cluster_tpu
+    if mesh_scaling is not None:
+        out["mesh_scaling"] = mesh_scaling
     if admission_storm is not None:
         out["admission_storm"] = admission_storm
     if rebalance_tier is not None:
@@ -900,7 +940,57 @@ def run_cluster_tpu_tier(leaves, cpu_fb=False) -> dict:
     hash-identical placement) sharing THIS process's accelerator,
     primes each node's owned slices, warms the mirrors onto the device,
     and measures the same PQL through the coordinator — sync p50 plus
-    concurrent ms/query and Gcols/s per node count."""
+    concurrent ms/query and Gcols/s per node count.  With >1 device
+    visible the tier additionally records the node × device GRID (the
+    production topology: each node's local map leg runs the
+    mesh-sharded plane over its owned slices), keyed "NxM"; the full
+    process-isolated grid over the virtual mesh is the mesh_scaling
+    tier's node_grid (tools/mesh_bench.py)."""
+    import jax
+
+    from pilosa_tpu.ops import bitplane as bp
+    from pilosa_tpu.parallel import mesh as pmesh
+
+    n_slices = min(
+        len(leaves), int(os.environ.get("BENCH_CLUSTER_TPU_SLICES", "128"))
+    )
+    rows = leaves[:n_slices]
+    want = int(np.bitwise_count(rows[:, 0] & rows[:, 1]).sum())
+    q = 'Count(Intersect(Bitmap(rowID=0, frame="f"), Bitmap(rowID=1, frame="f")))'
+    n_local = len(jax.local_devices())
+    device_counts = [d for d in (1, 2, 4, 8) if d <= n_local] or [1]
+    out: dict = {
+        "slices": n_slices,
+        "devices_visible": n_local,
+        "per_node": {},
+        "grid": {},
+    }
+    quiet = dict(
+        anti_entropy_interval=3600,
+        polling_interval=3600,
+        cache_flush_interval=3600,
+        prewarm=False,
+    )
+    for m_devices in device_counts:
+        bp.configure_mesh_devices(m_devices)
+        pmesh._slices_mesh = None
+        try:
+            out["grid"].update(
+                _cluster_tpu_node_rows(
+                    rows, want, q, quiet, m_devices, out["per_node"]
+                )
+            )
+        finally:
+            bp.configure_mesh_devices(0)
+            pmesh._slices_mesh = None
+    return out
+
+
+def _cluster_tpu_node_rows(
+    rows, want, q, quiet, m_devices, per_node
+) -> dict:
+    """One device-width column of the cluster_tpu grid; also fills the
+    legacy ``per_node`` table when running at the widest mesh."""
     import tempfile
     from concurrent.futures import ThreadPoolExecutor
 
@@ -910,19 +1000,8 @@ def run_cluster_tpu_tier(leaves, cpu_fb=False) -> dict:
     from pilosa_tpu.ops import bitplane as bp
     from pilosa_tpu.ops.bitplane import SLICE_WIDTH
 
-    n_slices = min(
-        len(leaves), int(os.environ.get("BENCH_CLUSTER_TPU_SLICES", "128"))
-    )
-    rows = leaves[:n_slices]
-    want = int(np.bitwise_count(rows[:, 0] & rows[:, 1]).sum())
-    q = 'Count(Intersect(Bitmap(rowID=0, frame="f"), Bitmap(rowID=1, frame="f")))'
-    out: dict = {"slices": n_slices, "per_node": {}}
-    quiet = dict(
-        anti_entropy_interval=3600,
-        polling_interval=3600,
-        cache_flush_interval=3600,
-        prewarm=False,
-    )
+    n_slices = rows.shape[0]
+    grid: dict = {}
     for n_nodes in (1, 2, 4):
         with tempfile.TemporaryDirectory() as td:
             servers = []
@@ -990,20 +1069,26 @@ def run_cluster_tpu_tier(leaves, cpu_fb=False) -> dict:
                     )
                 conc_s = (time.perf_counter() - t0) / n_conc
                 gcols = n_slices * SLICE_WIDTH / conc_s / 1e9
-                out["per_node"][str(n_nodes)] = {
+                row = {
                     "sync_p50_ms": round(p50 * 1e3, 3),
                     "concurrent_ms_per_query": round(conc_s * 1e3, 3),
                     "gcols_per_s": round(gcols, 3),
                 }
+                grid[f"{n_nodes}x{m_devices}"] = dict(
+                    row, nodes=n_nodes, devices_per_node=m_devices
+                )
+                # Device widths run ascending, so the legacy per_node
+                # table ends up recording the WIDEST mesh's figures.
+                per_node[str(n_nodes)] = row
                 log(
-                    f"cluster_tpu {n_nodes} node(s): sync p50 "
-                    f"{p50*1e3:.2f} ms, concurrent {conc_s*1e3:.2f} "
-                    f"ms/query, {gcols:.2f} Gcols/s"
+                    f"cluster_tpu {n_nodes} node(s) x {m_devices} "
+                    f"device(s): sync p50 {p50*1e3:.2f} ms, concurrent "
+                    f"{conc_s*1e3:.2f} ms/query, {gcols:.2f} Gcols/s"
                 )
             finally:
                 for s in servers:
                     s.close()
-    return out
+    return grid
 
 
 def run_bsi_tier(rng, n_slices, cpu_fb=False) -> dict:

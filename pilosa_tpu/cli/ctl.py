@@ -111,6 +111,7 @@ def build_server(cfg: config_mod.Config):
         stream_chunk_bytes=cfg.net.stream_chunk_bytes,
         slow_query_ms=cfg.obs.slow_query_ms,
         trace_ring=cfg.obs.trace_ring,
+        mesh_devices=cfg.device.mesh_devices,
         hbm_budget_bytes=cfg.device.hbm_budget_bytes,
         device_prefetch=cfg.device.prefetch,
         device_stage=cfg.device.stage,

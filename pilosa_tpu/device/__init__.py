@@ -54,15 +54,33 @@ def _set_pool(p: PlanePool | None) -> PlanePool | None:
 
 
 def bytes_by_device(arr) -> dict:
-    """{device: bytes} attribution for a jax array — a sharded array
-    splits its nbytes evenly over its devices (the slice axis shards
-    evenly by construction, parallel/mesh.assemble_sharded_batch), a
-    committed array lands whole on its one device."""
+    """{device: bytes} attribution for a jax array.
+
+    A mesh-sharded array charges each device exactly ITS shard's bytes
+    (``addressable_shards`` — the authoritative per-device footprint):
+    attributing the global size to one device would evict that shard's
+    neighbors for capacity the device never spends, and an even split
+    is wrong for uneven layouts and for replicated arrays (every device
+    holds a full copy).  A committed array lands whole on its one
+    device.  Fallback (arrays without shard introspection): even split
+    over ``devices()`` / the legacy ``.device`` attribute."""
     if arr is None:
         return {}
     nbytes = int(getattr(arr, "nbytes", 0) or 0)
     if not nbytes:
         return {}
+    try:
+        shards = arr.addressable_shards
+    except Exception:  # noqa: BLE001 — non-jax stand-ins / old arrays
+        shards = None
+    if shards:
+        out: dict = {}
+        for sh in shards:
+            n = int(getattr(sh.data, "nbytes", 0) or 0)
+            if n:
+                out[sh.device] = out.get(sh.device, 0) + n
+        if out:
+            return out
     devs = None
     try:
         devs = list(arr.devices())

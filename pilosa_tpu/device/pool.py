@@ -505,6 +505,11 @@ class PlanePool:
                     "bytes": ent.nbytes,
                     "pinned": ent.pins > 0,
                 }
+                if len(ent.bytes_by_device) > 1:
+                    # Mesh-sharded entry: each device's row below shows
+                    # only ITS shard's bytes; `bytes` is the global size.
+                    row["sharded"] = True
+                    row["shards"] = len(ent.bytes_by_device)
                 row.update(ent.info)
                 for d, n in ent.bytes_by_device.items():
                     dd = per_dev.setdefault(

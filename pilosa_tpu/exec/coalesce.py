@@ -97,7 +97,11 @@ DEFAULT_FUSE_MAX_PROGRAMS = 16
 MAX_FUSE_LEAVES = 64
 # Reduce kinds the interpreter can evaluate; "agg" trees reduce inside
 # the expression (BSI aggregates) and stay on the per-compile-key path.
-_FUSABLE_REDUCES = frozenset({"count", "row"})
+# "total" is the ICI-reduced count: per-register limb pairs summed
+# across the slice axis ON DEVICE (psum over the mesh for sharded
+# batches), so a fused launch of K distinct Count queries returns 8·K
+# bytes instead of K per-slice partial vectors.
+_FUSABLE_REDUCES = frozenset({"count", "row", "total"})
 # Sentinel queue key for shared device->host fetches (submit_fetch):
 # concurrent TopN score fetches drain in ONE jax.device_get round trip.
 _FETCH_KEY = ("__fetch__",)
@@ -401,22 +405,100 @@ class CoalesceScheduler:
         if extra:
             self._launch_fused(reduce, [(key, items)] + list(extra))
             return
+        if (
+            reduce == "total"
+            and self.fuse
+            and len({id(it.batch) for it in items}) > 1
+        ):
+            # Same-compile-key Count entries over DISTINCT batches
+            # cannot concatenate under "total" (each launch reduces to
+            # one scalar limb pair) — the interpreter evaluates them as
+            # distinct programs in ONE pass instead, preserving the
+            # concat path's one-launch sharing.
+            self._launch_fused(reduce, [(key, items)])
+            return
         self._fallback_launch(key, items)
 
     def _fallback_launch(self, key, items: list) -> None:
         """The per-compile-key launch semantics fusion falls back to:
         concat for single-device batches, identity-dedup-only for
         sharded ones (cross-array slice-axis concatenation would move
-        shards between devices mid-query)."""
+        shards between devices mid-query).  "total" reduces to one
+        scalar limb pair per batch, so it can never concatenate —
+        identity dedup only, through the limb total-count program."""
         expr, reduce, _tail, placement = key
+        if reduce == "total":
+            groups: "OrderedDict[int, list]" = OrderedDict()
+            for it in items:
+                groups.setdefault(id(it.batch), []).append(it)
+            for grp in groups.values():
+                self._launch_total(expr, grp)
+            return
         if not placement[1]:
             self._launch_concat(expr, reduce, items)
             return
-        groups: "OrderedDict[int, list]" = OrderedDict()
+        groups = OrderedDict()
         for it in items:
             groups.setdefault(id(it.batch), []).append(it)
         for grp in groups.values():
             self._launch_concat(expr, reduce, grp)
+
+    def _launch_total(self, expr, items: list) -> None:
+        """One identity-deduped batch through the limb total-count
+        program (plan.compiled_total_count): the cross-slice reduce
+        runs on device — as an all-reduce over ICI when the batch is
+        mesh-sharded — and every waiter receives the SAME int32[2]
+        (hi, lo) limb pair, recombined executor-side."""
+        import jax
+
+        from pilosa_tpu.exec import plan
+
+        batch = items[0].batch
+        mesh = None
+        try:
+            from jax.sharding import NamedSharding
+
+            sh = batch.sharding
+            if isinstance(sh, NamedSharding) and len(batch.devices()) > 1:
+                mesh = sh.mesh
+        except Exception:  # noqa: BLE001 — non-jax stand-ins, old arrays
+            mesh = None
+        pins = {k for it in items for k in it.pin_keys}
+        t0 = time.monotonic()
+        with device_mod.pool().pinned(*pins):
+            if mesh is not None:
+                # The program psums over the mesh: serialize with every
+                # other collective launch in the process (see
+                # plan.collective_launch — racing dispatches can
+                # deadlock the all-reduce rendezvous).
+                with plan.collective_launch():
+                    out = plan.compiled_total_count(expr, mesh)(batch)
+                    res = np.asarray(jax.device_get(out))
+            else:
+                out = plan.compiled_total_count(expr, mesh)(batch)
+                res = np.asarray(jax.device_get(out))
+        launch_ms = (time.monotonic() - t0) * 1e3
+        with self._mu:
+            self._launches += 1
+            self._queries += len(items)
+            self._launched_rows += int(batch.shape[0])
+            if len(items) > self._max_occupancy:
+                self._max_occupancy = len(items)
+            launch_n = self._launches
+        self.stats.count("exec.coalesce.launches")
+        self.stats.count("exec.coalesce.coalescedQueries", len(items))
+        self.stats.histogram("exec.coalesce.batchOccupancy", float(len(items)))
+        info = {
+            "launch": launch_n,
+            "total": True,
+            "batch_queries": len(items),
+            "batch_segments": 1,
+            "batch_rows": int(batch.shape[0]),
+            "pad_rows": 0,
+            "launch_ms": round(launch_ms, 3),
+        }
+        for it in items:
+            it.future.set_result((res, info))
 
     def _launch_concat(self, expr, reduce, items: list) -> None:
         # Identity dedup: one segment per DISTINCT batch array.
@@ -675,10 +757,21 @@ class CoalesceScheduler:
                 dtype=np.int32,
             )
             pins = {k for it, _ in fused for k in it.pin_keys}
+            try:
+                sharded = len(combined.devices()) > 1
+            except Exception:  # noqa: BLE001 — unit-test stand-ins
+                sharded = False
             t0 = time.monotonic()
             with device_mod.pool().pinned(*pins):
-                out = plan.interp_exec(reduce, combined, prog, out_idx)
-                res = np.asarray(jax.device_get(out))
+                if reduce == "total" and sharded:
+                    # The slice-axis limb sums psum over the mesh —
+                    # serialize with other collective launches.
+                    with plan.collective_launch():
+                        out = plan.interp_exec(reduce, combined, prog, out_idx)
+                        res = np.asarray(jax.device_get(out))
+                else:
+                    out = plan.interp_exec(reduce, combined, prog, out_idx)
+                    res = np.asarray(jax.device_get(out))
             launch_ms = (time.monotonic() - t0) * 1e3
             with self._mu:
                 self._launches += 1

@@ -1417,13 +1417,34 @@ class Executor:
             return 0
         kept_slices = ent["kept"]
 
-        # Coalesced path: the per-slice "count" partials are int32-exact
-        # (a slice-row is <= 2^20 bits) and the entry's positions sum in
-        # unbounded Python ints — identical totals to the limb program.
+        # Coalesced path.  A MESH-SHARDED entry within the limb budget
+        # rides the "total" reduce: the cross-slice sum happens ON
+        # DEVICE inside the (possibly fused multi-query) launch as an
+        # all-reduce over ICI, and only an int32[2] (hi, lo) limb pair
+        # crosses the tunnel per query.  Zero pad slices contribute
+        # nothing to either limb, and entries fused into one
+        # interpreter pass read only their own leaf registers, so the
+        # on-device total equals the per-position host sum
+        # byte-for-byte.  Unsharded entries keep the per-slice "count"
+        # partials (int32-exact, <= 2^20 bits per slice-row; host sums
+        # in unbounded Python ints — identical totals): the on-device
+        # reduce buys them only a smaller fetch, while their batches'
+        # committed-ness varies between the cold (host-assembled,
+        # uncommitted) and warm (device-gathered, committed) builders —
+        # distinct jit cache entries for one geometry, which would
+        # break the totalCount family's hard cardinality bound.
         if self.coalescer is not None:
-            res = self._coalesce_eval(ent, "count")
-            if res is not None:
-                return sum(int(res[p]) for p in ent["pos_of"].values())
+            if (
+                ent["mesh"] is not None
+                and len(kept_slices) <= plan.MAX_ONDEVICE_COUNT_PARTIALS
+            ):
+                res = self._coalesce_eval(ent, "total")
+                if res is not None:
+                    return plan.recombine_count_limbs(res)
+            else:
+                res = self._coalesce_eval(ent, "count")
+                if res is not None:
+                    return sum(int(res[p]) for p in ent["pos_of"].values())
 
         with device_mod.pool().pinned(ent.get("pool_key")), self._device_span(
             ent, "count"
@@ -1432,10 +1453,15 @@ class Executor:
                 # Zero pad slices contribute nothing, so the budget is on
                 # the real slice count, not the padded batch size.
                 if len(kept_slices) <= plan.MAX_ONDEVICE_COUNT_PARTIALS:
-                    limbs = plan.compiled_total_count(ent["expr"], ent["mesh"])(
-                        ent["batch"]
-                    )
-                    return plan.recombine_count_limbs(jax.device_get(limbs))
+                    # The program psums over the mesh: one collective
+                    # launch in flight per process (plan.collective_launch).
+                    with plan.collective_launch():
+                        limbs = plan.compiled_total_count(
+                            ent["expr"], ent["mesh"]
+                        )(ent["batch"])
+                        return plan.recombine_count_limbs(
+                            jax.device_get(limbs)
+                        )
                 res = jax.device_get(
                     plan.compiled_batched(ent["expr"], "count")(
                         ent["batch"]

@@ -356,6 +356,29 @@ def compiled_total_count(expr: tuple, mesh=None) -> "_Program":
     return _compiled_total_count(expr, mesh)
 
 
+# Collective-bearing launches (programs whose cross-slice reduce psums
+# over a sharded mesh axis) must never be IN FLIGHT concurrently from
+# two threads of one process: each launch enqueues on every
+# participating device, and two racing dispatches can enqueue in
+# different per-device orders — both all-reduces then wait forever for
+# participants stuck behind the other program (observed as the CPU
+# backend's cross_module rendezvous stall; the hazard is structural,
+# not backend-specific).  One process-wide mutex serializes them:
+# collective programs occupy the whole mesh anyway, so the lock costs
+# nothing a real device would not already charge.  Collective-free
+# launches (vmapped per-slice programs, single-device reduces) never
+# take it.
+_collective_mu = threading.Lock()
+
+
+def collective_launch() -> "threading.Lock":
+    """The process-wide mesh-collective launch lock; hold it across
+    dispatch + fetch of any program compiled with a mesh psum
+    (compiled_total_count(expr, mesh), interp "total" on sharded input,
+    parallel/mesh's distributed reduces)."""
+    return _collective_mu
+
+
 def recombine_count_limbs(limbs):
     """(hi, lo) int32 limbs -> exact totals.
 
@@ -552,8 +575,16 @@ def _build_interp(reduce: str):
     through the table (dynamic_update_index keeps the carry in place),
     vmapped over slices; ``"count"`` returns int32[n_slices, k]
     popcount partials, ``"row"`` uint32[n_slices, k, words] result
-    rows.  The table and selections are DATA — one compiled entry per
-    geometry bucket serves every expression mix."""
+    rows, ``"total"`` int32[2, k] per-register (hi, lo) 16-bit limb
+    pairs — the per-slice count partials limb-split and summed across
+    the slice axis INSIDE the jitted program, so on a mesh-sharded
+    batch the SPMD partitioner inserts the cross-device all-reduce
+    (psum over ICI) and only 8·k bytes ever reach the host (exact up
+    to MAX_ONDEVICE_COUNT_PARTIALS slice-row partials; zero pad slices
+    contribute nothing to either limb).  The table and selections are
+    DATA — one compiled entry per geometry bucket serves every
+    expression mix."""
+    inner = "count" if reduce == "total" else reduce
 
     def fn(leaves, prog, out_idx):
         n_leaves = leaves.shape[1]
@@ -596,20 +627,29 @@ def _build_interp(reduce: str):
 
             regs, _ = jax.lax.scan(step, regs0, (prog, jnp.arange(steps)))
             outs = regs[out_idx]
-            if reduce == "count":
+            if inner == "count":
                 return jnp.sum(
                     jax.lax.population_count(outs).astype(jnp.int32), axis=-1
                 )
             return outs
 
-        return jax.vmap(one)(leaves)
+        res = jax.vmap(one)(leaves)
+        if reduce == "total":
+            # Limb-split BEFORE the slice-axis sum (TPUs have no int64):
+            # each partial <= 2^20, so lo/hi stay int32-exact up to 2^15
+            # non-zero partials; the host recombines hi*2^16 + lo.  On
+            # sharded input the sums become all-reduces over the mesh.
+            lo = jnp.sum(res & 0xFFFF, axis=0)
+            hi = jnp.sum(res >> 16, axis=0)
+            return jnp.stack([hi, lo])
+        return res
 
     return jax.jit(fn)
 
 
 def compiled_interp(reduce: str) -> "_Program":
-    """The interpreter program for one reduce kind ("count" | "row").
-    Callers bucket EVERY input axis to powers of two (coalescer
+    """The interpreter program for one reduce kind ("count" | "row" |
+    "total").  Callers bucket EVERY input axis to powers of two (coalescer
     _launch_interp / warmup.prewarm_fuse) — the compiled-entry count
     per wrapper is the product of the bucket grids, not the number of
     distinct expression mixes ever fused."""

@@ -123,12 +123,14 @@ _FUSE_SHAPES = ((2, 8, 2), (4, 8, 2), (4, 8, 4), (8, 16, 8))
 
 
 def prewarm_fuse(
-    slice_buckets=(1, 2, 4, 8), shapes=_FUSE_SHAPES
+    slice_buckets=(1, 2, 4, 8), shapes=_FUSE_SHAPES,
+    reduces=("count", "total"),
 ) -> int:
     """Compile the multi-query interpreter's smallest geometry buckets
-    (plan.compiled_interp, "count" reduce — the mixed-storm hot path).
-    The program is expression-INDEPENDENT (opcode tables are data), so
-    these few compiles cover every query mix of their geometry."""
+    (plan.compiled_interp — "count" for the mixed-storm hot path and
+    "total" for the on-device-reduced Count storm).  The program is
+    expression-INDEPENDENT (opcode tables are data), so these few
+    compiles cover every query mix of their geometry."""
     warmed = 0
     for n_leaves, p_bucket, k_bucket in shapes:
         prog = np.zeros((p_bucket, 4), dtype=np.int32)
@@ -137,8 +139,11 @@ def prewarm_fuse(
             leaves = np.zeros(
                 (n, n_leaves, bp.WORDS_PER_SLICE), dtype=np.uint32
             )
-            plan.interp_exec("count", leaves, prog, out).block_until_ready()
-            warmed += 1
+            for reduce in reduces:
+                plan.interp_exec(
+                    reduce, leaves, prog, out
+                ).block_until_ready()
+                warmed += 1
     return warmed
 
 

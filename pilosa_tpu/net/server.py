@@ -54,6 +54,7 @@ class Server:
         stream_chunk_bytes: int = 0,
         slow_query_ms: float = 0.0,
         trace_ring: int = 64,
+        mesh_devices: int = 0,
         hbm_budget_bytes: int = 0,
         device_prefetch: bool = True,
         device_stage: bool = True,
@@ -103,6 +104,12 @@ class Server:
         # structured slow-query log line per over-threshold query.
         self.tracer = Tracer(capacity=trace_ring)
         self.slow_query_ms = slow_query_ms
+        # Mesh data plane ([device] mesh-devices): devices participating
+        # in slice placement and the sharded data plane.  0 = all
+        # visible (sharded execution engages by default with >1 device),
+        # 1 = force single-device, N = cap.  Placement is process-global
+        # (ops/bitplane), so this is applied at open().
+        self.mesh_devices = mesh_devices
         # HBM residency manager ([device] config): per-device budget for
         # pool-registered device memory (0 = auto), plus the async
         # cold-mirror prefetcher toggle.
@@ -233,7 +240,21 @@ class Server:
         # gauges/counters flow through the server's stats client and
         # evict/prefetch spans into its tracer.
         from pilosa_tpu import device as device_mod
+        from pilosa_tpu.ops import bitplane as bp
 
+        # Mesh-devices cap BEFORE any fragment opens: slice placement
+        # (home_device) and the slices mesh both derive from it.  Only
+        # an explicit cap is applied — the process-global default (all
+        # visible devices) must survive in-process multi-server setups.
+        if self.mesh_devices > 0:
+            bp.configure_mesh_devices(self.mesh_devices)
+        n_mesh = bp.mesh_device_count()
+        if n_mesh > 1:
+            self.logger(
+                f"data plane: mesh-sharded over {n_mesh} devices "
+                "(slice planes placed per shard, counts reduce over ICI); "
+                "set [device] mesh-devices = 1 to force single-device"
+            )
         device_mod.pool().configure(
             budget_bytes=self.hbm_budget_bytes,
             stats=self.stats,

@@ -212,26 +212,60 @@ def _parse_mesh_shape(shape: str) -> int | None:
     return want if want >= 1 else None
 
 
-@functools.lru_cache(maxsize=8)
-def _participating_devices(shape: str, n_local: int) -> tuple:
-    """The device tuple for slice placement under a mesh-shape cap —
+# [device] mesh-devices override (Server.open / bench / tests): the
+# process-global device-count cap for slice placement and the slices
+# mesh.  0 = unset (fall through to the envs, default all visible
+# devices); 1 = force the single-device data plane; N caps the mesh.
+_MESH_DEVICES_OVERRIDE = 0
+
+
+def configure_mesh_devices(n: int) -> None:
+    """Set (or with 0, clear) the process-wide ``[device] mesh-devices``
+    cap.  Placement is process-global state — in-process multi-server
+    setups (tests, bench grids) share whatever the last caller set."""
+    global _MESH_DEVICES_OVERRIDE
+    _MESH_DEVICES_OVERRIDE = max(0, int(n))
+
+
+def _mesh_devices_cap() -> int | None:
+    """The effective device cap: explicit configure_mesh_devices wins,
+    then ``PILOSA_DEVICE_MESH_DEVICES`` (0 = all visible), then the
+    legacy ``PILOSA_TPU_MESH_SHAPE`` factor product; None = uncapped.
+    Malformed values never silently disable sharding."""
+    if _MESH_DEVICES_OVERRIDE > 0:
+        return _MESH_DEVICES_OVERRIDE
+    raw = os.environ.get("PILOSA_DEVICE_MESH_DEVICES", "")
+    if raw:
+        try:
+            v = int(raw)
+            if v >= 1:
+                return v
+        except ValueError:
+            pass
+    return _parse_mesh_shape(os.environ.get("PILOSA_TPU_MESH_SHAPE", ""))
+
+
+@functools.lru_cache(maxsize=16)
+def _participating_devices(cap: int | None, n_local: int) -> tuple:
+    """The device tuple for slice placement under a device-count cap —
     cached so the per-slice hot paths don't re-derive it."""
-    want = _parse_mesh_shape(shape)
-    n = n_local if want is None else min(n_local, want)
+    n = n_local if cap is None else min(n_local, cap)
     return tuple(jax.local_devices()[:n])
 
 
 def participating_devices() -> tuple:
-    return _participating_devices(
-        os.environ.get("PILOSA_TPU_MESH_SHAPE", ""), len(jax.local_devices())
-    )
+    return _participating_devices(_mesh_devices_cap(), len(jax.local_devices()))
 
 
 def mesh_device_count() -> int:
     """Local devices participating in slice placement and the slices
-    mesh.  The ``tpu.mesh-shape`` config (env ``PILOSA_TPU_MESH_SHAPE``,
-    e.g. "4" or "4x2" — the product of the factors) caps it; default
-    all local devices."""
+    mesh.  The ``[device] mesh-devices`` config (env
+    ``PILOSA_DEVICE_MESH_DEVICES``; 0 = all visible, 1 = force
+    single-device) caps it, as does the legacy ``tpu.mesh-shape``
+    (``PILOSA_TPU_MESH_SHAPE``, e.g. "4" or "4x2" — the product of the
+    factors); default all local devices.  With >1 participating device
+    the mesh-sharded data plane engages BY DEFAULT
+    (parallel/mesh.default_slices_mesh)."""
     return len(participating_devices())
 
 

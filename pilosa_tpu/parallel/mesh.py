@@ -138,8 +138,9 @@ def distributed_count(
         n_partials = leaf_planes.shape[0] * leaf_planes.shape[2]
     sh = leaf_planes.sharding
     if isinstance(sh, NamedSharding) and n_partials <= plan.MAX_ONDEVICE_COUNT_PARTIALS:
-        limbs = plan.compiled_total_count(expr, sh.mesh)(leaf_planes)
-        return plan.recombine_count_limbs(jax.device_get(limbs))
+        with plan.collective_launch():
+            limbs = plan.compiled_total_count(expr, sh.mesh)(leaf_planes)
+            return plan.recombine_count_limbs(jax.device_get(limbs))
     return int(np.asarray(_count_tree(expr, leaf_planes), dtype=np.int64).sum())
 
 
@@ -195,9 +196,10 @@ def distributed_topn(plane: jax.Array, src: jax.Array, k: int):
     host stable-argsort for the exact reference tie-break."""
     sh = plane.sharding
     if isinstance(sh, NamedSharding) and plane.shape[0] <= plan.MAX_ONDEVICE_COUNT_PARTIALS:
-        per = plan.recombine_count_limbs(
-            jax.device_get(_topn_total_fn(sh.mesh)(plane, src))
-        )
+        with plan.collective_launch():
+            per = plan.recombine_count_limbs(
+                jax.device_get(_topn_total_fn(sh.mesh)(plane, src))
+            )
     else:
         per = np.asarray(_topn_partials(plane, src), dtype=np.int64).sum(axis=0)
     k = min(k, per.shape[0])

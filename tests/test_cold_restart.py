@@ -220,6 +220,84 @@ class TestStageErrorAccounting:
         holder.close()
 
 
+class TestStagingOntoMeshShards:
+    """ISSUE 12: cold staging must restore every mirror onto the
+    slice's OWNING mesh shard (slice mod n_devices), never the default
+    device — through both the background staging lane and the eager
+    warm path — with the priority order preserved on the multi-device
+    (virtual 8-device) mesh."""
+
+    def test_staged_mirrors_land_on_home_shards(self, tmp_path, fresh_pool):
+        import jax
+
+        assert len(jax.local_devices()) == 8  # conftest virtual mesh
+        holder = _build(str(tmp_path), frames=("f",))
+        # Pre-restart: touch two slices so the residency table has an
+        # MRU order to replay.
+        frags = {
+            f.slice: f
+            for f in holder.index("i").frame("f").view("standard").fragments()
+        }
+        frags[5].device_plane()
+        frags[3].device_plane()
+        holder.close()
+
+        device_mod._set_pool(PlanePool())
+        h2 = Holder(str(tmp_path))
+        h2.open()
+        pf = Prefetcher(max_workers=2)
+        job = h2.stage_device_mirrors(pf, hot_slices={"i": [7]})
+        assert job.wait(timeout=60.0)
+        assert job.snapshot()["errors"] == 0
+        for frag in h2.index("i").frame("f").view("standard").fragments():
+            mirror = frag._device
+            assert mirror is not None, f"slice {frag.slice} not staged"
+            (dev,) = mirror.devices()
+            assert dev == bp.home_device(frag.slice), (
+                f"slice {frag.slice} staged onto {dev}, "
+                f"owning shard is {bp.home_device(frag.slice)}"
+            )
+        # Mirrors are spread across the mesh, not piled on device 0.
+        devs = {
+            next(iter(f._device.devices()))
+            for f in h2.index("i").frame("f").view("standard").fragments()
+        }
+        assert len(devs) == 8
+        h2.close()
+
+    def test_priority_order_preserved_on_mesh(self, tmp_path, fresh_pool):
+        holder = _build(str(tmp_path), frames=("f",))
+        frags = {
+            f.slice: f
+            for f in holder.index("i").frame("f").view("standard").fragments()
+        }
+        frags[6].device_plane()
+        frags[1].device_plane()
+        holder.close()
+
+        device_mod._set_pool(PlanePool())
+        h2 = Holder(str(tmp_path))
+        h2.open()
+        rec = _RecordingPrefetcher()
+        h2.stage_device_mirrors(rec, hot_slices={"i": [4]})
+        order = [f.slice for f in rec.frags]
+        # Hot, then residency MRU-first, then the tail — the shard
+        # placement never reorders the priority queue.
+        assert order[:3] == [4, 1, 6]
+        h2.close()
+
+    def test_warm_device_mirrors_places_on_home_shards(
+        self, tmp_path, fresh_pool
+    ):
+        holder = _build(str(tmp_path), frames=("f",))
+        warmed = holder.warm_device_mirrors()
+        assert warmed == N_SLICES
+        for frag in holder.index("i").frame("f").view("standard").fragments():
+            (dev,) = frag._device.devices()
+            assert dev == bp.home_device(frag.slice)
+        holder.close()
+
+
 class TestGossipHotPiggyback:
     def test_hot_field_and_merge_roundtrip(self):
         from pilosa_tpu.cluster.gossip import GossipNodeSet

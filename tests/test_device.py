@@ -399,3 +399,131 @@ def test_debug_hbm_endpoint(tmp_path, fresh_pool):
     finally:
         s.close()
     assert fresh_pool.resident_bytes() == 0, "server close releases HBM"
+
+
+# ---------------------------------------------------------------------------
+# mesh-sharded byte accounting (ISSUE 12: charge each device only its
+# shard's bytes; per-shard residency visible in /debug/hbm)
+# ---------------------------------------------------------------------------
+
+
+class TestShardedAccounting:
+    def _sharded(self, n_slices=8, words=256):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from pilosa_tpu.parallel import mesh as pmesh
+
+        mesh = pmesh.default_slices_mesh()
+        assert mesh is not None and mesh.devices.size == 8
+        arr = np.zeros((n_slices, 2, words), dtype=np.uint32)
+        return (
+            jax.device_put(
+                arr, NamedSharding(mesh, P(pmesh.AXIS_SLICES, None, None))
+            ),
+            arr.nbytes,
+        )
+
+    def test_sharded_array_charges_per_shard(self):
+        sharded, nbytes = self._sharded()
+        bbd = device_mod.bytes_by_device(sharded)
+        assert len(bbd) == 8, "every mesh device owns a shard"
+        assert all(n == nbytes // 8 for n in bbd.values()), bbd
+        assert sum(bbd.values()) == nbytes
+
+    def test_replicated_array_charges_full_copy_per_device(self):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from pilosa_tpu.parallel import mesh as pmesh
+
+        mesh = pmesh.default_slices_mesh()
+        arr = np.zeros((4, 16), dtype=np.uint32)
+        rep = jax.device_put(arr, NamedSharding(mesh, P()))
+        bbd = device_mod.bytes_by_device(rep)
+        # Each device holds a FULL copy — an even split would
+        # under-account 8x.
+        assert len(bbd) == 8
+        assert all(n == arr.nbytes for n in bbd.values())
+
+    def test_sharded_entry_fits_per_device_budget(self, fresh_pool):
+        """The regression the even/global attribution broke: a sharded
+        array whose GLOBAL size exceeds the per-device budget — but
+        whose per-shard share fits — must admit without evicting
+        anything and without an over-budget breach."""
+        sharded, nbytes = self._sharded()
+        share = nbytes // 8
+        fresh_pool.configure(budget_bytes=2 * share)  # global is 8x share
+        fresh_pool.admit(
+            ("resident",),
+            {d: share for d in device_mod.bytes_by_device(sharded)},
+            lambda: True,
+            category="mirror",
+        )
+        fresh_pool.admit(
+            ("batch",),
+            device_mod.bytes_by_device(sharded),
+            lambda: True,
+            category="cache",
+            info={"cache": "batch"},
+        )
+        snap = fresh_pool.snapshot()
+        assert fresh_pool.evictions == 0
+        assert snap["counters"]["overBudget"] == 0
+        assert fresh_pool.contains(("resident",))
+        for dev in snap["devices"]:
+            assert dev["resident_bytes"] <= 2 * share
+        # /debug/hbm surfaces the per-shard rows.
+        batch_rows = [
+            row
+            for dev in snap["devices"]
+            for row in dev["entries"]
+            if row.get("cache") == "batch"
+        ]
+        assert len(batch_rows) == 8
+        assert all(row["bytes"] == share for row in batch_rows)
+        assert all(
+            row.get("sharded") and row.get("shards") == 8
+            for row in batch_rows
+        )
+
+    def test_executor_sharded_sweep_within_per_device_budget(
+        self, holder, fresh_pool
+    ):
+        """End to end at an artificial per-device budget: an 8-slice
+        mesh-sharded Count through the executor — mirrors land on their
+        home shards, the assembled global batch charges per shard, and
+        no device's reported residency exceeds its budget."""
+        n = 8
+        fill_fragments(holder, n)
+        frags = frags_of(holder, n)
+        plane_bytes = frags[0]._plane.nbytes
+        # Mirror (1 plane) + the batch entry's shard + zero-row slack
+        # fits; the GLOBAL batch (n x 2 leaves x 128 KiB) would not.
+        budget = 2 * plane_bytes
+        fresh_pool.configure(budget_bytes=budget)
+        c = new_cluster(1)
+        ex = Executor(holder, host=c.nodes[0].host, cluster=c)
+        try:
+            (cnt,) = ex.execute(
+                "i",
+                parse_string(
+                    "Count(Intersect(Bitmap(rowID=0, frame=f),"
+                    " Bitmap(rowID=1, frame=f)))"
+                ),
+                slices=list(range(n)),
+            )
+            assert int(cnt) == 0  # rows 0/1 share no columns per fixture
+            (cnt1,) = ex.execute(
+                "i",
+                parse_string("Count(Bitmap(rowID=0, frame=f))"),
+                slices=list(range(n)),
+            )
+            assert int(cnt1) == 2 * n
+            snap = fresh_pool.snapshot()
+            assert snap["counters"]["overBudget"] == 0
+            assert fresh_pool.evictions == 0
+            for dev in snap["devices"]:
+                assert dev["max_resident_bytes"] <= budget, dev
+        finally:
+            ex.close()

@@ -304,6 +304,165 @@ class TestShardedExecutor:
         )
 
 
+class TestShardedByDefault:
+    """ISSUE 12 acceptance: with >1 device visible, mesh-sharded
+    execution engages BY DEFAULT — no config required — and the
+    ``[device] mesh-devices`` knob can force it off (1) or cap it."""
+
+    def _executor(self, tmp_path, n_slices=8):
+        from pilosa_tpu.core.holder import Holder
+        from pilosa_tpu.exec.executor import Executor
+        from pilosa_tpu.ops.bitplane import SLICE_WIDTH
+
+        h = Holder(str(tmp_path / "data"))
+        h.open()
+        idx = h.create_index("i")
+        f = idx.create_frame("f")
+        for s in range(n_slices):
+            f.set_bit("standard", 1, s * SLICE_WIDTH + s)
+            f.set_bit("standard", 2, s * SLICE_WIDTH + s)
+        return h, Executor(holder=h, host="local")
+
+    def test_default_batch_is_mesh_sharded(self, tmp_path):
+        from pilosa_tpu.ops import bitplane as bp
+        from pilosa_tpu.parallel import mesh as pmesh
+        from pilosa_tpu.pql.parser import parse_string
+
+        assert bp.mesh_device_count() == 8  # no knob, all visible
+        h, ex = self._executor(tmp_path)
+        try:
+            call = parse_string(
+                'Count(Intersect(Bitmap(frame="f", rowID=1),'
+                ' Bitmap(frame="f", rowID=2)))'
+            ).calls[0].children[0]
+            ent = ex._cached_batch("i", call, list(range(8)))
+            assert ent["mesh"] is not None, (
+                "sharded execution must engage by default with >1 device"
+            )
+            assert ent["mesh"] is pmesh.default_slices_mesh()
+            assert len(ent["batch"].devices()) == 8
+        finally:
+            ex.close()
+            h.close()
+
+    def test_mesh_devices_1_forces_single_device(self, tmp_path):
+        import jax
+
+        from pilosa_tpu.ops import bitplane as bp
+        from pilosa_tpu.parallel import mesh as pmesh
+        from pilosa_tpu.pql.parser import parse_string
+
+        bp.configure_mesh_devices(1)
+        try:
+            assert bp.mesh_device_count() == 1
+            assert pmesh.default_slices_mesh() is None
+            h, ex = self._executor(tmp_path)
+            try:
+                call = parse_string(
+                    'Count(Bitmap(frame="f", rowID=1))'
+                ).calls[0].children[0]
+                ent = ex._cached_batch("i", call, list(range(8)))
+                assert ent["mesh"] is None
+                assert list(ent["batch"].devices()) == [jax.local_devices()[0]]
+                q = parse_string('Count(Bitmap(frame="f", rowID=1))')
+                assert ex.execute("i", q) == [8]
+            finally:
+                ex.close()
+                h.close()
+        finally:
+            bp.configure_mesh_devices(0)
+            pmesh._slices_mesh = None
+
+    def test_mesh_devices_env_caps(self, monkeypatch):
+        from pilosa_tpu.ops import bitplane as bp
+
+        monkeypatch.setenv("PILOSA_DEVICE_MESH_DEVICES", "4")
+        assert bp.mesh_device_count() == 4
+        monkeypatch.setenv("PILOSA_DEVICE_MESH_DEVICES", "0")
+        assert bp.mesh_device_count() == 8  # 0 = all visible
+        # malformed values never silently disable sharding
+        monkeypatch.setenv("PILOSA_DEVICE_MESH_DEVICES", "bogus")
+        assert bp.mesh_device_count() == 8
+        # explicit configure wins over env
+        bp.configure_mesh_devices(2)
+        try:
+            monkeypatch.setenv("PILOSA_DEVICE_MESH_DEVICES", "4")
+            assert bp.mesh_device_count() == 2
+        finally:
+            bp.configure_mesh_devices(0)
+
+    def test_server_applies_mesh_devices(self, tmp_path):
+        from pilosa_tpu.net.server import Server
+        from pilosa_tpu.ops import bitplane as bp
+        from pilosa_tpu.parallel import mesh as pmesh
+
+        s = Server(
+            data_dir=str(tmp_path / "data"),
+            host="127.0.0.1:0",
+            anti_entropy_interval=3600,
+            polling_interval=3600,
+            cache_flush_interval=3600,
+            mesh_devices=1,
+        )
+        s.open()
+        try:
+            assert bp.mesh_device_count() == 1
+        finally:
+            s.close()
+            bp.configure_mesh_devices(0)
+            pmesh._slices_mesh = None
+
+    def test_config_knob_roundtrip(self):
+        from pilosa_tpu import config as config_mod
+
+        cfg = config_mod.from_toml("[device]\nmesh-devices = 1\n")
+        assert cfg.device.mesh_devices == 1
+        assert "mesh-devices = 1" in cfg.to_toml()
+        cfg2 = config_mod.Config()
+        config_mod.apply_env(
+            cfg2, {"PILOSA_DEVICE_MESH_DEVICES": "4"}
+        )
+        assert cfg2.device.mesh_devices == 4
+        cfg2.device.mesh_devices = -1
+        with pytest.raises(config_mod.ConfigError):
+            cfg2.validate()
+
+
+def test_total_reduce_fused_over_mesh(rng):
+    """The fused multi-query "total" reduce: K distinct Count trees in
+    ONE interpreter pass over a sharded batch, the cross-slice sum as a
+    compiled all-reduce — only limb pairs reach the host."""
+    from pilosa_tpu.ops import bitplane as bp
+
+    m = slice_mesh(8)
+    planes = rng.integers(0, 2**32, size=(8, 3, W), dtype=np.uint32)
+    batch = jax.device_put(
+        planes, NamedSharding(m, P(AXIS_SLICES, None, None))
+    )
+    em = plan.FuseEmitter(4)
+    r_and = plan.lower_expr(("Intersect", ("leaf", 0), ("leaf", 1)), 0, em)
+    r_or = plan.lower_expr(
+        ("Union", ("leaf", 0), ("leaf", 1), ("leaf", 2)), 0, em
+    )
+    prog = np.zeros((8, 4), dtype=np.int32)
+    prog[: len(em.rows)] = np.asarray(em.rows, dtype=np.int32)
+    out_idx = np.asarray([r_and, r_or], dtype=np.int32)
+    # Leaf axis pads to the emitter's bucket (4).
+    padded = jax.device_put(
+        np.pad(planes, ((0, 0), (0, 1), (0, 0))),
+        NamedSharding(m, P(AXIS_SLICES, None, None)),
+    )
+    fn = plan.compiled_interp("total")
+    hlo = fn.fn.lower(padded, prog, out_idx).compile().as_text()
+    assert "all-reduce" in hlo, hlo[:2000]
+    res = np.asarray(jax.device_get(plan.interp_exec("total", padded, prog, out_idx)))
+    assert res.shape == (2, 2)
+    totals = plan.recombine_count_limbs(res)
+    a, b, c = planes[:, 0], planes[:, 1], planes[:, 2]
+    assert int(totals[0]) == int(np.bitwise_count(a & b).sum())
+    assert int(totals[1]) == int(np.bitwise_count(a | b | c).sum())
+
+
 def test_mesh_shape_config_caps_devices(monkeypatch):
     from pilosa_tpu.ops import bitplane as bp
     from pilosa_tpu.parallel import mesh as pmesh

@@ -41,6 +41,12 @@ def main() -> int:
         BENCH_SKIP_ADMISSION_TIER="1",
         # The live-resize tier has its own smoke (make resize-smoke).
         BENCH_SKIP_REBALANCE_TIER="1",
+        # Mesh-scaling tier at smoke scale: tiny curve corpus, a
+        # 16M-column headline (the 10B default is the real bench run),
+        # light node-grid seeding.
+        BENCH_MESH_SLICES="8",
+        BENCH_MESH_COLUMNS=str(16 * (1 << 20)),
+        BENCH_MESH_GRID_BITS="256",
     )
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py")],
@@ -135,6 +141,53 @@ def main() -> int:
         if key not in ms:
             print(f"FAIL: mixed_storm missing {key!r}: {ms}", file=sys.stderr)
             return 1
+    mesh = out.get("mesh_scaling")
+    if not isinstance(mesh, dict):
+        print(f"FAIL: artifact missing mesh_scaling tier: {out}", file=sys.stderr)
+        return 1
+    curve = mesh.get("curve")
+    if not isinstance(curve, dict) or set(curve) != {"1", "2", "4", "8"}:
+        print(
+            f"FAIL: mesh_scaling curve must cover 1/2/4/8 devices: {mesh}",
+            file=sys.stderr,
+        )
+        return 1
+    for d, point in curve.items():
+        if not point.get("byte_identical") or point.get("gcols_per_s", 0) <= 0:
+            print(
+                f"FAIL: mesh_scaling curve[{d}] implausible: {point}",
+                file=sys.stderr,
+            )
+            return 1
+        if point.get("sharded") != (d != "1"):
+            print(
+                f"FAIL: sharded execution must engage by default at"
+                f" {d} devices: {point}",
+                file=sys.stderr,
+            )
+            return 1
+    hl = mesh.get("headline")
+    if (
+        not isinstance(hl, dict)
+        or not hl.get("byte_identical")
+        or hl.get("gcols_per_s", 0) <= 0
+        or hl.get("devices", 0) < 2
+    ):
+        print(f"FAIL: mesh_scaling headline implausible: {hl}", file=sys.stderr)
+        return 1
+    ngrid = mesh.get("node_grid")
+    if not isinstance(ngrid, dict) or not ngrid:
+        print(f"FAIL: mesh_scaling missing node_grid: {mesh}", file=sys.stderr)
+        return 1
+    if not any(row.get("devices_per_node", 0) > 1 for row in ngrid.values()):
+        print(
+            f"FAIL: node_grid never ran a multi-device node: {ngrid}",
+            file=sys.stderr,
+        )
+        return 1
+    if not all(row.get("byte_identical") for row in ngrid.values()):
+        print(f"FAIL: node_grid byte-check failed: {ngrid}", file=sys.stderr)
+        return 1
     cold = out.get("cold_restart")
     if not isinstance(cold, dict):
         print(f"FAIL: artifact missing cold_restart tier: {out}", file=sys.stderr)
@@ -167,6 +220,9 @@ def main() -> int:
         f" {total_launches} launches, speedup={ms['speedup']},"
         f" interp entries {ms['interp_entries']}->"
         f"{ms['interp_entries_after_diversity']};"
+        f" mesh curve {[curve[d]['gcols_per_s'] for d in ('1', '2', '4', '8')]}"
+        f" Gcols/s, headline {hl['columns']} cols @ {hl['devices']} dev"
+        f" = {hl['gcols_per_s']} Gcols/s, grid {sorted(ngrid)};"
         f" cold restart first answer {cold['first_answer_ms']} ms"
     )
     return 0
