@@ -5,7 +5,7 @@
 
 PYTHON ?= python
 
-.PHONY: default test lint analyze typecheck check bench bench-smoke chaos-smoke load-smoke resize-smoke multichip-smoke churn-soak install build docker clean generate
+.PHONY: default test lint analyze typecheck check bench bench-smoke chaos-smoke load-smoke resize-smoke multichip-smoke tier-smoke churn-soak install build docker clean generate
 
 default: build test
 
@@ -101,6 +101,15 @@ resize-smoke:
 # (.github/workflows/check.yml).
 multichip-smoke:
 	$(PYTHON) tools/multichip_smoke.py
+
+# Tiered-storage smoke (tools/tier_smoke.py): local-FS object store;
+# demote under a forced disk budget -> cold-boot a node from an EMPTY
+# data dir + store alone -> byte-check Count/TopN/Range vs the donor
+# (/debug/tier showing cold->hydrating->hot) -> retention sweep ages
+# and deletes time-quantum views with a racing writer reviving one.
+# BLOCKING in CI (.github/workflows/check.yml), like resize-smoke.
+tier-smoke:
+	$(PYTHON) tools/tier_smoke.py
 
 # Gossip churn soak (tools/churn_soak.py): 20-50 virtual members under
 # seeded datagram loss + member flapping; asserts membership converges

@@ -696,6 +696,24 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — the artifact must survive
             log(f"cold-restart tier FAILED ({e!r:.300})")
 
+    # --- tier 8: tiered storage (disk budget << total plane bytes) -----
+    # The object-store cold tier (pilosa_tpu/tier): a skewed query
+    # storm over more fragments than the disk budget admits, so the
+    # LRU demotes and demand hydration pulls fragments back — versus
+    # the identical storm unbounded.  Records hydration p50/p99, the
+    # cold-hit rate, demotion/hydration cycle counts, and steady-state
+    # query p99 vs the unbounded baseline.
+    tiered = None
+    if os.environ.get("BENCH_SKIP_TIERED_TIER") != "1":
+        try:
+            tiered = with_retries(
+                "tiered tier",
+                lambda: run_tiered_tier(rng, cpu_fallback),
+                attempts=2,
+            )
+        except Exception as e:  # noqa: BLE001 — the artifact must survive
+            log(f"tiered tier FAILED ({e!r:.300})")
+
     if cpu_fallback:
         metric += "_cpu_fallback"
 
@@ -761,6 +779,8 @@ def main() -> None:
         out["mixed_storm"] = mixed_storm
     if cold_restart is not None:
         out["cold_restart"] = cold_restart
+    if tiered is not None:
+        out["tiered"] = tiered
     if cluster_reduce is not None:
         out["cluster_reduce"] = cluster_reduce
     if cluster_tpu is not None:
@@ -810,6 +830,160 @@ def measure_query(
     per_q = wall / n_conc
     conc_p50 = sorted(conc_lat)[len(conc_lat) // 2]
     return p50, per_q, conc_p50
+
+
+def run_tiered_tier(rng, cpu_fb=False) -> dict:
+    """Tiered-storage scenario (pilosa_tpu/tier): local-FS store,
+    disk budget set to ~1/3 of the hot fragment bytes (and the HBM
+    budget to half the per-device plane bytes), then a SKEWED Count
+    storm over every slice — the working set stays hot while the long
+    tail cycles demote->hydrate — versus the identical storm
+    unbounded.  The p99 ratio is the cost of serving an index that
+    does not fit local storage; the demotion/hydration counters prove
+    the cycle actually ran."""
+    import jax
+
+    from pilosa_tpu import device as device_mod
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.device.pool import PlanePool
+    from pilosa_tpu.exec.executor import Executor
+    from pilosa_tpu.obs.stats import ExpvarStatsClient
+    from pilosa_tpu.ops import bitplane as bpl
+    from pilosa_tpu.pql.parser import parse_string
+    from pilosa_tpu.tier import LocalFSStore, TierManager
+
+    n_dev = max(1, len(jax.local_devices()))
+    n_slices = 12 if cpu_fb else 32
+    rows = 16  # pad_rows(16) x 128 KiB = 2 MiB plane per fragment
+    n_queries = n_slices * (6 if cpu_fb else 10)
+    hot_set = max(2, n_slices // 4)
+
+    with tempfile.TemporaryDirectory() as d:
+        holder = Holder(os.path.join(d, "data"))
+        holder.open()
+        idx = holder.create_index("tiered")
+        fr = idx.create_frame("t", cache_size=256)
+        view = fr.create_view_if_not_exists("standard")
+        planes = rng.integers(
+            0, 2**32, size=(n_slices, rows, bpl.WORDS_PER_SLICE),
+            dtype=np.uint32,
+        )
+        for s in range(n_slices):
+            frag = view.create_fragment_if_not_exists(s)
+            prime_fragment(frag, planes[s], bpl.pad_rows)
+            frag.snapshot()  # disk accounting needs the real file bytes
+        want = {
+            s: int(np.bitwise_count(planes[s][0]).sum())
+            for s in range(n_slices)
+        }
+        total_disk = sum(
+            os.path.getsize(view.fragment(s).path) for s in range(n_slices)
+        )
+        plane_bytes = view.fragment(0)._plane.nbytes
+        per_dev = (n_slices + n_dev - 1) // n_dev
+        hbm_budget = per_dev * plane_bytes // 2
+        pq = parse_string("Count(Bitmap(rowID=0, frame=t))")
+
+        # 80% of queries hit the hot quarter, 20% sweep the tail — the
+        # access pattern tiering exists for.
+        seq = [
+            int(rng.integers(0, hot_set))
+            if rng.random() < 0.8
+            else int(rng.integers(0, n_slices))
+            for _ in range(n_queries)
+        ]
+
+        def storm(mgr) -> list:
+            lats = []
+            ex = Executor(holder, host="localhost:0")
+            try:
+                for s in seq:
+                    t0 = time.perf_counter()
+                    (n,) = ex.execute("tiered", pq, slices=[s])
+                    lats.append(time.perf_counter() - t0)
+                    assert n == want[s], (s, n, want[s])
+            finally:
+                ex.close()
+            lats.sort()
+            return lats
+
+        def pcts(lats) -> dict:
+            return {
+                "p50_ms": round(lats[len(lats) // 2] * 1e3, 2),
+                "p99_ms": round(
+                    lats[min(len(lats) - 1, int(len(lats) * 0.99))] * 1e3, 2
+                ),
+            }
+
+        # Warm compiles outside any timed window (shared fixed cost).
+        warm_ex = Executor(holder, host="localhost:0")
+        try:
+            for s in range(n_slices):
+                warm_ex.execute("tiered", pq, slices=[s])
+        finally:
+            warm_ex.close()
+
+        out = {
+            "n_fragments": n_slices,
+            "total_disk_mib": round(total_disk / 2**20, 2),
+        }
+        baseline = pcts(storm(None))
+        out["unbounded"] = baseline
+
+        stats = ExpvarStatsClient()
+        store = LocalFSStore(os.path.join(d, "store"), stats=stats)
+        disk_budget = max(1, total_disk // 3)
+        mgr = TierManager(
+            holder, store, stats=stats, disk_budget_bytes=disk_budget
+        )
+        mgr.attach_all()
+        mgr.upload_all(include_schema=False)
+        pool = PlanePool(budget_bytes=hbm_budget)
+        prev = device_mod._set_pool(pool)
+        try:
+            mgr.enforce_disk_budget()  # initial demotion to budget
+            lats = storm(mgr)
+            # drain the async budget sweeps before reading counters
+            t0 = time.monotonic()
+            while mgr._enforcing and time.monotonic() - t0 < 30:
+                time.sleep(0.05)
+        finally:
+            device_mod._set_pool(prev)
+        snap = stats.snapshot()
+        counts = snap.get("counts", {})
+        hyd = snap.get("histograms", {}).get("tier.hydrateMs", {})
+        tier = pcts(lats)
+        tier.update(
+            {
+                "disk_budget_mib": round(disk_budget / 2**20, 2),
+                "hbm_budget_mib": round(hbm_budget / 2**20, 2),
+                "demotions": counts.get("tier.demotions", 0),
+                "hydrations": counts.get("tier.hydrations", 0),
+                "cold_hit_rate": round(
+                    counts.get("tier.hydrations", 0) / len(seq), 3
+                ),
+                "hydrate_p50_ms": round(hyd.get("p50", 0.0), 2),
+                "hydrate_p99_ms": round(hyd.get("p99", 0.0), 2),
+            }
+        )
+        out["tiered"] = tier
+        out["p99_ratio"] = (
+            round(tier["p99_ms"] / baseline["p99_ms"], 2)
+            if baseline["p99_ms"]
+            else None
+        )
+        log(
+            f"tiered: disk budget {tier['disk_budget_mib']} MiB of"
+            f" {out['total_disk_mib']} MiB total; p50"
+            f" {tier['p50_ms']:.2f} ms p99 {tier['p99_ms']:.2f} ms"
+            f" ({out['p99_ratio']}x unbounded p99"
+            f" {baseline['p99_ms']:.2f} ms); {tier['demotions']}"
+            f" demotions, {tier['hydrations']} hydrations (cold-hit"
+            f" rate {tier['cold_hit_rate']}), hydrate p50"
+            f" {tier['hydrate_p50_ms']} ms p99 {tier['hydrate_p99_ms']} ms"
+        )
+        holder.close()
+        return out
 
 
 def run_hbm_pressure_tier(rng, cpu_fb=False) -> dict:

@@ -138,6 +138,12 @@ def build_server(cfg: config_mod.Config):
         rebalance_delta_cap=cfg.cluster.rebalance_delta_cap,
         rebalance_release_delay_ms=cfg.cluster.rebalance_release_delay_ms,
         rebalance_on_join=cfg.cluster.rebalance_on_join,
+        tier_store=cfg.tier.store,
+        tier_hydrate_throttle_mbps=cfg.tier.hydrate_throttle_mbps,
+        tier_disk_budget_bytes=cfg.tier.disk_budget_bytes,
+        tier_retention_age_s=cfg.tier.retention_age_s,
+        tier_retention_delete_s=cfg.tier.retention_delete_s,
+        tier_sweep_interval_s=cfg.tier.sweep_interval_s,
     )
 
 
@@ -496,6 +502,10 @@ def run_export(args) -> int:
 
 def run_backup(args) -> int:
     client = _client(args.host)
+    if getattr(args, "store", ""):
+        return _backup_to_store(client, args)
+    if not args.frame:
+        raise CommandError("--frame required (unless backing up --store)")
     w = _out(args)
     try:
         client.backup_to(w, args.index, args.frame, args.view)
@@ -505,10 +515,97 @@ def run_backup(args) -> int:
     return 0
 
 
+def _backup_to_store(client, args) -> int:
+    """``backup --store URL``: archive the server's schema plus every
+    fragment tar of the view into the object store (the tier layout —
+    ``schema.json`` + ``fragments/<index>/<frame>/<view>/<slice>.tar``)
+    so a node with only ``[tier] store`` configured cold-boots the
+    index from the store alone."""
+    import json as _json
+
+    from pilosa_tpu.tier import fragment_store_key, open_store
+    from pilosa_tpu.tier.manager import SCHEMA_KEY
+
+    store = open_store(args.store)
+    if store is None:
+        raise CommandError("--store must name a store location")
+    schema = client.schema()
+    store.put(SCHEMA_KEY, _json.dumps({"indexes": schema}).encode())
+    frames = (
+        [args.frame]
+        if args.frame
+        else [
+            f["name"]
+            for idx in schema
+            if idx["name"] == args.index
+            for f in idx.get("frames", [])
+        ]
+    )
+    n = 0
+    for frame in frames:
+        views = (
+            [args.view] if args.view else client.frame_views(args.index, frame)
+        )
+        for view in views:
+            max_slices = client.max_slice_by_index(
+                inverse=view.startswith("inverse")
+            )
+            for slice_i in range(max_slices.get(args.index, 0) + 1):
+                payload = client.backup_slice(args.index, frame, view, slice_i)
+                if payload is None:
+                    continue
+                store.put(
+                    fragment_store_key(args.index, frame, view, slice_i),
+                    payload,
+                )
+                n += 1
+    print(f"backed up {n} fragment(s) to {store.url}", file=sys.stderr)
+    return 0
+
+
 def run_restore(args) -> int:
     client = _client(args.host)
+    if getattr(args, "store", ""):
+        return _restore_from_store(client, args)
+    if not args.input_file:
+        raise CommandError("--input-file (or --store) required")
+    if not args.frame:
+        raise CommandError("--frame required (unless restoring --store)")
     with open(args.input_file, "rb") as r:
         client.restore_from(r, args.index, args.frame, args.view)
+    return 0
+
+
+def _restore_from_store(client, args) -> int:
+    """``restore --store URL``: push every matching fragment tar from
+    the object store into the server (its restore endpoint verifies
+    the tar's embedded checksums before installing)."""
+    import io as _io
+
+    from pilosa_tpu.tier import open_store, parse_fragment_store_key
+    from pilosa_tpu.tier.manager import FRAGMENT_PREFIX
+
+    store = open_store(args.store)
+    if store is None:
+        raise CommandError("--store must name a store location")
+    prefix = f"{FRAGMENT_PREFIX}{args.index}/"
+    if args.frame:
+        prefix += f"{args.frame}/"
+        if args.view:
+            prefix += f"{args.view}/"
+    n = 0
+    for meta in store.list(prefix):
+        parsed = parse_fragment_store_key(meta.key)
+        if parsed is None:
+            continue
+        index, frame, view, slice_i = parsed
+        client.restore_slice_from(
+            index, frame, view, slice_i, _io.BytesIO(store.get(meta.key))
+        )
+        n += 1
+    if n == 0:
+        raise CommandError(f"store holds no fragments under {prefix!r}")
+    print(f"restored {n} fragment(s) from {store.url}", file=sys.stderr)
     return 0
 
 

@@ -82,20 +82,50 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-o", "--output-file", default="", help="default stdout")
     p.set_defaults(fn=ctl.run_export)
 
-    p = sub.add_parser("backup", help="backup a view to a tar archive")
+    p = sub.add_parser(
+        "backup",
+        help="backup a view to a tar archive, or the whole index into "
+        "a tier object store (--store)",
+    )
     _add_host(p)
     p.add_argument("-i", "--index", required=True)
-    p.add_argument("-f", "--frame", required=True)
-    p.add_argument("-v", "--view", default="standard")
+    p.add_argument(
+        "-f", "--frame", default="",
+        help="frame to back up (with --store: default = every frame)",
+    )
+    p.add_argument(
+        "-v", "--view", default="standard",
+        help="view to back up; with --store pass '' for every view",
+    )
     p.add_argument("-o", "--output-file", default="", help="default stdout")
+    p.add_argument(
+        "--store", default="", metavar="URL",
+        help="tier object-store target (http://host:port, file:///path, "
+        "or a bare path): uploads schema.json + per-fragment tars in "
+        "the [tier] store layout",
+    )
     p.set_defaults(fn=ctl.run_backup)
 
-    p = sub.add_parser("restore", help="restore a view from a tar archive")
+    p = sub.add_parser(
+        "restore",
+        help="restore a view from a tar archive, or fragments from a "
+        "tier object store (--store)",
+    )
     _add_host(p)
     p.add_argument("-i", "--index", required=True)
-    p.add_argument("-f", "--frame", required=True)
-    p.add_argument("-v", "--view", default="standard")
-    p.add_argument("-d", "--input-file", required=True)
+    p.add_argument(
+        "-f", "--frame", default="",
+        help="frame to restore (with --store: default = every frame)",
+    )
+    p.add_argument(
+        "-v", "--view", default="standard",
+        help="view to restore; with --store pass '' for every view",
+    )
+    p.add_argument("-d", "--input-file", default="")
+    p.add_argument(
+        "--store", default="", metavar="URL",
+        help="tier object-store source (see backup --store)",
+    )
     p.set_defaults(fn=ctl.run_restore)
 
     p = sub.add_parser("check", help="offline consistency check of data files")

@@ -195,6 +195,22 @@ class FragmentError(RuntimeError):
     pass
 
 
+class FragmentRetiredError(FragmentError):
+    """A write landed on a fragment that left service (demoted to the
+    cold tier or released after migration).  Raised instead of
+    mutating the orphaned in-memory plane — the caller (View.set_bit)
+    retries through the view, which revives the fragment by
+    hydration; a second failure propagates loudly.  Bits are never
+    silently dropped."""
+
+
+class ArchiveChecksumError(FragmentError):
+    """A fragment tar's payload does not match its embedded per-entry
+    checksum — the named error restore paths reject on instead of
+    silently installing torn bytes (the tar self-verifies since the
+    tiered-storage PR; rebalance's out-of-band checksums remain)."""
+
+
 @dataclass
 class PairSet:
     """Parallel row/column id lists for block sync (reference:
@@ -352,6 +368,11 @@ class Fragment:
         self._block_sums: dict[int, bytes | None] = {}
         self._dirty_blocks: set[int] = set()
         self._opened = False
+        # Set by retire(): the fragment left service (tier demotion,
+        # post-migration release) and writes must raise rather than
+        # mutate the orphaned plane.  Reads stay valid — the host
+        # tiers still hold the content as of retirement.
+        self._retired = False
 
     # ------------------------------------------------------------------
     # lifecycle (reference: fragment.go:154-338)
@@ -488,6 +509,41 @@ class Fragment:
             _bump_write_epoch()
         # Outside the lock: listeners may take their own locks.
         _notify_close(self)
+
+    def retire(self) -> None:
+        """Take the fragment out of service permanently: block further
+        writes (they raise :class:`FragmentRetiredError` so the caller
+        revives through the view instead of losing bits), then close.
+        The tier manager's demotion path calls this AFTER the tar
+        upload verified, so retirement never strands unuploaded
+        state."""
+        self.mark_retired()
+        self.close()
+
+    def mark_retired(self) -> None:
+        with self._mu:
+            self._retired = True
+
+    def mark_retired_if_version(self, version: int) -> bool:
+        """Atomically retire ONLY if no write landed since ``version``
+        was read — the optimistic token the tier demotion path uses:
+        the uploaded tar snapshot is provably current when this
+        succeeds, and any write racing the demotion either bumped the
+        version first (demotion aborts) or arrives after retirement
+        (raises, and the view-level retry revives by hydration)."""
+        with self._mu:
+            if self._version != version:
+                return False
+            self._retired = True
+            return True
+
+    def _check_writable_locked(self) -> None:
+        if self._retired:
+            raise FragmentRetiredError(
+                f"fragment {self.index}/{self.frame}/{self.view}/"
+                f"{self.slice} is retired (demoted or released); "
+                "re-resolve it through the view"
+            )
 
     @property
     def cache_path(self) -> str:
@@ -1270,6 +1326,7 @@ class Fragment:
 
     def set_bit(self, row_id: int, column_id: int) -> bool:
         with self._mu:
+            self._check_writable_locked()
             pos = self.pos(row_id, column_id)
             offset = pos % SLICE_WIDTH
             grew = row_id > self._max_row_id
@@ -1294,6 +1351,7 @@ class Fragment:
 
     def clear_bit(self, row_id: int, column_id: int) -> bool:
         with self._mu:
+            self._check_writable_locked()
             pos = self.pos(row_id, column_id)
             offset = pos % SLICE_WIDTH
             slot = self._slot_of.get(row_id)
@@ -1400,6 +1458,7 @@ class Fragment:
         if len(row_ids) == 0 and len(clear_row_ids) == 0:
             return
         with self._mu:
+            self._check_writable_locked()
             rows = np.asarray(row_ids, dtype=np.int64)
             cols = np.asarray(column_ids, dtype=np.int64)
             min_col = self.slice * SLICE_WIDTH
@@ -2078,13 +2137,29 @@ class Fragment:
     # ------------------------------------------------------------------
 
     def _archive_payloads(self) -> list[tuple[str, bytes]]:
-        """Consistent snapshot of the two archive entries, taken under
-        the lock; serialization to tar happens lock-free so a slow
-        consumer never stalls writers."""
+        """Consistent snapshot of the archive entries, taken under the
+        lock; serialization to tar happens lock-free so a slow consumer
+        never stalls writers.
+
+        The archive SELF-VERIFIES: a leading "checksum" entry carries
+        the sha256 of every payload entry, so restore (and the tier
+        store's get) rejects torn bytes with
+        :class:`ArchiveChecksumError` instead of installing them —
+        previously only ``rebalance/`` checksummed, out-of-band."""
         with self._mu:
             data = roaring.encode_packed(*self._containers_packed())
             cache_data = self._encode_cache_ids(self.cache.ids())
-        return [("data", data), ("cache", cache_data)]
+        sums = json.dumps(
+            {
+                "algo": "sha256",
+                "entries": {
+                    "data": hashlib.sha256(data).hexdigest(),
+                    "cache": hashlib.sha256(cache_data).hexdigest(),
+                },
+            },
+            separators=(",", ":"),
+        ).encode()
+        return [("checksum", sums), ("data", data), ("cache", cache_data)]
 
     @staticmethod
     def _write_archive(entries: list[tuple[str, bytes]], w) -> None:
@@ -2113,33 +2188,69 @@ class Fragment:
             lambda w: self._write_archive(entries, w), chunk_bytes=chunk_bytes
         )
 
+    @staticmethod
+    def _verify_archive_payloads(payloads: dict[str, bytes]) -> None:
+        """Check every payload entry against the tar's embedded
+        "checksum" entry (when present — archives from before the
+        tiered-storage PR have none and install unverified, like the
+        reference's).  Raises :class:`ArchiveChecksumError` BEFORE any
+        payload is applied, so a torn transfer never half-installs."""
+        chk = payloads.pop("checksum", None)
+        if chk is None:
+            return
+        try:
+            entries = json.loads(chk).get("entries", {})
+        except (ValueError, AttributeError) as e:
+            raise ArchiveChecksumError(
+                f"fragment archive has an unreadable checksum entry: {e}"
+            ) from e
+        for name, want in entries.items():
+            payload = payloads.get(name)
+            if payload is None:
+                continue  # entry legitimately absent from this archive
+            got = hashlib.sha256(payload).hexdigest()
+            if got != want:
+                raise ArchiveChecksumError(
+                    f"fragment archive entry {name!r} is torn: sha256 "
+                    f"{got[:12]}… != recorded {str(want)[:12]}…"
+                )
+
     def read_from(self, r) -> None:
-        """Restore from a tar produced by write_to."""
+        """Restore from a tar produced by write_to.  Payloads are
+        collected and CHECKSUM-VERIFIED first (see
+        :meth:`_verify_archive_payloads`), then applied data-then-cache
+        — a rejected archive leaves the fragment untouched."""
         with self._mu:
             tr = tarfile.open(fileobj=r, mode="r|")
+            payloads: dict[str, bytes] = {}
             for member in tr:
-                payload = tr.extractfile(member).read()
-                if member.name == "data":
-                    words, arrays, _ = roaring.decode_tiered(payload)
-                    self._load_tiered(words, arrays)
-                    self._version += 1
-                    self._row_cache.clear()
-                    self._op_n = 0
-                    self._op_buf.clear()  # replaced wholesale below
-                    # persist
-                    with open(self.path + ".snapshotting", "wb") as fh:
-                        fh.write(payload)
-                    if self._file is not None:
-                        fcntl.flock(self._file.fileno(), fcntl.LOCK_UN)
-                        self._file.close()
-                    os.replace(self.path + ".snapshotting", self.path)
-                    self._file = open(self.path, "a+b")
-                    fcntl.flock(self._file.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
-                elif member.name == "cache":
-                    ids = self._decode_cache_ids(payload)
-                    if ids is None:
-                        continue
-                    self.cache = cache_mod.new_cache(self.cache_type, self.cache_size)
+                payloads[member.name] = tr.extractfile(member).read()
+            tr.close()
+            self._verify_archive_payloads(payloads)
+            payload = payloads.get("data")
+            if payload is not None:
+                words, arrays, _ = roaring.decode_tiered(payload)
+                self._load_tiered(words, arrays)
+                self._version += 1
+                self._row_cache.clear()
+                self._op_n = 0
+                self._op_buf.clear()  # replaced wholesale below
+                # persist
+                with open(self.path + ".snapshotting", "wb") as fh:
+                    fh.write(payload)
+                if self._file is not None:
+                    fcntl.flock(self._file.fileno(), fcntl.LOCK_UN)
+                    self._file.close()
+                os.replace(self.path + ".snapshotting", self.path)
+                self._file = open(self.path, "a+b")
+                fcntl.flock(self._file.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
+            cache_payload = payloads.get("cache")
+            if cache_payload is not None:
+                ids = self._decode_cache_ids(cache_payload)
+                if ids is not None:
+                    self.cache = cache_mod.new_cache(
+                        self.cache_type, self.cache_size
+                    )
                     self.cache.stats = self.stats
                     for row_id in ids:
                         if isinstance(row_id, int) and (
@@ -2154,7 +2265,6 @@ class Fragment:
                     # must notice even for a cache-only tar (the data
                     # branch bumps via _load_tiered).
                     _bump_write_epoch()
-            tr.close()
 
     # ------------------------------------------------------------------
 

@@ -60,6 +60,13 @@ class Frame:
         self.cache_size = DEFAULT_CACHE_SIZE
         self.inverse_enabled = False
         self.time_quantum = ""
+        # Tiered-storage retention overrides for this frame's
+        # time-quantum sub-views (pilosa_tpu/tier): seconds past a
+        # view's quantum end before it ages to the cold store, and
+        # before it deletes outright.  0 = inherit the node's
+        # ``[tier] retention-age-s`` / ``retention-delete-s``.
+        self.retention_age_s = 0.0
+        self.retention_delete_s = 0.0
         # BSI integer fields (pilosa_tpu/bsi): declared per frame when
         # range_enabled, each stored in its own ``field_<name>`` view.
         self.range_enabled = False
@@ -106,6 +113,8 @@ class Frame:
         self.inverse_enabled = meta.get("inverseEnabled", False)
         self.time_quantum = meta.get("timeQuantum", "")
         self.range_enabled = meta.get("rangeEnabled", False)
+        self.retention_age_s = float(meta.get("retentionAgeS", 0.0))
+        self.retention_delete_s = float(meta.get("retentionDeleteS", 0.0))
         self._fields = {
             f["name"]: bsi.BSIField(
                 name=f["name"], min=int(f["min"]), max=int(f["max"])
@@ -126,6 +135,8 @@ class Frame:
                         "inverseEnabled": self.inverse_enabled,
                         "timeQuantum": self.time_quantum,
                         "rangeEnabled": self.range_enabled,
+                        "retentionAgeS": self.retention_age_s,
+                        "retentionDeleteS": self.retention_delete_s,
                         "fields": [
                             self._fields[n].to_dict()
                             for n in sorted(self._fields)
@@ -143,6 +154,8 @@ class Frame:
         inverse_enabled: bool | None = None,
         time_quantum: str | None = None,
         range_enabled: bool | None = None,
+        retention_age_s: float | None = None,
+        retention_delete_s: float | None = None,
     ) -> None:
         with self._mu:
             if row_label is not None:
@@ -160,6 +173,14 @@ class Frame:
                 self.time_quantum = tq.parse_time_quantum(time_quantum)
             if range_enabled is not None:
                 self.range_enabled = range_enabled
+            if retention_age_s is not None:
+                if float(retention_age_s) < 0:
+                    raise ValidationError("retention age must be >= 0")
+                self.retention_age_s = float(retention_age_s)
+            if retention_delete_s is not None:
+                if float(retention_delete_s) < 0:
+                    raise ValidationError("retention delete must be >= 0")
+                self.retention_delete_s = float(retention_delete_s)
             self.save_meta()
 
     def set_time_quantum(self, q: str) -> None:
@@ -400,6 +421,8 @@ class Frame:
                 "inverseEnabled": self.inverse_enabled,
                 "timeQuantum": self.time_quantum,
                 "rangeEnabled": self.range_enabled,
+                "retentionAgeS": self.retention_age_s,
+                "retentionDeleteS": self.retention_delete_s,
                 "fields": [
                     self._fields[n].to_dict() for n in sorted(self._fields)
                 ],

@@ -86,6 +86,33 @@ def _next_unit_gte(t: datetime, end: datetime, unit: str) -> bool:
     return same or end > nxt
 
 
+_SUFFIX_UNITS = {4: "Y", 6: "M", 8: "D", 10: "H"}
+
+
+def parse_time_view(name: str) -> tuple[str, datetime, str] | None:
+    """Inverse of :func:`view_by_time_unit`: ``<base>_<stamp>`` ->
+    ``(base, period_start, unit)``, or None when ``name`` is not a
+    generated time view.  The tier retention sweep uses this to decide
+    which sub-views have aged past their quantum."""
+    base, sep, stamp = name.rpartition("_")
+    if not sep or not base or not stamp.isdigit():
+        return None
+    unit = _SUFFIX_UNITS.get(len(stamp))
+    if unit is None:
+        return None
+    try:
+        t = datetime.strptime(stamp, _UNIT_FORMATS[unit])
+    except ValueError:
+        return None
+    return base, t, unit
+
+
+def view_period_end(t: datetime, unit: str) -> datetime:
+    """First instant AFTER the view's quantum period — the moment its
+    retention clock starts."""
+    return _add_unit(t, unit)
+
+
 def views_by_time_range(name: str, start: datetime, end: datetime, quantum: str) -> list[str]:
     """Minimal view cover of [start, end) (reference: time.go:95-167)."""
     has = {u: (u in quantum) for u in "YMDH"}

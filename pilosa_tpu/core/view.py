@@ -7,6 +7,14 @@ routes bit writes by ``columnID // SLICE_WIDTH`` (reference:
 view.go:262-279), and notifies the cluster when a write grows the max
 slice (reference: view.go:218-250 broadcasting CreateSliceMessage — here
 an ``on_create_slice`` callback wired up by the server).
+
+Tiered storage (pilosa_tpu/tier) adds a third fragment state beyond
+hot/absent: **cold** — the fragment's metadata is resident here (the
+slice counts toward ``max_slice`` and ``fragment_slices``) but its
+bytes live as a tar in the object store.  First touch through
+:meth:`fragment` or :meth:`create_fragment_if_not_exists` hydrates via
+the attached ``hydrator`` (the TierManager); a failed hydration raises
+rather than silently serving an empty fragment.
 """
 
 from __future__ import annotations
@@ -17,7 +25,7 @@ import threading
 from collections.abc import Callable
 
 from pilosa_tpu.core import cache as cache_mod
-from pilosa_tpu.core.fragment import Fragment
+from pilosa_tpu.core.fragment import Fragment, FragmentRetiredError
 from pilosa_tpu.obs.stats import NopStatsClient
 from pilosa_tpu.ops.bitplane import SLICE_WIDTH
 
@@ -60,6 +68,13 @@ class View:
         self.logger = lambda msg: print(msg, file=sys.stderr)  # re-wired alongside stats
         self._mu = threading.RLock()
         self._fragments: dict[int, Fragment] = {}
+        # COLD fragments: slice -> opaque store metadata (set by the
+        # tier manager).  Metadata resident, bytes in the object store;
+        # first touch hydrates through ``hydrator``.  Empty (and
+        # hydrator None) on nodes without a configured tier — the hot
+        # paths pay one falsy check.
+        self._cold: dict[int, object] = {}
+        self.hydrator = None  # TierManager, attached with cold entries
 
     # --- lifecycle (reference: view.go:97-154) ---
 
@@ -103,32 +118,60 @@ class View:
 
     def fragment(self, slice_i: int) -> Fragment | None:
         with self._mu:
-            return self._fragments.get(slice_i)
+            frag = self._fragments.get(slice_i)
+            if frag is not None:
+                if self.hydrator is not None:
+                    self.hydrator.touch(self, slice_i)
+                return frag
+            if slice_i not in self._cold or self.hydrator is None:
+                return None
+        # Cold: hydrate OUTSIDE the view lock (store I/O must not hold
+        # a core data lock); the hydrator serializes per fragment.
+        return self.hydrator.hydrate(self, slice_i)
 
     def fragments(self) -> list[Fragment]:
+        """The HOT (locally materialized) fragments only — cold
+        fragments have no local state to flush/close/account."""
         with self._mu:
             return list(self._fragments.values())
 
     def fragment_slices(self) -> set[int]:
-        """Snapshot of the slice numbers that have fragments — lets the
-        executor's per-slice host walks skip slices this view never
-        materialized (a frame rarely spans the whole index slice range;
-        missing fragments contribute nothing to any query)."""
+        """Snapshot of the slice numbers that have fragments — hot OR
+        cold: a cold fragment's bits must still be found by the
+        executor's per-slice walks (the walk's ``fragment()`` call
+        hydrates it).  Missing slices contribute nothing to any
+        query."""
         with self._mu:
-            return set(self._fragments)
+            return set(self._fragments) | set(self._cold)
 
     def max_slice(self) -> int:
         with self._mu:
-            return max(self._fragments.keys(), default=0)
+            return max(
+                max(self._fragments.keys(), default=0),
+                max(self._cold.keys(), default=0),
+            )
 
     def create_fragment_if_not_exists(self, slice_i: int) -> Fragment:
         """reference: view.go:218-250"""
+        if self.hydrator is not None:
+            with self._mu:
+                cold = (
+                    slice_i in self._cold and slice_i not in self._fragments
+                )
+            if cold:
+                # A WRITE to a cold fragment revives it: hydrate first
+                # so the write lands on the full restored plane, never
+                # on a silently-empty shadow of it.  Hydration failures
+                # raise (loud) — see tier/manager.py.
+                frag = self.hydrator.hydrate(self, slice_i)
+                if frag is not None:
+                    return frag
         notify = False
         with self._mu:
             frag = self._fragments.get(slice_i)
             if frag is not None:
                 return frag
-            first = len(self._fragments) == 0
+            first = len(self._fragments) == 0 and not self._cold
             grew = slice_i > self.max_slice()
             frag = self._new_fragment(slice_i)
             frag.open()
@@ -150,12 +193,14 @@ class View:
         """Drop one fragment from service and DELETE its backing files
         — the rebalance source-release path: the fragment's device
         mirror/sparse rows deregister from the HBM pool (close), and
-        its disk footprint returns.  Returns False when the slice has
-        no fragment here."""
+        its disk footprint returns.  A COLD fragment releases by
+        dropping its registration (there are no local bytes).  Returns
+        False when the slice has no fragment here."""
         with self._mu:
             frag = self._fragments.pop(slice_i, None)
+            was_cold = self._cold.pop(slice_i, None) is not None
         if frag is None:
-            return False
+            return was_cold
         # close() outside the view lock (it notifies close listeners).
         frag.close()
         for path in (frag.path, frag.cache_path):
@@ -165,14 +210,100 @@ class View:
                 pass
         return True
 
+    # --- cold-fragment state (pilosa_tpu/tier) ---
+
+    def register_cold(self, slice_i: int, meta: object) -> bool:
+        """Record a cold fragment (bytes in the object store).  No-op
+        (False) when a hot fragment already holds the slice."""
+        with self._mu:
+            if slice_i in self._fragments:
+                return False
+            self._cold[slice_i] = meta
+            return True
+
+    def cold_slices(self) -> set[int]:
+        with self._mu:
+            return set(self._cold)
+
+    def cold_meta(self, slice_i: int) -> object | None:
+        with self._mu:
+            return self._cold.get(slice_i)
+
+    def drop_cold(self, slice_i: int) -> None:
+        with self._mu:
+            self._cold.pop(slice_i, None)
+
+    def _fragment_raw(self, slice_i: int) -> Fragment | None:
+        """Plain hot-map lookup — no hydration, no touch.  The
+        hydrator's own re-check path."""
+        with self._mu:
+            return self._fragments.get(slice_i)
+
+    def adopt_hydrated(self, slice_i: int, frag: Fragment) -> None:
+        """Install a freshly hydrated fragment and clear its cold
+        registration, atomically under the view lock."""
+        with self._mu:
+            self._fragments[slice_i] = frag
+            self._cold.pop(slice_i, None)
+
+    def demote_fragment(
+        self,
+        slice_i: int,
+        meta: object,
+        expect: Fragment | None = None,
+        expect_version: int | None = None,
+    ) -> Fragment | None:
+        """Flip a hot fragment to cold: RETIRE it (writes now raise and
+        retry through the view, which revives by hydration), pop it,
+        and register the store metadata — atomically under the view
+        lock.  With ``expect``/``expect_version`` the flip is
+        optimistic: it aborts (returns None, fragment stays hot) when
+        the fragment was replaced or written since the caller captured
+        the version — i.e. since the uploaded tar snapshot — so a
+        demotion can never strand a write.  The caller closes the
+        returned fragment and deletes its local files outside the
+        lock."""
+        with self._mu:
+            frag = self._fragments.get(slice_i)
+            if frag is None:
+                return None
+            if expect is not None:
+                if frag is not expect or not frag.mark_retired_if_version(
+                    expect_version or 0
+                ):
+                    return None
+            else:
+                frag.mark_retired()
+            del self._fragments[slice_i]
+            self._cold[slice_i] = meta
+            return frag
+
     # --- writes (reference: view.go:262-279) ---
 
     def set_bit(self, row_id: int, column_id: int) -> bool:
-        frag = self.create_fragment_if_not_exists(column_id // SLICE_WIDTH)
-        return frag.set_bit(row_id, column_id)
+        # Two attempts: a fragment retired by a concurrent demotion
+        # (tier LRU / retention sweep) revives through hydration on the
+        # retry; a second failure propagates loudly — a write is never
+        # silently dropped into a retired plane.
+        last: FragmentRetiredError | None = None
+        for _ in range(2):
+            frag = self.create_fragment_if_not_exists(
+                column_id // SLICE_WIDTH
+            )
+            try:
+                return frag.set_bit(row_id, column_id)
+            except FragmentRetiredError as e:
+                last = e
+        raise last  # type: ignore[misc]
 
     def clear_bit(self, row_id: int, column_id: int) -> bool:
-        frag = self.fragment(column_id // SLICE_WIDTH)
-        if frag is None:
-            return False
-        return frag.clear_bit(row_id, column_id)
+        last: FragmentRetiredError | None = None
+        for _ in range(2):
+            frag = self.fragment(column_id // SLICE_WIDTH)
+            if frag is None:
+                return False
+            try:
+                return frag.clear_bit(row_id, column_id)
+            except FragmentRetiredError as e:
+                last = e
+        raise last  # type: ignore[misc]

@@ -15,10 +15,14 @@ that reaches a fragment mid-upload simply blocks on that fragment's
 lock until its mirror is ready (the overlap is across fragments, not
 within one).
 
-Two priority lanes share the workers:
+Three priority lanes share the workers:
 
 * **query lane** (:meth:`prefetch`) — the per-query cold-mirror warm;
   always drains first.
+* **hydrate lane** (:meth:`run_hydration`) — cold-tier fragment
+  hydration (pilosa_tpu/tier): store fetch + tar restore jobs run
+  here so concurrent hydrations are bounded by the worker pool, and
+  the query lane's HBM warms still jump them (query lane wins).
 * **staging lane** (:meth:`stage`) — the post-restart background
   re-materialization of the whole residency set
   (core/holder.stage_device_mirrors).  A restarted node answers its
@@ -97,9 +101,11 @@ class Prefetcher:
     def __init__(self, pool=None, max_workers: int = DEFAULT_WORKERS):
         self._pool = pool
         self._max_workers = max_workers
-        # Two-lane work queue: query prefetches (high) always pop
-        # before background staging (low).
+        # Three-lane work queue: query prefetches (high) always pop
+        # before hydration jobs (mid), which pop before background
+        # staging (low).
         self._high: deque = deque()
+        self._mid: deque = deque()
         self._low: deque = deque()
         self._cv = threading.Condition(threading.Lock())
         self._threads: list[threading.Thread] = []
@@ -167,11 +173,30 @@ class Prefetcher:
                 self._submit(("stage", f, pool, job, throttle_s), low=True)
         return job
 
+    def run_hydration(self, fn):
+        """Run ``fn()`` on the HYDRATE lane and block for its result
+        (or re-raise its exception).  Cold-tier hydrations ride this so
+        their store fetch + restore work is bounded by the worker pool
+        while query-lane HBM warms still pop first.  The calling query
+        thread blocks here — hydration IS its critical path."""
+        done = threading.Event()
+        box: dict = {}
+        self._submit(("hydrate", fn, box, done), lane="mid")
+        done.wait()
+        if "exc" in box:
+            raise box["exc"]
+        return box.get("result")
+
     # ------------------------------------------------------------------
 
-    def _submit(self, item: tuple, low: bool) -> None:
+    def _submit(self, item: tuple, low: bool | None = None,
+                lane: str | None = None) -> None:
+        if lane is None:
+            lane = "low" if low else "high"
         with self._cv:
-            (self._low if low else self._high).append(item)
+            {"high": self._high, "mid": self._mid, "low": self._low}[
+                lane
+            ].append(item)
             if self._idle == 0 and len(self._threads) < self._max_workers:
                 t = threading.Thread(
                     target=self._worker, daemon=True, name="hbm-prefetch"
@@ -184,18 +209,33 @@ class Prefetcher:
     def _take(self) -> tuple:
         with self._cv:
             self._idle += 1
-            while not self._high and not self._low:
+            while not self._high and not self._mid and not self._low:
                 self._cv.wait()
             self._idle -= 1
-            return self._high.popleft() if self._high else self._low.popleft()
+            if self._high:
+                return self._high.popleft()
+            if self._mid:
+                return self._mid.popleft()
+            return self._low.popleft()
 
     def _worker(self) -> None:
         while True:
             item = self._take()
             if item[0] == "prefetch":
                 self._run_prefetch(*item[1:])
+            elif item[0] == "hydrate":
+                self._run_hydrate(*item[1:])
             else:
                 self._run_stage(*item[1:])
+
+    @staticmethod
+    def _run_hydrate(fn, box: dict, done: threading.Event) -> None:
+        try:
+            box["result"] = fn()
+        except BaseException as e:  # noqa: BLE001 — relayed to the caller
+            box["exc"] = e
+        finally:
+            done.set()
 
     def _run_prefetch(self, frag, pool, remaining, rlock, done) -> None:
         try:

@@ -712,6 +712,25 @@ class InternalClient:
             )
         tr.close()
 
+    def tier_restore(
+        self, index: str, frame: str, view: str, slice_i: int
+    ) -> int:
+        """Ask the node to restore one fragment from ITS configured
+        object store (the store-riding rebalance bulk-copy path).
+        Returns the restored byte count; raises ClientError 501 when
+        the node has no tier configured — callers fall back to peer
+        streaming."""
+        payload = json.dumps(
+            {
+                "index": index,
+                "frame": frame,
+                "view": view,
+                "slice": int(slice_i),
+            }
+        ).encode()
+        status, data = self._request("POST", "/tier/restore", body=payload)
+        return int(json.loads(self._check(status, data)).get("bytes", 0))
+
     def restore_frame(self, host: str, index: str, frame: str) -> None:
         """Ask the server to pull a frame from another cluster
         (reference: client.go:704-738)."""
@@ -817,7 +836,9 @@ class InternalClient:
 def _err_text(data: bytes) -> str:
     try:
         return json.loads(data).get("error", data.decode(errors="replace"))
-    except (json.JSONDecodeError, AttributeError):
+    except (json.JSONDecodeError, AttributeError, UnicodeDecodeError):
+        # UnicodeDecodeError: a non-UTF8 (e.g. protobuf) error body —
+        # json.loads raises it BEFORE JSONDecodeError can.
         return data.decode(errors="replace")
 
 

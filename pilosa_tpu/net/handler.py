@@ -160,6 +160,7 @@ class Handler:
         resilience=None,
         admission=None,
         rebalance=None,
+        tier=None,
     ):
         self.holder = holder
         self.executor = executor
@@ -191,6 +192,11 @@ class Handler:
         # endpoints, /debug/rebalance.  None = static cluster surface
         # (the endpoints answer 501).
         self.rebalance = rebalance
+        # Tiered storage (pilosa_tpu/tier): the TierManager behind
+        # GET /debug/tier and the store-riding rebalance restore
+        # endpoint POST /tier/restore.  None = no cold tier (the
+        # endpoints answer 501 / a stub document).
+        self.tier = tier
         # Staging-lane prefetcher (device/prefetch.py), wired by the
         # Server: fragments restored with ?stage=true (migration
         # arrivals) register their HBM mirrors through it.
@@ -243,6 +249,8 @@ class Handler:
             ("POST", r"/cluster/topology", self.handle_post_topology),
             ("POST", r"/rebalance/delta", self.handle_post_rebalance_delta),
             ("POST", r"/rebalance/release", self.handle_post_rebalance_release),
+            ("POST", r"/tier/restore", self.handle_post_tier_restore),
+            ("GET", r"/debug/tier", self.handle_get_tier),
             ("GET", r"/debug/rebalance", self.handle_get_rebalance),
             ("GET", r"/debug/vars", self.handle_get_vars),
             ("GET", r"/debug/health", self.handle_get_health),
@@ -485,6 +493,8 @@ class Handler:
             ("cacheSize", "cache_size"),
             ("timeQuantum", "time_quantum"),
             ("rangeEnabled", "range_enabled"),
+            ("retentionAgeS", "retention_age_s"),
+            ("retentionDeleteS", "retention_delete_s"),
         ):
             if json_key in options:
                 kwargs[py_key] = options[json_key]
@@ -1157,11 +1167,19 @@ class Handler:
             f = self.holder.frame(index, frame)
             if f is None:
                 return Response.error("frame not found", 404)
+            from pilosa_tpu.core.fragment import ArchiveChecksumError
+
             vw = f.create_view_if_not_exists(view)
             frag = vw.create_fragment_if_not_exists(int(slice_s))
-            # The tar reader pulls straight off the request body stream —
-            # a chunked restore applies archive entries as they arrive.
-            frag.read_from(req.body_reader())
+            # The tar reader pulls straight off the request body stream;
+            # payloads verify against the archive's embedded checksums
+            # before anything installs (core/fragment.read_from).
+            try:
+                frag.read_from(req.body_reader())
+            except ArchiveChecksumError as e:
+                # Torn bytes rejected with a NAMED failure — the sender
+                # must not believe a corrupt restore succeeded.
+                return Response.error(str(e), 422)
             if req.query.get("stage") == "true" and self.prefetcher is not None:
                 self.prefetcher.stage([frag])
             return Response.json({})
@@ -1286,6 +1304,52 @@ class Handler:
         finally:
             if ticket is not None:
                 ticket.release()
+
+    def handle_post_tier_restore(self, req: Request) -> Response:
+        """Store-riding rebalance bulk copy, target side: restore one
+        fragment from THIS node's configured object store instead of a
+        peer stream (the source verified the store copy's checksum is
+        fresh first).  Internal admission lane; 501 without a
+        configured tier so the source falls back to streaming."""
+        if self.tier is None:
+            return Response.error("tier not configured", 501)
+        ticket, shed = self._admit(adm.CLASS_INTERNAL, req)
+        if shed is not None:
+            return shed
+        try:
+            payload = json.loads(req.body or b"{}")
+            nbytes = self.tier.restore_from_store(
+                str(payload.get("index", "")),
+                str(payload.get("frame", "")),
+                str(payload.get("view", "")),
+                int(payload.get("slice", 0)),
+            )
+            if self.prefetcher is not None:
+                frag = self.holder.fragment(
+                    str(payload.get("index", "")),
+                    str(payload.get("frame", "")),
+                    str(payload.get("view", "")),
+                    int(payload.get("slice", 0)),
+                )
+                if frag is not None:
+                    self.prefetcher.stage([frag])
+            return Response.json({"bytes": nbytes})
+        except Exception as e:  # noqa: BLE001 — peer boundary
+            return Response.error(str(e), 409)
+        finally:
+            if ticket is not None:
+                ticket.release()
+
+    def handle_get_tier(self, req: Request) -> Response:
+        """Tiered-storage observability: per-fragment state (+ the
+        cold→hydrating→hot transition history), counts by state, disk
+        usage vs budget, retention config, and the store client's
+        health."""
+        if self.tier is None:
+            return Response.json(
+                {"fragments": {}, "note": "tier not configured"}
+            )
+        return Response.json(self.tier.snapshot())
 
     def handle_get_rebalance(self, req: Request) -> Response:
         """Migration observability: topology epoch + transition, the

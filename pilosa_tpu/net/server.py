@@ -81,6 +81,12 @@ class Server:
         rebalance_delta_cap: int = 50_000,
         rebalance_release_delay_ms: float = 200.0,
         rebalance_on_join: bool = False,
+        tier_store: str = "",
+        tier_hydrate_throttle_mbps: float = 0.0,
+        tier_disk_budget_bytes: int = 0,
+        tier_retention_age_s: float = 0.0,
+        tier_retention_delete_s: float = 0.0,
+        tier_sweep_interval_s: float = 60.0,
     ):
         self.data_dir = data_dir
         self.host = host
@@ -187,6 +193,17 @@ class Server:
         from pilosa_tpu.rebalance import Rebalancer
 
         self.rebalance = Rebalancer(self)
+        # Tiered storage ([tier] config, pilosa_tpu/tier): the shared
+        # object-store cold tier.  Built at open() (the store client
+        # shares the server's retry/breaker wiring); None when no
+        # store is configured.
+        self.tier_store = tier_store
+        self.tier_hydrate_throttle_mbps = tier_hydrate_throttle_mbps
+        self.tier_disk_budget_bytes = tier_disk_budget_bytes
+        self.tier_retention_age_s = tier_retention_age_s
+        self.tier_retention_delete_s = tier_retention_delete_s
+        self.tier_sweep_interval_s = tier_sweep_interval_s
+        self.tier = None
         self.executor: Executor | None = None
         self.handler: Handler | None = None
         self._http = None
@@ -284,6 +301,41 @@ class Server:
                     "from scratch on every process start"
                 )
         self.holder.open()
+
+        # Tiered storage: open the cold-store client (sharing the
+        # server's retry policy + per-host breakers), then BOOTSTRAP —
+        # restore the schema and register store-held fragments as cold
+        # BEFORE the first query routes, so a node with an empty data
+        # dir and only [tier] store configured serves the whole index,
+        # hydrating on demand.
+        if self.tier_store:
+            from pilosa_tpu.tier import TierManager, open_store
+
+            store = open_store(
+                self.tier_store,
+                stats=self.stats,
+                retry=self.resilience.retry,
+                breakers=self.resilience.breakers,
+            )
+            self.tier = TierManager(
+                holder=self.holder,
+                store=store,
+                prefetcher=device_mod.prefetcher(),
+                stats=self.stats,
+                tracer=self.tracer,
+                logger=self.logger,
+                hydrate_throttle_mbps=self.tier_hydrate_throttle_mbps,
+                disk_budget_bytes=self.tier_disk_budget_bytes,
+                retention_age_s=self.tier_retention_age_s,
+                retention_delete_s=self.tier_retention_delete_s,
+            )
+            boot = self.tier.bootstrap()
+            self.logger(
+                f"tier: cold store {store.url} attached "
+                f"({boot['cold']} cold fragment(s) registered, "
+                f"{boot['frames']} frame(s) restored from schema)"
+            )
+
         if self.coalesce:
             from pilosa_tpu.exec.coalesce import CoalesceScheduler
 
@@ -318,6 +370,7 @@ class Server:
             resilience=self.resilience,
             admission=self.admission,
             rebalance=self.rebalance,
+            tier=self.tier,
         )
         # Migration arrivals (?stage=true restores) register their HBM
         # mirrors through the background staging lane.
@@ -432,12 +485,18 @@ class Server:
         self._http_thread.start()
 
         # Background loops (reference: server.go:166-169).
-        for name, fn, interval in (
+        loops = [
             ("anti-entropy", self._tick_anti_entropy, self.anti_entropy_interval),
             ("max-slices", self._tick_max_slices, self.polling_interval),
             ("cache-flush", self._tick_cache_flush, self.cache_flush_interval),
             ("runtime", self._tick_runtime, self.polling_interval),
-        ):
+        ]
+        if self.tier is not None:
+            # Retention aging/deletion + disk-budget LRU demotion.
+            loops.append(
+                ("tier-sweep", self.tier.sweep, self.tier_sweep_interval_s)
+            )
+        for name, fn, interval in loops:
             t = threading.Thread(
                 target=self._loop,
                 args=(fn, interval),

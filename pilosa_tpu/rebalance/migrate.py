@@ -719,13 +719,33 @@ class Rebalancer:
     ) -> dict:
         """SOURCE side of the bulk copy: stream every view's fragment
         tar for the slice straight to the target's restore endpoint —
-        chunked, throttled, never materialized."""
+        chunked, throttled, never materialized.
+
+        With a tier store configured (pilosa_tpu/tier), fragments
+        whose store copy carries a CHECKSUM-FRESH logical checksum
+        restore from the STORE instead: the target pulls the tar from
+        shared storage (POST /tier/restore) and the source's uplink
+        carries nothing — a joining node no longer hammers its peers.
+        Stale/missing store copies (and targets without a tier, 501)
+        fall back to peer streaming; the post-copy delta-log replay
+        rounds close any gap either way."""
         if not target:
             raise RebalanceError("copy needs a target host")
+        tier = getattr(self._server, "tier", None)
         client = self._client(target, timeout=600.0)
         views = 0
         nbytes = 0
+        from_store = 0
         for frame, view, frag in list(self._slice_fragments(index, slice_i)):
+            if tier is not None:
+                restored = self._restore_via_store(
+                    client, tier, index, frame, view, slice_i, frag
+                )
+                if restored is not None:
+                    views += 1
+                    nbytes += restored
+                    from_store += 1
+                    continue
             reader = _ThrottledChunkReader(
                 frag.tar_chunks(chunk_bytes=self._server.stream_chunk_bytes),
                 bytes_per_sec=bytes_per_sec,
@@ -735,7 +755,27 @@ class Rebalancer:
             )
             views += 1
             nbytes += reader.bytes
-        return {"views": views, "bytes": nbytes}
+        if from_store:
+            self._stats.count("cluster.rebalance.storeRestores", from_store)
+        return {"views": views, "bytes": nbytes, "fromStore": from_store}
+
+    def _restore_via_store(
+        self, client, tier, index: str, frame: str, view: str,
+        slice_i: int, frag
+    ) -> int | None:
+        """One fragment's store-riding copy attempt: None = ride the
+        peer stream instead (store stale/missing, target tier-less, or
+        the restore failed)."""
+        try:
+            if tier.store_fresh_meta(frag) is None:
+                return None
+            return client.tier_restore(index, frame, view, slice_i)
+        except Exception as e:  # noqa: BLE001 — fall back to streaming
+            self._log(
+                f"store-riding copy of {index}/{slice_i} {frame}/{view} "
+                f"fell back to peer stream: {e}"
+            )
+            return None
 
     def _replay(self, index: str, slice_i: int, target: str) -> dict:
         """Drain the slice's delta log to the target in application
@@ -762,21 +802,45 @@ class Rebalancer:
     def release_slice(self, index: str, slice_i: int) -> dict:
         """Drop every local fragment of a slice this node no longer
         owns: device mirrors deregister from the HBM pool and the
-        backing files are deleted — capacity actually returns."""
+        backing files are deleted — capacity actually returns.
+
+        With a tier store configured, every fragment whose store copy
+        is stale (or absent) UPLOADS before its local bytes go — the
+        store stays a complete, fresh archive of released slices, so
+        the next join restores from shared storage instead of peers.
+        Upload failures log and count but never block the release (the
+        new owners hold the data; durability-to-store is additive)."""
         if self._cluster.is_write_owner(self._host, index, slice_i):
             raise RebalanceError(
                 f"refusing to release {index}/{slice_i}: this node still "
                 "owns it"
             )
+        tier = getattr(self._server, "tier", None)
         released = 0
+        uploaded = 0
         idx = self._holder.index(index)
         if idx is not None:
             for frame in idx.frames().values():
                 for view in frame.views().values():
+                    if tier is not None:
+                        frag = view._fragment_raw(slice_i)
+                        if frag is not None:
+                            try:
+                                if tier.store_fresh_meta(frag) is None:
+                                    tier.upload_fragment(frag)
+                                    uploaded += 1
+                            except Exception as e:  # noqa: BLE001
+                                self._stats.count(
+                                    "cluster.rebalance.releaseUploadErrors"
+                                )
+                                self._log(
+                                    f"release upload of {index}/{slice_i} "
+                                    f"{frame.name}/{view.name} failed: {e}"
+                                )
                     if view.remove_fragment(slice_i):
                         released += 1
         self._stats.count("cluster.rebalance.fragmentsReleased", released)
-        return {"released": released}
+        return {"released": released, "uploaded": uploaded}
 
     # -- observability --------------------------------------------------
 

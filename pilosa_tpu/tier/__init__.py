@@ -1,0 +1,58 @@
+"""Tiered storage — the cold tier below the PR-3 residency hierarchy.
+
+The residency ladder so far is HBM mirror ← mmap'd host file ← nothing:
+a node cannot admit an index whose plane bytes exceed local disk+RAM,
+a joining node hydrates exclusively by hammering peers, and expired
+time-quantum views live forever.  This package extends the ladder one
+level down to a shared OBJECT STORE holding the existing fragment tar
+format from ``stream/``:
+
+* :mod:`pilosa_tpu.tier.store` — the pluggable object store: a
+  local-filesystem backend (tests/bench/smoke) and an S3-style HTTP
+  backend behind one interface, with content checksums on every object
+  and retry/breaker via ``net/resilience.py``.
+* :mod:`pilosa_tpu.tier.manager` — the node-side policy engine:
+  demand hydration of ``cold`` fragments (metadata resident, bytes in
+  the store) on first touch, token-throttled through the prefetcher's
+  hydrate lane; disk-budget accounting with LRU demotion back to
+  tar-only; time-quantum retention (age expired views to the store,
+  delete past a second horizon); and cold-boot bootstrap so a node
+  with an empty data dir and only ``[tier] store`` configured serves
+  the whole index.
+"""
+
+from __future__ import annotations
+
+from pilosa_tpu.tier.store import (  # noqa: F401
+    HTTPStore,
+    LocalFSStore,
+    ObjectMeta,
+    ObjectStore,
+    StoreChecksumError,
+    StoreError,
+    open_store,
+    serve_store,
+)
+from pilosa_tpu.tier.manager import (  # noqa: F401
+    HydrationError,
+    TierError,
+    TierManager,
+    fragment_store_key,
+    parse_fragment_store_key,
+)
+
+__all__ = [
+    "HTTPStore",
+    "HydrationError",
+    "LocalFSStore",
+    "ObjectMeta",
+    "ObjectStore",
+    "StoreChecksumError",
+    "StoreError",
+    "TierError",
+    "TierManager",
+    "fragment_store_key",
+    "open_store",
+    "parse_fragment_store_key",
+    "serve_store",
+]

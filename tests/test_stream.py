@@ -240,7 +240,7 @@ class TestChunkedExport:
         assert all(len(f) <= CHUNK for f in frames)
         # The reassembled stream is a valid fragment archive.
         tr = tarfile.open(fileobj=io.BytesIO(b"".join(frames)), mode="r|")
-        assert sorted(m.name for m in tr) == ["cache", "data"]
+        assert sorted(m.name for m in tr) == ["cache", "checksum", "data"]
 
     def test_chunked_post_restore_roundtrip(self, holder, http_server):
         """Client restore streams the archive as a chunked request body;

@@ -197,6 +197,33 @@ def main() -> int:
         if key not in cold:
             print(f"FAIL: cold_restart missing {key!r}: {cold}", file=sys.stderr)
             return 1
+    tiered = out.get("tiered")
+    if not isinstance(tiered, dict):
+        print(f"FAIL: artifact missing tiered tier: {out}", file=sys.stderr)
+        return 1
+    for section in ("unbounded", "tiered"):
+        sec = tiered.get(section)
+        if not isinstance(sec, dict) or any(
+            k not in sec for k in ("p50_ms", "p99_ms")
+        ):
+            print(f"FAIL: tiered tier missing {section!r}: {tiered}", file=sys.stderr)
+            return 1
+    tt = tiered["tiered"]
+    # The demotion/hydration cycle must actually run: a disk budget
+    # << total bytes with zero demotions or hydrations means the cold
+    # tier silently disengaged.
+    if tt.get("demotions", 0) < 1 or tt.get("hydrations", 0) < 1:
+        print(
+            f"FAIL: tiered tier recorded no demotion/hydration cycle: {tt}",
+            file=sys.stderr,
+        )
+        return 1
+    if not (0 < tt.get("cold_hit_rate", 0) <= 1):
+        print(f"FAIL: implausible cold-hit rate: {tt}", file=sys.stderr)
+        return 1
+    if tt.get("hydrate_p99_ms", 0) <= 0:
+        print(f"FAIL: tiered tier missing hydration latency: {tt}", file=sys.stderr)
+        return 1
     pc = out.get("program_cache")
     if not isinstance(pc, dict) or "entries" not in pc or "bounds" not in pc:
         print(f"FAIL: artifact missing program_cache: {out}", file=sys.stderr)
@@ -223,7 +250,9 @@ def main() -> int:
         f" mesh curve {[curve[d]['gcols_per_s'] for d in ('1', '2', '4', '8')]}"
         f" Gcols/s, headline {hl['columns']} cols @ {hl['devices']} dev"
         f" = {hl['gcols_per_s']} Gcols/s, grid {sorted(ngrid)};"
-        f" cold restart first answer {cold['first_answer_ms']} ms"
+        f" cold restart first answer {cold['first_answer_ms']} ms;"
+        f" tiered p99 {tt['p99_ms']} ms ({tt['demotions']} demotions,"
+        f" {tt['hydrations']} hydrations, cold-hit {tt['cold_hit_rate']})"
     )
     return 0
 

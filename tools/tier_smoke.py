@@ -1,0 +1,198 @@
+"""CI smoke pass over the tiered-storage subsystem (pilosa_tpu/tier).
+
+A tiny CPU-only end-to-end wiring check, BLOCKING in CI (like
+resize-smoke for the elastic cluster): local-FS store →
+
+    1. a donor node imports multi-slice data (plain bits, a BSI field,
+       TopN-shaped rows, a time-quantum frame) and archives it to the
+       store (schema + per-fragment checksummed tars);
+    2. DEMOTE: the donor's disk budget is forced below its hot bytes —
+       the LRU sweep flips fragments to tar-only and queries
+       transparently hydrate them back, byte-identically;
+    3. COLD BOOT: a second node with an EMPTY data dir and only
+       [tier] store configured serves every query byte-identically to
+       the donor, with /debug/tier showing cold → hydrating → hot;
+    4. RETENTION: expired time-quantum views age to the store and
+       delete past the horizon on a sweep, and a racing writer to an
+       expired view revives it with no bit loss.
+
+Not a performance measurement — the `tiered` bench tier records those.
+Run via ``make tier-smoke``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+from datetime import datetime, timedelta
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def fail(msg: str) -> int:
+    print(f"FAIL: {msg}", file=sys.stderr)
+    return 1
+
+
+def main() -> int:  # noqa: PLR0911 — smoke gates exit at first failure
+    from pilosa_tpu.net.client import InternalClient
+    from pilosa_tpu.net.server import Server
+    from pilosa_tpu.ops.bitplane import SLICE_WIDTH
+
+    tmp = tempfile.mkdtemp(prefix="tier-smoke-")
+    store_url = os.path.join(tmp, "store")
+
+    def boot(name: str, **kwargs) -> Server:
+        s = Server(
+            data_dir=os.path.join(tmp, name),
+            host="127.0.0.1:0",
+            logger=lambda m: print(f"[{name}] {m}", file=sys.stderr),
+            tier_store=store_url,
+            anti_entropy_interval=3600,
+            polling_interval=3600,
+            cache_flush_interval=3600,
+            tier_sweep_interval_s=3600,
+            prewarm=False,
+            **kwargs,
+        )
+        s.open()
+        return s
+
+    queries = [
+        'Count(Bitmap(frame="f", rowID=1))',
+        'Count(Union(Bitmap(frame="f", rowID=1), Bitmap(frame="f", rowID=3)))',
+        'Count(Difference(Bitmap(frame="f", rowID=2), Bitmap(frame="f", rowID=4)))',
+        'TopN(frame="f", n=8)',
+        'Count(Range(frame="f", val > 50))',
+    ]
+
+    def run_all(client) -> list:
+        out = []
+        for q in queries:
+            r = client.execute_pql("i", q)
+            if hasattr(r, "__iter__"):
+                r = [(p.id, p.count) for p in r]
+            out.append(r)
+        return out
+
+    # ---- 1. donor: seed data across 3 slices + archive to the store --
+    donor = boot("donor")
+    c0 = InternalClient(donor.host)
+    c0.create_index("i")
+    c0.create_frame("i", "f", {"rangeEnabled": True})
+    c0.create_field("i", "f", "val", 0, 1000)
+    for s in range(3):
+        bits = [
+            ((c * 7 + s) % 13, s * SLICE_WIDTH + (c * 31) % SLICE_WIDTH)
+            for c in range(400)
+        ]
+        c0.import_bits("i", "f", s, bits)
+    c0.import_value(
+        "i", "f", "val", 0, list(range(64)), [((v * 17) % 999) for v in range(64)]
+    )
+    want = run_all(c0)
+    uploaded = donor.tier.upload_all()
+    if uploaded < 4:
+        return fail(f"donor uploaded only {uploaded} fragments")
+    print(f"tier-smoke: donor archived {uploaded} fragments", file=sys.stderr)
+
+    # ---- 2. demote under a forced disk budget; queries hydrate back --
+    donor.tier.disk_budget_bytes = 1
+    demoted = donor.tier.enforce_disk_budget()
+    if demoted < 4:
+        return fail(f"budget sweep demoted only {demoted} fragments")
+    snap = donor.tier.snapshot()
+    cold_n = snap["countsByState"].get("cold", 0)
+    if cold_n < 4:
+        return fail(f"expected >=4 cold fragments after demotion: {snap['countsByState']}")
+    after_demote = run_all(c0)
+    if after_demote != want:
+        return fail(f"post-demotion results diverged: {after_demote} != {want}")
+    hydrations = donor.tier.snapshot()["countsByState"].get("hot", 0)
+    if hydrations < 1:
+        return fail("queries did not hydrate demoted fragments")
+    print(
+        f"tier-smoke: demoted {demoted}, queries hydrated back byte-identically",
+        file=sys.stderr,
+    )
+    donor.close()
+
+    # ---- 3. cold boot: empty data dir + store only -------------------
+    cold = boot("empty")
+    c1 = InternalClient(cold.host)
+    snap = json.loads(c1._check(*c1._request("GET", "/debug/tier")))
+    if not snap["fragments"] or any(
+        v["state"] != "cold" for v in snap["fragments"].values()
+    ):
+        return fail(f"cold boot must register every fragment cold: {snap}")
+    got = run_all(c1)
+    if got != want:
+        return fail(f"cold-boot results diverged: {got} != {want}")
+    snap = json.loads(c1._check(*c1._request("GET", "/debug/tier")))
+    transitions = [
+        v["history"] for v in snap["fragments"].values() if v["state"] == "hot"
+    ]
+    if not transitions or any(
+        t[-3:] != ["cold", "hydrating", "hot"] for t in transitions
+    ):
+        return fail(f"/debug/tier must show cold->hydrating->hot: {snap}")
+    print(
+        f"tier-smoke: cold boot served {len(queries)} queries byte-identically"
+        f" ({len(transitions)} fragments hydrated)",
+        file=sys.stderr,
+    )
+    cold.close()
+
+    # ---- 4. retention: age + delete + racing-writer revival ----------
+    ret = boot("retention")
+    c2 = InternalClient(ret.host)
+    c2.create_index("t")
+    c2.create_frame("t", "ev", {"timeQuantum": "YMD"})
+    old = datetime.utcnow() - timedelta(days=400)
+    recent = datetime.utcnow() - timedelta(days=40)
+    pb_bits_old = [(1, c, int(old.timestamp() * 1e9)) for c in range(50)]
+    pb_bits_recent = [(2, c, int(recent.timestamp() * 1e9)) for c in range(50)]
+    c2.import_bits("t", "ev", 0, pb_bits_old + pb_bits_recent)
+    ret.tier.retention_age_s = 30 * 86400.0
+    ret.tier.retention_delete_s = 365 * 86400.0
+    out = ret.tier.sweep()
+    if out["aged"] < 1 or out["deleted"] < 1:
+        return fail(f"retention sweep must age and delete: {out}")
+    frame = ret.holder.frame("t", "ev")
+    old_view = f"standard_{old.strftime('%Y%m%d')}"
+    recent_view = f"standard_{recent.strftime('%Y%m%d')}"
+    if frame.view(old_view) is not None:
+        return fail(f"view {old_view} must be deleted past the horizon")
+    v = frame.view(recent_view)
+    if v is None or v.cold_slices() != {0}:
+        return fail(f"view {recent_view} must be aged to the store")
+    # racing writer to the aged view revives it — no bit loss
+    before = 50
+    c2.execute_pql(
+        "t",
+        f'SetBit(frame="ev", rowID=2, columnID=999, '
+        f'timestamp="{recent.strftime("%Y-%m-%dT%H:%M")}")',
+    )
+    frag = frame.view(recent_view).fragment(0)
+    if frag is None or frag.count() != before + 1 or not frag.contains(2, 999):
+        return fail("racing writer must revive the aged view without bit loss")
+    print(
+        f"tier-smoke: retention aged {out['aged']}, deleted {out['deleted']},"
+        " racing writer revived the aged view",
+        file=sys.stderr,
+    )
+    ret.close()
+
+    print(
+        "OK: demote -> cold-boot -> byte-check -> retention sweep all green"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
