@@ -5,7 +5,7 @@
 
 PYTHON ?= python
 
-.PHONY: default test lint analyze typecheck check bench bench-smoke chaos-smoke load-smoke resize-smoke multichip-smoke tier-smoke churn-soak install build docker clean generate
+.PHONY: default test lint analyze typecheck check bench bench-smoke chaos-smoke load-smoke resize-smoke multichip-smoke tier-smoke replication-smoke churn-soak install build docker clean generate
 
 default: build test
 
@@ -110,6 +110,15 @@ multichip-smoke:
 # BLOCKING in CI (.github/workflows/check.yml), like resize-smoke.
 tier-smoke:
 	$(PYTHON) tools/tier_smoke.py
+
+# Quorum-replication smoke (tools/replication_smoke.py): 3-node
+# replica-3 write storm at consistency=quorum with one replica KILLED
+# mid-storm -> restart -> breaker-triggered hint replay converges
+# checksums with zero lost writes and NO anti-entropy tick; a
+# consistency=all write against the dead replica fails loudly.
+# BLOCKING in CI (.github/workflows/check.yml), like resize-smoke.
+replication-smoke:
+	$(PYTHON) tools/replication_smoke.py
 
 # Gossip churn soak (tools/churn_soak.py): 20-50 virtual members under
 # seeded datagram loss + member flapping; asserts membership converges

@@ -404,6 +404,46 @@ def main() -> None:
         except Exception as e:
             log(f"rebalance tier failed: {e}")
 
+    # Replication tier (ISSUE 14 / ROADMAP 4): quorum write latency at
+    # one/quorum/all over a 3-node replica-3 cluster, plus the hinted-
+    # handoff drain rate — kill a replica under a quorum write burst,
+    # restart it, time breaker-triggered replay to checksum
+    # convergence (tools/replication_bench.py subprocess, CPU).
+    replication_tier = None
+    if os.environ.get("BENCH_SKIP_REPLICATION_TIER") != "1":
+        import subprocess
+
+        rpt = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "tools",
+            "replication_bench.py",
+        )
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+        try:
+            out = subprocess.run(
+                [sys.executable, rpt], env=env, capture_output=True,
+                timeout=900, text=True,
+            )
+            if out.returncode == 0 and out.stdout.strip():
+                for line in out.stderr.strip().splitlines():
+                    if line.startswith("[replication]"):
+                        log(line)
+                replication_tier = json.loads(
+                    out.stdout.strip().splitlines()[-1]
+                )
+                hr = replication_tier.get("hint_replay", {})
+                log(
+                    "replication tier: quorum write p99 "
+                    f"{replication_tier['writes']['quorum']['p99_ms']} ms, "
+                    f"hint drain {hr.get('hints_per_s')}/s "
+                    f"(converged={hr.get('converged')})"
+                )
+            else:
+                log(f"replication tier failed: rc={out.returncode} "
+                    f"stderr={out.stderr.strip()[-300:]!r}")
+        except Exception as e:
+            log(f"replication tier failed: {e}")
+
     # Mesh-scaling tier (ISSUE 12 / ROADMAP 2): the mesh-sharded data
     # plane end to end — devices-vs-Gcols/s curve at 1/2/4/8 devices,
     # the 10B-column Intersect+Count headline over the full mesh (ICI-
@@ -791,6 +831,8 @@ def main() -> None:
         out["admission_storm"] = admission_storm
     if rebalance_tier is not None:
         out["rebalance"] = rebalance_tier
+    if replication_tier is not None:
+        out["replication"] = replication_tier
     out["program_cache"] = {
         "entries": plan.program_cache_stats(),
         "bounds": plan.program_cache_bounds(),

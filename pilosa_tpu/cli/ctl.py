@@ -138,6 +138,10 @@ def build_server(cfg: config_mod.Config):
         rebalance_delta_cap=cfg.cluster.rebalance_delta_cap,
         rebalance_release_delay_ms=cfg.cluster.rebalance_release_delay_ms,
         rebalance_on_join=cfg.cluster.rebalance_on_join,
+        write_consistency=cfg.cluster.write_consistency,
+        read_consistency=cfg.cluster.read_consistency,
+        hint_cap=cfg.cluster.hint_cap,
+        hint_replay_throttle_mbps=cfg.cluster.hint_replay_throttle_mbps,
         tier_store=cfg.tier.store,
         tier_hydrate_throttle_mbps=cfg.tier.hydrate_throttle_mbps,
         tier_disk_budget_bytes=cfg.tier.disk_budget_bytes,
@@ -357,6 +361,7 @@ def _flush_values(client, args, pairs: list[tuple[int, int]]) -> None:
             slice_i,
             [c for c, _ in group],
             [v for _, v in group],
+            consistency=getattr(args, "consistency", "quorum"),
         )
 
 
@@ -429,6 +434,7 @@ def _import_parsed_block(client, args, block: bytes) -> bool:
                 args.frame,
                 s,
                 (r_s[lo : lo + args.buffer_size], c_s[lo : lo + args.buffer_size]),
+                consistency=getattr(args, "consistency", "quorum"),
             )
     return True
 
@@ -476,7 +482,13 @@ def _flush_bits(client, args, bits: list[tuple[int, int, int]]) -> None:
             f"importing slice: {slice_i}, n={len(by_slice[slice_i])}",
             file=sys.stderr,
         )
-        client.import_bits(args.index, args.frame, slice_i, by_slice[slice_i])
+        client.import_bits(
+            args.index,
+            args.frame,
+            slice_i,
+            by_slice[slice_i],
+            consistency=getattr(args, "consistency", "quorum"),
+        )
 
 
 # ---------------------------------------------------------------------------

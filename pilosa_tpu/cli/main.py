@@ -71,6 +71,13 @@ def build_parser() -> argparse.ArgumentParser:
         "-s", "--buffer-size", type=int, default=10_000_000,
         help="bits to buffer/sort before importing",
     )
+    p.add_argument(
+        "--consistency",
+        default="quorum",
+        choices=("one", "quorum", "all"),
+        help="replica acks required per slice payload (W-of-N; "
+        "unreachable replicas get hinted handoff)",
+    )
     p.add_argument("paths", nargs="+", help="CSV files ('-' = stdin)")
     p.set_defaults(fn=ctl.run_import)
 

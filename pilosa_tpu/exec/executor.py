@@ -114,6 +114,12 @@ class ExecOptions:
     """reference: executor.go:1302-1304 (+ resilience extensions)"""
 
     remote: bool = False
+    # Per-request consistency overrides (pilosa_tpu/replicate): "" means
+    # the server-configured [cluster] write-consistency /
+    # read-consistency default; one|quorum|all otherwise.  Ignored when
+    # no Replication is wired (bare library executors).
+    write_consistency: str = ""
+    read_consistency: str = ""
     # Graceful degradation: when every replica for a slice is down or
     # circuit-broken, reduce over the surviving slices and record the
     # lost ones in ``missing_slices`` instead of failing the query.
@@ -293,6 +299,7 @@ class Executor:
         tracer=None,
         prefetcher=None,
         coalescer=None,
+        replication=None,
     ):
         self.holder = holder
         self.host = host
@@ -300,6 +307,12 @@ class Executor:
         self.client_factory = client_factory
         self.max_writes_per_request = max_writes_per_request
         self.tracer = tracer or trace.NOP_TRACER
+        # Quorum replication (pilosa_tpu/replicate): when wired (Server
+        # does), write fan-out becomes W-of-N with hinted handoff and
+        # reads at quorum/all consistency version-check their replicas
+        # (read-repair on divergence).  None = the legacy best-effort
+        # fan-out (bare library use, remote legs).
+        self.replication = replication
         # Async HBM mirror prefetcher (device/prefetch.py): when wired
         # (Server does, gated on [device] prefetch), a query's cold leaf
         # mirrors re-materialize concurrently while planning proceeds.
@@ -468,6 +481,28 @@ class Executor:
         # Bulk attribute-insert fast path (reference: executor.go:119-122).
         if q.calls and all(c.name == "SetRowAttrs" for c in q.calls):
             return self._execute_bulk_set_row_attrs(index, q.calls, opt)
+
+        # Version-checked replica reads (pilosa_tpu/replicate): at
+        # read consistency quorum/all the touched slices' replica
+        # versions must agree before execution — divergence triggers a
+        # synchronous read-repair (newest -> stale, checksum-verified),
+        # which is what makes read-your-writes hold at W+R > N.  The
+        # default level "one" costs nothing here.
+        if (
+            self.replication is not None
+            and not opt.remote
+            and slices
+            and any(c.name not in WRITE_CALLS for c in q.calls)
+        ):
+            level = self.replication.read_consistency_for(opt)
+            if level != "one":
+                with self.tracer.span(
+                    "replicate.read", consistency=level
+                ) as sp:
+                    repaired = self.replication.ensure_read_consistency(
+                        index, slices, level
+                    )
+                    sp.annotate(repaired=repaired)
 
         # Async HBM prefetch: kick cold leaf-mirror uploads for the whole
         # query now, so host->device staging overlaps the per-call
@@ -2517,6 +2552,15 @@ class Executor:
             if wn is not None
             else self.cluster.fragment_nodes(index, slice_i)
         )
+        if self.replication is not None and not opt.remote:
+            # Quorum path (pilosa_tpu/replicate): W-of-N acknowledgement
+            # at the request's consistency, hints queued for unreachable
+            # replicas, sub-W failing LOUDLY — never "success because
+            # someone acked".
+            return self.replication.coordinate_write(
+                self, index, c, opt, view, write_fn, row_id, col_id,
+                slice_i, targets,
+            )
         for node in targets:
             if node.host == self.host:
                 if write_fn(view, row_id, col_id):
@@ -2811,7 +2855,10 @@ class Executor:
             resp.error = e
         return resp
 
-    def _exec_remote(self, node, index, q, slices, opt, idempotent=False) -> list:
+    def _exec_remote(
+        self, node, index, q, slices, opt, idempotent=False,
+        extra_headers=None,
+    ) -> list:
         """Forward a query to a peer (reference: executor.go:1045-1129).
 
         The rpc span's ids travel as X-Trace-Id/X-Span-Id headers; the
@@ -2820,7 +2867,8 @@ class Executor:
 
         ``idempotent`` marks the call safe to retry (read-only map
         legs); write fan-out stays single-shot, matching the client's
-        retry contract."""
+        retry contract.  ``extra_headers`` ride the same header channel
+        (the quorum coordinator's X-Write-Version stamp)."""
         if self.client_factory is None:
             raise ExecutorError(f"no client for remote node {node.host}")
         client = self.client_factory(node)
@@ -2828,6 +2876,8 @@ class Executor:
             "rpc.execute", node=node.host, slices=len(slices) if slices else 0
         ) as sp:
             headers = self.tracer.remote_headers(sp)
+            if extra_headers:
+                headers = {**(headers or {}), **extra_headers}
             kwargs = {}
             if getattr(client, "supports_resilience", False):
                 kwargs["idempotent"] = idempotent

@@ -451,14 +451,22 @@ class InternalClient:
         frame: str,
         slice_i: int,
         bits,
+        consistency: str = "quorum",
     ) -> None:
         """POST one slice's bits to every replica node (reference:
-        client.go:314-401).
+        client.go:314-401) with W-of-N acknowledgement.
 
         ``bits``: either a list of ``(row, col[, ts])`` tuples, or the
         vectorized form — a tuple of parallel numpy arrays ``(rows,
         cols[, timestamps])`` (discriminated by the ndarray element, so
-        a tuple-of-bit-tuples is still treated as bit tuples)."""
+        a tuple-of-bit-tuples is still treated as bit tuples).
+
+        ``consistency`` (one|quorum|all) sets W over the slice's write
+        owners: a sub-W ack count FAILS loudly naming the dead hosts —
+        never "success because someone acked" — and every unreachable
+        replica's payload is queued as a hint on the first acked node
+        (``POST /replicate/hint``) so it converges on recovery without
+        waiting for anti-entropy."""
         pb = wire.ImportRequest(Index=index, Frame=frame, Slice=slice_i)
         if (
             isinstance(bits, tuple)
@@ -484,36 +492,23 @@ class InternalClient:
                     [b[2] if len(b) > 2 and b[2] else 0 for b in bits]
                 )
         payload = pb.SerializeToString()
-        nodes = self.fragment_nodes(index, slice_i, write=True)
-        if not nodes:
-            raise ClientError(500, f"no nodes for slice {slice_i}")
-        errs = []
-        for node in nodes:
-            # One dead replica must not abort the fan-out: transport
-            # failures (and open breakers) collect alongside HTTP
-            # errors, each prefixed with the failing HOST, and every
-            # surviving replica still receives the import — a retry
-            # after the node recovers then converges all replicas.
-            try:
-                client = self._peer(node["host"])
-                status, data = client._request(
-                    "POST",
-                    "/import",
-                    body=payload,
-                    headers={"Content-Type": PROTOBUF, "Accept": PROTOBUF},
-                )
-                resp = wire.ImportResponse()
-                resp.ParseFromString(client._check(status, data))
-                if resp.Err:
-                    errs.append(f"{node['host']}: {resp.Err}")
-            except (
-                (ClientError, resilience.BreakerOpenError,
-                 resilience.ShedError)
-                + resilience.TRANSPORT_ERRORS
-            ) as e:
-                errs.append(f"{node['host']}: {e}")
-        if errs:
-            raise ClientError(500, "; ".join(errs))
+
+        def _post(client) -> None:
+            status, data = client._request(
+                "POST",
+                "/import",
+                body=payload,
+                headers={"Content-Type": PROTOBUF, "Accept": PROTOBUF},
+            )
+            resp = wire.ImportResponse()
+            resp.ParseFromString(client._check(status, data))
+            if resp.Err:
+                raise ClientError(500, resp.Err)
+
+        self._fanout_write(
+            index, slice_i, _post, consistency, "import", payload,
+            rows=len(pb.RowIDs),
+        )
 
     def import_value(
         self,
@@ -523,11 +518,11 @@ class InternalClient:
         slice_i: int,
         columns,
         values,
+        consistency: str = "quorum",
     ) -> None:
-        """POST one slice's field values to every replica node —
-        the columnar BSI import leg (mirrors :meth:`import_bits`'s
-        per-host error collection so one dead replica never aborts the
-        fan-out)."""
+        """POST one slice's field values to every replica node — the
+        columnar BSI import leg, with the same W-of-N acknowledgement +
+        hinted-handoff contract as :meth:`import_bits`."""
         payload = json.dumps(
             {
                 "index": index,
@@ -538,25 +533,169 @@ class InternalClient:
                 "values": np.asarray(values, dtype=np.int64).tolist(),
             }
         ).encode()
+
+        def _post(client) -> None:
+            status, data = client._request(
+                "POST", "/import-value", body=payload
+            )
+            client._check(status, data)
+
+        self._fanout_write(
+            index, slice_i, _post, consistency, "import-value", payload,
+            rows=len(np.asarray(columns)),
+        )
+
+    def _fanout_write(
+        self,
+        index: str,
+        slice_i: int,
+        post_fn,
+        consistency: str,
+        hint_kind: str,
+        payload: bytes,
+        rows: int,
+    ) -> None:
+        """Shared import fan-out: every write owner receives the
+        payload, acks tally against W = required_acks(consistency, N),
+        failed replicas' payloads queue as hints on the first acked
+        node, and a sub-W outcome raises with every failing host named."""
+        from pilosa_tpu.replicate.quorum import required_acks, validate_level
+
+        validate_level(consistency)
         nodes = self.fragment_nodes(index, slice_i, write=True)
         if not nodes:
             raise ClientError(500, f"no nodes for slice {slice_i}")
-        errs = []
+        acked: list[str] = []
+        errs: list[str] = []
+        failed_hosts: list[str] = []
         for node in nodes:
+            # One dead replica must not abort the fan-out: transport
+            # failures (and open breakers) collect alongside HTTP
+            # errors, each prefixed with the failing HOST, and every
+            # surviving replica still receives the import.
             try:
-                client = self._peer(node["host"])
-                status, data = client._request(
-                    "POST", "/import-value", body=payload
-                )
-                client._check(status, data)
+                post_fn(self._peer(node["host"]))
+                acked.append(node["host"])
             except (
                 (ClientError, resilience.BreakerOpenError,
                  resilience.ShedError)
                 + resilience.TRANSPORT_ERRORS
             ) as e:
                 errs.append(f"{node['host']}: {e}")
-        if errs:
-            raise ClientError(500, "; ".join(errs))
+                failed_hosts.append(node["host"])
+        hint_errs: list[str] = []
+        if failed_hosts and acked:
+            holder = self._peer(acked[0])
+            for host in failed_hosts:
+                try:
+                    holder.queue_hint(
+                        host, index, slice_i, hint_kind, payload, rows
+                    )
+                except (
+                    (ClientError, resilience.BreakerOpenError,
+                     resilience.ShedError)
+                    + resilience.TRANSPORT_ERRORS
+                ) as e:
+                    hint_errs.append(f"{host}: {e}")
+        need = required_acks(consistency, len(nodes))
+        if len(acked) < need:
+            raise ClientError(
+                500,
+                f"import acknowledged by {len(acked)} of {len(nodes)} "
+                f"replicas (need {need} at consistency={consistency}): "
+                + "; ".join(errs),
+            )
+        if hint_errs:
+            # W was met but the dead replicas' hints could not queue:
+            # convergence falls back to anti-entropy — fail loudly so
+            # the caller knows the handoff guarantee did not attach.
+            raise ClientError(
+                500, "import acked but hint queue failed: " + "; ".join(hint_errs)
+            )
+
+    # ------------------------------------------------------------------
+    # replication (pilosa_tpu/replicate)
+    # ------------------------------------------------------------------
+
+    def queue_hint(
+        self, target: str, index: str, slice_i: int, kind: str,
+        payload: bytes, rows: int,
+    ) -> None:
+        """Queue a write payload on THIS node as a hint destined for
+        ``target`` (hinted handoff: any live node may hold hints for a
+        dead replica)."""
+        body = json.dumps(
+            {
+                "target": target,
+                "index": index,
+                "slice": int(slice_i),
+                "kind": kind,
+                "payload": base64.b64encode(payload).decode(),
+                "rows": int(rows),
+            }
+        ).encode()
+        status, data = self._request("POST", "/replicate/hint", body=body)
+        self._check(status, data)
+
+    def replicate_versions(self, index: str, slices) -> dict[int, int]:
+        """The node's per-slice write versions for ``slices`` — the
+        read path's staleness probe (one call covers many slices)."""
+        body = json.dumps(
+            {"index": index, "slices": [int(s) for s in slices]}
+        ).encode()
+        # A pure read in POST shape (slice lists outgrow a query
+        # string) — idempotent, so it rides the retry policy.
+        status, data = self._request(
+            "POST", "/replicate/versions", body=body, idempotent=True
+        )
+        versions = json.loads(self._check(status, data))["versions"]
+        return {int(k): int(v) for k, v in versions.items()}
+
+    def observe_version(self, index: str, slice_i: int, version: int) -> None:
+        """Stamp the node's slice version forward (max-merge) — the
+        post-repair/post-replay convergence marker."""
+        body = json.dumps(
+            {
+                "index": index,
+                "slice": int(slice_i),
+                "version": int(version),
+                "action": "observe",
+            }
+        ).encode()
+        status, data = self._request(
+            "POST", "/replicate/versions", body=body, idempotent=True
+        )
+        self._check(status, data)
+
+    def import_raw(self, payload: bytes) -> None:
+        """Replay a queued /import payload verbatim on THIS node, on the
+        internal admission lane (hint replay must never starve behind a
+        client write storm)."""
+        status, data = self._request(
+            "POST",
+            "/import",
+            body=payload,
+            headers={
+                "Content-Type": PROTOBUF,
+                "Accept": PROTOBUF,
+                "X-Internal-Lane": "1",
+            },
+        )
+        resp = wire.ImportResponse()
+        resp.ParseFromString(self._check(status, data))
+        if resp.Err:
+            raise ClientError(500, resp.Err)
+
+    def import_value_raw(self, payload: bytes) -> None:
+        """Replay a queued /import-value payload verbatim (internal
+        lane)."""
+        status, data = self._request(
+            "POST",
+            "/import-value",
+            body=payload,
+            headers={"X-Internal-Lane": "1"},
+        )
+        self._check(status, data)
 
     def export_csv(self, index: str, frame: str, view: str, slice_i: int) -> str:
         """Whole-export convenience over :meth:`export_to`."""

@@ -63,6 +63,7 @@ from pilosa_tpu.net import resilience as rz
 from pilosa_tpu.net import wire_pb2 as wire
 from pilosa_tpu.obs import prom, trace
 from pilosa_tpu.pql.parser import parse_string
+from pilosa_tpu.replicate import quorum as replicate_mod
 from pilosa_tpu.testing import faults
 
 PROTOBUF = "application/x-protobuf"
@@ -161,6 +162,7 @@ class Handler:
         admission=None,
         rebalance=None,
         tier=None,
+        replication=None,
     ):
         self.holder = holder
         self.executor = executor
@@ -197,6 +199,11 @@ class Handler:
         # endpoint POST /tier/restore.  None = no cold tier (the
         # endpoints answer 501 / a stub document).
         self.tier = tier
+        # Quorum replication (pilosa_tpu/replicate): version/hint
+        # endpoints, /debug/replication, per-request consistency
+        # overrides, and the X-Write-Version stamp on remote write
+        # legs.  None = static single-copy surface (endpoints 501).
+        self.replication = replication
         # Staging-lane prefetcher (device/prefetch.py), wired by the
         # Server: fragments restored with ?stage=true (migration
         # arrivals) register their HBM mirrors through it.
@@ -250,6 +257,10 @@ class Handler:
             ("POST", r"/rebalance/delta", self.handle_post_rebalance_delta),
             ("POST", r"/rebalance/release", self.handle_post_rebalance_release),
             ("POST", r"/tier/restore", self.handle_post_tier_restore),
+            ("POST", r"/replicate/versions", self.handle_post_replicate_versions),
+            ("POST", r"/replicate/hint", self.handle_post_replicate_hint),
+            ("POST", r"/replicate/replay", self.handle_post_replicate_replay),
+            ("GET", r"/debug/replication", self.handle_get_replication),
             ("GET", r"/debug/tier", self.handle_get_tier),
             ("GET", r"/debug/rebalance", self.handle_get_rebalance),
             ("GET", r"/debug/vars", self.handle_get_vars),
@@ -814,13 +825,46 @@ class Handler:
                 q = parse_string(qreq["query"])
         except Exception as e:  # parser error
             return self._query_error(req, str(e), 400)
+        # Per-request consistency overrides (pilosa_tpu/replicate):
+        # header wins over query param; junk is a 400, not a silent
+        # default.
+        try:
+            write_consistency = _consistency_arg(
+                req, "X-Write-Consistency", "writeConsistency"
+            )
+            read_consistency = _consistency_arg(
+                req, "X-Read-Consistency", "readConsistency"
+            )
+        except ValueError as e:
+            return self._query_error(req, str(e), 400)
         opt = ExecOptions(
             remote=qreq["remote"],
             allow_partial=(
                 req.query.get("allowPartial") == "true"
                 or req.header("X-Allow-Partial") in ("1", "true")
             ),
+            write_consistency=write_consistency,
+            read_consistency=read_consistency,
         )
+        # Remote write legs carry the quorum coordinator's per-slice
+        # version stamp (taken at the PRIMARY after its local apply).
+        # Versions are pure local write counts — comparable across
+        # replicas because every replica applies the same stream — so
+        # the stamp is NOT merged into the clock (that would double-
+        # count this very write); it is the replica's self-staleness
+        # probe: applying this write should land the local counter AT
+        # the stamp, and landing short means earlier writes were missed
+        # (surfaced as cluster.replication.staleSelf before read-repair
+        # or hint replay ever looks).
+        stale_probe = None
+        if qreq["remote"] and self.replication is not None:
+            stamp = req.header(replicate_mod.WRITE_VERSION_HEADER)
+            if stamp:
+                try:
+                    slice_s, _, ver_s = stamp.partition(":")
+                    stale_probe = (int(slice_s), int(ver_s))
+                except (TypeError, ValueError):
+                    pass  # malformed stamp must not fail the write
         # Admission gate: classify from the parsed plan (remote map
         # legs ride the internal priority lane — a saturated node must
         # never starve another coordinator's fan-out behind its own
@@ -858,6 +902,14 @@ class Handler:
         finally:
             if ticket is not None:
                 ticket.release()
+
+        if stale_probe is not None:
+            probe_slice, probe_ver = stale_probe
+            if self.replication.versions.get(index, probe_slice) < probe_ver:
+                self.replication.stats.count(
+                    "cluster.replication.staleSelf"
+                )
+                root.annotate(stale_self=True)
 
         column_attr_sets = None
         if qreq["column_attrs"]:
@@ -914,7 +966,14 @@ class Handler:
                 "quantum": pb.Quantum or "YMDH",
                 "remote": pb.Remote,
             }
-        valid = {"slices", "columnAttrs", "time_granularity", "allowPartial"}
+        valid = {
+            "slices",
+            "columnAttrs",
+            "time_granularity",
+            "allowPartial",
+            "writeConsistency",
+            "readConsistency",
+        }
         for key in req.query:
             if key not in valid:
                 raise ValueError("invalid query params")
@@ -967,9 +1026,16 @@ class Handler:
         """Admission for non-query routes (imports, repair pushes):
         returns ``(ticket, None)`` or ``(None, 429 response)``.  The
         deadline comes straight off the request header — these routes
-        run outside the query path's deadline scope."""
+        run outside the query path's deadline scope.
+
+        ``X-Internal-Lane`` reclasses the request onto the internal
+        priority lane: hint replays push queued /import payloads
+        through the client write route, and cluster-internal traffic
+        must never starve behind (or be shed as) a client storm."""
         if self.admission is None:
             return None, None
+        if req.header("X-Internal-Lane") in ("1", "true"):
+            cls = adm.CLASS_INTERNAL
         dl = rz.Deadline.from_header(req.header(rz.DEADLINE_HEADER))
         try:
             return self.admission.acquire(cls, deadline=dl), None
@@ -1351,6 +1417,126 @@ class Handler:
             )
         return Response.json(self.tier.snapshot())
 
+    # ------------------------------------------------------------------
+    # quorum replication: versions / hints / replay
+    # ------------------------------------------------------------------
+
+    def handle_post_replicate_versions(self, req: Request) -> Response:
+        """Per-slice write versions — the read path's staleness probe.
+        Body ``{"index", "slices": [...]}`` answers the versions map;
+        ``{"action": "observe", "index", "slice", "version"}`` stamps
+        the slice's version forward (max-merge, post-repair marker).
+        Internal admission lane (replication control traffic)."""
+        if self.replication is None:
+            return Response.error("replication not configured", 501)
+        ticket, shed = self._admit(adm.CLASS_INTERNAL, req)
+        if shed is not None:
+            return shed
+        try:
+            payload = json.loads(req.body or b"{}")
+            index = str(payload.get("index", ""))
+            if payload.get("action") == "observe":
+                v = self.replication.versions.observe(
+                    index,
+                    int(payload.get("slice", 0)),
+                    int(payload.get("version", 0)),
+                )
+                return Response.json({"ok": True, "version": v})
+            slices = payload.get("slices") or []
+            return Response.json(
+                {
+                    "versions": {
+                        str(s): v
+                        for s, v in self.replication.versions.get_many(
+                            index, slices
+                        ).items()
+                    }
+                }
+            )
+        except (TypeError, ValueError, json.JSONDecodeError) as e:
+            return Response.error(str(e), 400)
+        finally:
+            if ticket is not None:
+                ticket.release()
+
+    def handle_post_replicate_hint(self, req: Request) -> Response:
+        """Queue a write payload on THIS node as a hint destined for
+        an unreachable replica (hinted handoff; the client-side import
+        fan-out posts here when a replica is down).  Body ``{"target",
+        "index", "slice", "kind": import|import-value|pql,
+        "payload"(b64)|"query", "rows"}``.  Internal lane."""
+        if self.replication is None:
+            return Response.error("replication not configured", 501)
+        ticket, shed = self._admit(adm.CLASS_INTERNAL, req)
+        if shed is not None:
+            return shed
+        try:
+            payload = json.loads(req.body or b"{}")
+            target = str(payload.get("target", ""))
+            index = str(payload.get("index", ""))
+            slice_i = int(payload.get("slice", 0))
+            kind = str(payload.get("kind", ""))
+            if not target or not index:
+                return Response.error("target and index required", 400)
+            if kind == "pql":
+                queued = self.replication.hints.queue_pql(
+                    target, index, slice_i, str(payload.get("query", ""))
+                )
+            else:
+                queued = self.replication.hints.queue_payload(
+                    target,
+                    index,
+                    slice_i,
+                    kind,
+                    base64.b64decode(payload.get("payload", "")),
+                    int(payload.get("rows", 1)),
+                )
+            if queued:
+                self.replication.stats.count(
+                    "cluster.replication.hintsQueued"
+                )
+            return Response.json({"queued": bool(queued)})
+        except (TypeError, ValueError, json.JSONDecodeError) as e:
+            return Response.error(str(e), 400)
+        finally:
+            if ticket is not None:
+                ticket.release()
+
+    def handle_post_replicate_replay(self, req: Request) -> Response:
+        """Force a synchronous hint replay (ops/test convenience —
+        the background replayer normally triggers off the target's
+        breaker transition).  Body ``{"target"?: host}``; answers the
+        per-target replayed-entry counts.  Internal lane."""
+        if self.replication is None:
+            return Response.error("replication not configured", 501)
+        ticket, shed = self._admit(adm.CLASS_INTERNAL, req)
+        if shed is not None:
+            return shed
+        try:
+            payload = json.loads(req.body or b"{}")
+            return Response.json(
+                {
+                    "replayed": self.replication.replay_now(
+                        str(payload.get("target", "")) or None
+                    )
+                }
+            )
+        except (TypeError, ValueError, json.JSONDecodeError) as e:
+            return Response.error(str(e), 400)
+        finally:
+            if ticket is not None:
+                ticket.release()
+
+    def handle_get_replication(self, req: Request) -> Response:
+        """Replication observability: consistency defaults, per-replica
+        hint backlog (entries/bits/slices, last replay outcome), local
+        per-slice write versions, and the replayer's state."""
+        if self.replication is None:
+            return Response.json(
+                {"hints": {}, "note": "replication not configured"}
+            )
+        return Response.json(self.replication.snapshot())
+
     def handle_get_rebalance(self, req: Request) -> Response:
         """Migration observability: topology epoch + transition, the
         coordinator's per-slice state machine, delta-log occupancy, and
@@ -1554,6 +1740,16 @@ class Handler:
                 self.broadcaster.send_sync(msg)
             except Exception as e:  # noqa: BLE001 — broadcast is best-effort
                 self.logger(f"broadcast error: {e}")
+
+
+def _consistency_arg(req: Request, header: str, param: str) -> str:
+    """A per-request consistency override: the header wins over the
+    query param; "" means the server default; anything else must be a
+    valid level (raises ValueError -> 400)."""
+    raw = req.header(header) or req.query.get(param, "")
+    if not raw:
+        return ""
+    return replicate_mod.validate_level(raw, param)
 
 
 def _coalesce_batch_stats(record: dict) -> dict | None:

@@ -81,6 +81,10 @@ class Server:
         rebalance_delta_cap: int = 50_000,
         rebalance_release_delay_ms: float = 200.0,
         rebalance_on_join: bool = False,
+        write_consistency: str = "quorum",
+        read_consistency: str = "one",
+        hint_cap: int = 10_000,
+        hint_replay_throttle_mbps: float = 0.0,
         tier_store: str = "",
         tier_hydrate_throttle_mbps: float = 0.0,
         tier_disk_budget_bytes: int = 0,
@@ -193,6 +197,31 @@ class Server:
         from pilosa_tpu.rebalance import Rebalancer
 
         self.rebalance = Rebalancer(self)
+        # Quorum replication ([cluster] write-consistency /
+        # read-consistency, pilosa_tpu/replicate): per-slice monotonic
+        # write versions, W-of-N write acknowledgement with hinted
+        # handoff for unreachable replicas, version-checked reads with
+        # read-repair.  The hint replayer triggers off the shared
+        # per-host breakers (open -> half-open = the recovery signal)
+        # and its repair pushes ride the rebalancer's delta machinery.
+        from pilosa_tpu.replicate import Replication
+
+        self.replication = Replication(
+            host=self.host,
+            cluster=self.cluster,
+            holder=self.holder,
+            client_factory=self._client_factory,
+            breakers=self.resilience.breakers,
+            rebalancer=self.rebalance,
+            tracer=self.tracer,
+            stats=self.holder.stats,
+            logger=self.logger,
+            data_dir=data_dir,
+            write_consistency=write_consistency,
+            read_consistency=read_consistency,
+            hint_cap=hint_cap,
+            hint_replay_throttle_mbps=hint_replay_throttle_mbps,
+        )
         # Tiered storage ([tier] config, pilosa_tpu/tier): the shared
         # object-store cold tier.  Built at open() (the store client
         # shares the server's retry/breaker wiring); None when no
@@ -210,6 +239,7 @@ class Server:
         self._http_thread = None
         self._closing = threading.Event()
         self._loops: list[threading.Thread] = []
+        self._ae_ticks = 0
 
     def _client_factory(self, node) -> InternalClient:
         """Inter-node clients carrying this server's resilience wiring:
@@ -371,15 +401,23 @@ class Server:
             admission=self.admission,
             rebalance=self.rebalance,
             tier=self.tier,
+            replication=self.replication,
         )
         # Migration arrivals (?stage=true restores) register their HBM
         # mirrors through the background staging lane.
         self.handler.prefetcher = device_mod.prefetcher()
         # The rebalance delta log captures the write stream of every
-        # actively-migrating slice from the fragment write hook.
+        # actively-migrating slice from the fragment write hook; the
+        # replication listener bumps per-slice write versions and feeds
+        # the quorum coordinator's hint-capture scope on the same hook.
         from pilosa_tpu.core import fragment as fragment_mod
 
         fragment_mod.register_write_listener(self.rebalance.delta_log.record)
+        if self.stats is not None:
+            self.replication.stats = self.holder.stats
+            self.replication.versions.stats = self.holder.stats
+            self.replication.hints.stats = self.holder.stats
+        fragment_mod.register_write_listener(self.replication.on_local_write)
         # ONE provider feeds both /state (the stream fallback's pull
         # endpoint, any cluster type) and gossip's piggybacked state —
         # the digest gossip advertises must be of the exact blob /state
@@ -414,6 +452,12 @@ class Server:
         # routes; migration resumes when the operator re-issues the
         # resize.
         self.rebalance.resume_from_disk()
+
+        # Replication opens AFTER the node identity is final (a ":0"
+        # port just resolved): persisted write versions restore and the
+        # hint replayer starts watching the shared breakers.
+        self.replication.host = self.host
+        self.replication.open()
 
         self.broadcast_receiver.start(self)
         ns = getattr(self.cluster, "node_set", None)
@@ -453,6 +497,7 @@ class Server:
                 device_mod.prefetcher() if self.device_prefetch else None
             ),
             coalescer=self.coalescer,
+            replication=self.replication,
             **kwargs,
         )
         self.handler.executor = self.executor
@@ -509,11 +554,15 @@ class Server:
     def close(self) -> None:
         self._closing.set()
         self.rebalance.close()
+        # Stops the hint replayer and persists the per-slice write
+        # versions (.replication.json) so a clean restart compares.
+        self.replication.close()
         from pilosa_tpu.core import fragment as fragment_mod
 
         fragment_mod.unregister_write_listener(
             self.rebalance.delta_log.record
         )
+        fragment_mod.unregister_write_listener(self.replication.on_local_write)
         if self._http is not None:
             self._http.shutdown()
             self._http.server_close()
@@ -551,14 +600,22 @@ class Server:
             except Exception as e:  # noqa: BLE001 — loops must survive
                 self.logger(f"background loop error: {e}")
 
+    # Every Nth anti-entropy tick ignores the version-agreement fast
+    # path and walks full block checksums — the backstop for the
+    # (crash-reset, equal-but-wrong) version edge cases.
+    FULL_SYNC_EVERY = 4
+
     def _tick_anti_entropy(self) -> None:
         from pilosa_tpu.sync.syncer import HolderSyncer
 
+        self._ae_ticks += 1
         HolderSyncer(
             holder=self.holder,
             host=self.host,
             cluster=self.cluster,
             closing=self._closing,
+            replication=self.replication,
+            full=(self._ae_ticks % self.FULL_SYNC_EVERY == 0),
         ).sync_holder()
 
     def _tick_max_slices(self) -> None:

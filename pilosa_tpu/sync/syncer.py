@@ -36,14 +36,32 @@ REPAIR_BATCH = 1000
 
 
 class HolderSyncer:
-    """reference: holder.go:357-556"""
+    """reference: holder.go:357-556
 
-    def __init__(self, holder, host: str, cluster, closing=None, client_factory=None):
+    With ``replication`` wired (pilosa_tpu/replicate), anti-entropy is
+    the BACKSTOP rather than the mechanism: a slice whose per-slice
+    write versions already agree across every replica skips the block
+    checksum walk entirely (``sync.skippedInSync``), and repairs that
+    do run are attributed by cause — ``cause:missed-hint`` when the
+    versions disagreed (writes a replica provably missed, i.e. hints
+    that overflowed or never replayed) vs ``cause:drift`` when versions
+    agreed but content diverged anyway.  ``full=True`` disables the
+    skip (the server forces it every Nth tick against the equal-but-
+    wrong version edge cases)."""
+
+    def __init__(
+        self, holder, host: str, cluster, closing=None, client_factory=None,
+        replication=None, full: bool = False,
+    ):
         self.holder = holder
         self.host = host
         self.cluster = cluster
         self.closing = closing or threading.Event()
         self.client_factory = client_factory or (lambda h: InternalClient(h, timeout=30.0))
+        self.replication = replication
+        self.full = full
+        # host -> {slice: version} fetched once per (peer, index).
+        self._peer_versions: dict[tuple[str, str], dict[int, int] | None] = {}
 
     def is_closing(self) -> bool:
         return self.closing.is_set()
@@ -51,11 +69,49 @@ class HolderSyncer:
     def _peers(self):
         return [n for n in self.cluster.nodes if n.host != self.host]
 
+    def _versions_of(self, host: str, index: str, max_slice: int):
+        """One peer's slice versions for an index, fetched once per
+        sweep; None = unreachable (treat as disagreeing)."""
+        key = (host, index)
+        if key not in self._peer_versions:
+            try:
+                self._peer_versions[key] = self.client_factory(
+                    host
+                ).replicate_versions(index, range(max_slice + 1))
+            except Exception:  # noqa: BLE001 — peer may be down/old
+                self._peer_versions[key] = None
+        return self._peer_versions[key]
+
+    def slice_cause(self, index: str, slice_i: int, max_slice: int) -> str | None:
+        """The sync decision for one slice: None = versions agree on
+        every replica (skip the checksum walk), ``"missed-hint"`` =
+        some replica's version lags (it provably missed writes),
+        ``"drift"`` = versions unavailable/equal-but-unproven (full
+        sweep, no replication, unreachable peer)."""
+        if self.replication is None or self.full:
+            return "drift"
+        local = self.replication.versions.get(index, slice_i)
+        if local <= 0:
+            return "drift"  # nothing observed yet: not provably in sync
+        for node in self.cluster.fragment_nodes(index, slice_i):
+            if node.host == self.host:
+                continue
+            versions = self._versions_of(node.host, index, max_slice)
+            if versions is None:
+                return "drift"
+            if versions.get(slice_i, 0) != local:
+                return "missed-hint"
+        return None
+
     def sync_holder(self) -> None:
         """reference: holder.go:379-430"""
         for index_name, idx in sorted(self.holder.indexes().items()):
             if self.is_closing():
                 return
+            # Per-(index, slice) sync decision, shared by every view of
+            # the slice: versions-agree slices skip their checksum walk.
+            causes: dict[int, str | None] = {}
+            index_max = max(idx.max_slice(), idx.max_inverse_slice())
             self.sync_index(index_name)
             for frame_name, frame in sorted(idx.frames().items()):
                 if self.is_closing():
@@ -83,11 +139,22 @@ class HolderSyncer:
                             self.host, index_name, slice_i
                         ):
                             continue
+                        if slice_i not in causes:
+                            causes[slice_i] = self.slice_cause(
+                                index_name, slice_i, index_max
+                            )
+                            if causes[slice_i] is None:
+                                self.holder.stats.count("sync.skippedInSync")
+                        if causes[slice_i] is None:
+                            continue  # replica versions agree: backstop only
                         # Create locally-absent fragments so data that
                         # exists only on peers is pulled (reference:
                         # holder.go:533-546 CreateFragmentIfNotExists).
                         view.create_fragment_if_not_exists(slice_i)
-                        self.sync_fragment(index_name, frame_name, view_name, slice_i)
+                        self.sync_fragment(
+                            index_name, frame_name, view_name, slice_i,
+                            cause=causes[slice_i],
+                        )
 
     def sync_index(self, index: str) -> None:
         """Column-attr convergence (reference: holder.go:432-475)."""
@@ -124,7 +191,8 @@ class HolderSyncer:
             blocks = f.row_attr_store.blocks()
 
     def sync_fragment(
-        self, index: str, frame: str, view: str, slice_i: int
+        self, index: str, frame: str, view: str, slice_i: int,
+        cause: str = "drift",
     ) -> None:
         f = self.holder.fragment(index, frame, view, slice_i)
         if f is None:
@@ -135,18 +203,38 @@ class HolderSyncer:
             cluster=self.cluster,
             closing=self.closing,
             client_factory=self.client_factory,
+            cause=cause,
+            holder_stats=self.holder.stats,
         ).sync_fragment()
 
 
 class FragmentSyncer:
-    """reference: fragment.go:1317-1498"""
+    """reference: fragment.go:1317-1498
 
-    def __init__(self, fragment, host: str, cluster, closing=None, client_factory=None):
+    ``cause`` attributes this sync's repairs: "missed-hint" = the
+    replica versions disagreed before the walk (writes a replica
+    provably missed — overflowed or never-replayed hints), "drift" =
+    versions agreed/unknown but checksums diverged anyway.  Rendered as
+    ``sync.repairBits[cause:*]`` on the holder stats."""
+
+    def __init__(
+        self, fragment, host: str, cluster, closing=None, client_factory=None,
+        cause: str = "drift", holder_stats=None,
+    ):
         self.fragment = fragment
         self.host = host
         self.cluster = cluster
         self.closing = closing or threading.Event()
         self.client_factory = client_factory or (lambda h: InternalClient(h, timeout=30.0))
+        self.cause = cause
+        self.holder_stats = holder_stats
+
+    def _count_repair_bits(self, n: int) -> None:
+        self.fragment.stats.count("repairBits", n)
+        if self.holder_stats is not None:
+            self.holder_stats.count_with_custom_tags(
+                "sync.repairBits", n, [f"cause:{self.cause}"]
+            )
 
     def is_closing(self) -> bool:
         return self.closing.is_set()
@@ -250,7 +338,7 @@ class FragmentSyncer:
                     # reference: fragment.go:1412 counts repairs; per
                     # batch here so dashboards see push progress.
                     f.stats.count("repairBatch")
-                    f.stats.count("repairBits", len(batch))
+                    self._count_repair_bits(len(batch))
             else:
                 # Derived views repair via the view-scoped raw write
                 # path: PQL cannot target an individual inverse/time
@@ -264,7 +352,6 @@ class FragmentSyncer:
                     (clear_ps.row_ids, [base + c for c in clear_ps.column_ids]),
                 )
                 f.stats.count("repairBatch")
-                f.stats.count(
-                    "repairBits",
-                    len(set_ps.column_ids) + len(clear_ps.column_ids),
+                self._count_repair_bits(
+                    len(set_ps.column_ids) + len(clear_ps.column_ids)
                 )

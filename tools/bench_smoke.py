@@ -41,6 +41,9 @@ def main() -> int:
         BENCH_SKIP_ADMISSION_TIER="1",
         # The live-resize tier has its own smoke (make resize-smoke).
         BENCH_SKIP_REBALANCE_TIER="1",
+        # The quorum-replication tier has its own smoke
+        # (make replication-smoke).
+        BENCH_SKIP_REPLICATION_TIER="1",
         # Mesh-scaling tier at smoke scale: tiny curve corpus, a
         # 16M-column headline (the 10B default is the real bench run),
         # light node-grid seeding.
