@@ -5,7 +5,7 @@
 
 PYTHON ?= python
 
-.PHONY: default test lint analyze typecheck check bench bench-smoke chaos-smoke load-smoke resize-smoke multichip-smoke tier-smoke replication-smoke churn-soak install build docker clean generate
+.PHONY: default test lint analyze typecheck check bench bench-smoke chaos-smoke device-chaos-smoke load-smoke resize-smoke multichip-smoke tier-smoke replication-smoke churn-soak install build docker clean generate
 
 default: build test
 
@@ -74,6 +74,16 @@ bench-smoke:
 # exactly.  Non-blocking in CI (.github/workflows/check.yml).
 chaos-smoke:
 	$(PYTHON) tools/chaos_smoke.py
+
+# Device-fault chaos pass (tools/device_chaos_smoke.py): on the virtual
+# 8-device mesh, a mixed Count/Range/TopN/Sum storm under EACH injected
+# device fault (oom / error / hang) must answer byte-identically via
+# host fallback, the device must quarantine within the configured
+# threshold, a hung collective must trip the launch watchdog instead of
+# wedging the process, and clearing the fault must heal through a
+# half-open probe.  BLOCKING in CI (.github/workflows/check.yml).
+device-chaos-smoke:
+	$(PYTHON) tools/device_chaos_smoke.py
 
 # Tiny CPU open-loop load pass (tools/load_smoke.py over the
 # tools/load_harness.py storm generator): asserts the artifact carries

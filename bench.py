@@ -444,6 +444,45 @@ def main() -> None:
         except Exception as e:
             log(f"replication tier failed: {e}")
 
+    # Degraded tier (ISSUE 15): device-fault tolerance figures —
+    # healthy vs quarantined-host-fallback Count Gcols/s + p50/p99
+    # (every degraded answer byte-checked), queries-to-quarantine at
+    # the configured threshold, and the watchdog trip recovery time
+    # for a hang injected inside the collective dispatch
+    # (tools/degraded_bench.py subprocess on the virtual mesh, CPU).
+    degraded_tier = None
+    if os.environ.get("BENCH_SKIP_DEGRADED_TIER") != "1":
+        import subprocess
+
+        dgt = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "tools",
+            "degraded_bench.py",
+        )
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+        try:
+            out = subprocess.run(
+                [sys.executable, dgt], env=env, capture_output=True,
+                timeout=900, text=True,
+            )
+            if out.returncode == 0 and out.stdout.strip():
+                for line in out.stderr.strip().splitlines():
+                    if line.startswith("[degraded]"):
+                        log(line)
+                degraded_tier = json.loads(out.stdout.strip().splitlines()[-1])
+                log(
+                    "degraded tier: healthy "
+                    f"{degraded_tier['healthy']['gcols_s']} Gcols/s vs "
+                    f"host-fallback {degraded_tier['degraded']['gcols_s']} "
+                    f"Gcols/s; watchdog trip recovery "
+                    f"{degraded_tier['watchdog']['trip_recovery_ms']} ms"
+                )
+            else:
+                log(f"degraded tier failed: rc={out.returncode} "
+                    f"stderr={out.stderr.strip()[-300:]!r}")
+        except Exception as e:
+            log(f"degraded tier failed: {e}")
+
     # Mesh-scaling tier (ISSUE 12 / ROADMAP 2): the mesh-sharded data
     # plane end to end — devices-vs-Gcols/s curve at 1/2/4/8 devices,
     # the 10B-column Intersect+Count headline over the full mesh (ICI-
@@ -833,6 +872,8 @@ def main() -> None:
         out["rebalance"] = rebalance_tier
     if replication_tier is not None:
         out["replication"] = replication_tier
+    if degraded_tier is not None:
+        out["degraded"] = degraded_tier
     out["program_cache"] = {
         "entries": plan.program_cache_stats(),
         "bounds": plan.program_cache_bounds(),

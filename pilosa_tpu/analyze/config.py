@@ -69,6 +69,10 @@ class AnalyzeConfig:
     call_edges: list[CallEdge] = field(default_factory=list)
     # function qualname -> class qualname it returns an instance of
     returns: dict[str, str] = field(default_factory=dict)
+    # function qualname -> LOCK id it returns (``with mod.fn():``
+    # acquires that lock — e.g. plan.collective_launch, whose returned
+    # module mutex the AST cannot otherwise attribute)
+    lock_returns: dict[str, str] = field(default_factory=dict)
     # attribute name -> class qualnames (fallback when inference fails)
     attr_types: dict[str, list[str]] = field(default_factory=dict)
     blocking_calls: list[str] = field(default_factory=list)
@@ -134,6 +138,7 @@ def load_config(path: str | None = None) -> AnalyzeConfig:
             )
         )
     cfg.returns = dict(locks.get("returns", {}))
+    cfg.lock_returns = dict(locks.get("lock-returns", {}))
     cfg.attr_types = {
         k: list(v) for k, v in locks.get("attr-types", {}).items()
     }

@@ -591,6 +591,19 @@ class PackageIndex:
     def resolve_lock_expr(self, mi, class_qual, node, local_locks) -> str | None:
         """Lock id of an expression used as ``with <expr>`` or
         ``<expr>.acquire()``; None when it isn't a known lock."""
+        if isinstance(node, ast.Call) and not node.args and not node.keywords:
+            # ``with mod.fn():`` where fn is a declared lock-returning
+            # factory ([locks.lock-returns] in analyze.toml — e.g.
+            # plan.collective_launch returning the process collective
+            # mutex): the acquisition is of the RETURNED lock.
+            sym = self.resolve_symbol(mi, node.func)
+            if sym is None and isinstance(node.func, ast.Name):
+                sym = mi.imports.get(node.func.id)
+            if sym:
+                lid = self.config.lock_returns.get(sym)
+                if lid and lid in self.locks:
+                    return lid
+            return None
         if isinstance(node, ast.Name):
             if node.id in local_locks:
                 return local_locks[node.id]

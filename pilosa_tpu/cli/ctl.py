@@ -116,6 +116,10 @@ def build_server(cfg: config_mod.Config):
         device_prefetch=cfg.device.prefetch,
         device_stage=cfg.device.stage,
         stage_throttle_ms=cfg.device.stage_throttle_ms,
+        launch_watchdog_ms=cfg.device.launch_watchdog_ms,
+        quarantine_threshold=cfg.device.quarantine_threshold,
+        quarantine_open_ms=cfg.device.quarantine_open_ms,
+        quarantine_probe_successes=cfg.device.quarantine_probe_successes,
         coalesce=cfg.exec.coalesce,
         coalesce_max_batch=cfg.exec.coalesce_max_batch,
         coalesce_max_wait_us=cfg.exec.coalesce_max_wait_us,

@@ -107,6 +107,7 @@ class GossipNodeSet:
         state_merger=None,
         state_fetcher=None,
         hot_provider=None,
+        health_provider=None,
         logger=None,
         stats=None,
         ack_timeout: float = 0.25,
@@ -139,6 +140,15 @@ class GossipNodeSet:
         # fragments FIRST (core/holder.stage_device_mirrors).
         self.hot_provider = hot_provider
         self._hot_remote: dict[str, tuple[float, dict]] = {}
+        # Device-health piggyback: ``health_provider() -> bool``
+        # (degraded = accelerator quarantined, node serving from host
+        # planes) rides every PING/ACK; receivers keep the per-peer
+        # flag and invoke ``on_peer_health(host, degraded)`` so the
+        # server can deprioritize degraded replicas in routing
+        # (Cluster.note_degraded).
+        self.health_provider = health_provider
+        self.on_peer_health = None
+        self._health_remote: dict[str, bool] = {}
         # Stream fallback: fetch a peer's whole state blob over its
         # HTTP listener (GET /state) when UDP chunking is the wrong
         # tool — injectable for tests.
@@ -496,6 +506,7 @@ class GossipNodeSet:
             self._merge_members(obj.get("members", []))
             self._merge_state(obj)
             self._merge_hot(sender, obj)
+            self._merge_health(sender, obj)
             self._send_logged(
                 _parse_addr(obj["gaddr"]),
                 {
@@ -505,6 +516,7 @@ class GossipNodeSet:
                     "members": self._member_list(),
                     **self._state_field(),
                     **self._hot_field(),
+                    **self._health_field(),
                 },
             )
         elif typ == "ack":
@@ -512,6 +524,7 @@ class GossipNodeSet:
             self._merge_members(obj.get("members", []))
             self._merge_state(obj)
             self._merge_hot(sender, obj)
+            self._merge_health(sender, obj)
             # SWIM relay leg 3: if someone asked us to probe this
             # sender, tell them it answered.
             with self._mu:
@@ -549,6 +562,7 @@ class GossipNodeSet:
                     "members": self._member_list(),
                     **self._state_field(),
                     **self._hot_field(),
+                    **self._health_field(),
                 },
             )
         elif typ == "ind-ack":
@@ -621,6 +635,36 @@ class GossipNodeSet:
                 if slices
             }
         }
+
+    def _health_field(self) -> dict:
+        if self.health_provider is None:
+            return {}
+        try:
+            degraded = bool(self.health_provider())
+        except Exception as e:  # noqa: BLE001
+            self.logger(f"health provider error: {e}")
+            return {}
+        # Only announce a non-default state (one key per datagram is
+        # cheap, but an always-healthy fleet should pay nothing).
+        return {"dvh": True} if degraded else {"dvh": False}
+
+    def _merge_health(self, sender: str, obj: dict) -> None:
+        flag = obj.get("dvh")
+        if not sender or not isinstance(flag, bool):
+            return
+        with self._mu:
+            prev = self._health_remote.get(sender)
+            self._health_remote[sender] = flag
+        if prev != flag and self.on_peer_health is not None:
+            try:
+                self.on_peer_health(sender, flag)
+            except Exception as e:  # noqa: BLE001 — advisory hook
+                self.logger(f"peer health callback error: {e}")
+
+    def remote_device_health(self) -> dict[str, bool]:
+        """{peer host: degraded} as last announced."""
+        with self._mu:
+            return dict(self._health_remote)
 
     def _merge_hot(self, sender: str, obj: dict) -> None:
         hot = obj.get("hot")
@@ -928,6 +972,7 @@ class GossipNodeSet:
                         "members": self._member_list(),
                         **self._state_field(),
                         **self._hot_field(),
+                    **self._health_field(),
                     },
                 )
             # SWIM suspect machinery: silence past suspect_after marks a
@@ -987,6 +1032,7 @@ class GossipNodeSet:
                         "members": self._member_list(),
                         **self._state_field(),
                         **self._hot_field(),
+                    **self._health_field(),
                     },
                 )
                 pool = [r for r in relays if r[0] != h]

@@ -82,6 +82,12 @@ class Node:
     host: str
     internal_host: str = ""
     state: str = NODE_STATE_DOWN
+    # Device-health flag (device/health.py, learned via the gossip
+    # piggyback or set locally): the node is UP but its accelerator is
+    # quarantined — it answers correctly from host planes, slower.
+    # Coordinators deprioritize degraded replicas when a healthy one
+    # owns the slice (executor._slices_by_node).
+    degraded: bool = False
 
     def set_state(self, s: str) -> None:
         self.state = s
@@ -131,6 +137,7 @@ class Cluster:
         self._mu = threading.Lock()
         self._epoch = 0
         self._routing_version = 0
+        self._health_version = 0
         self._transition: Transition | None = None
 
     # --- versioned topology --------------------------------------------
@@ -146,6 +153,27 @@ class Cluster:
         """Placement version: bumps with ``epoch`` AND on every
         per-slice ownership flip — the cache key for slice->node maps."""
         return self._routing_version
+
+    @property
+    def health_version(self) -> int:
+        """Replica-health version: bumped whenever any node's
+        device-degraded flag flips — the extra cache key that lets
+        slice->node routing maps react to degradation without a ring
+        mutation."""
+        return self._health_version
+
+    def note_degraded(self, host: str, degraded: bool) -> bool:
+        """Record a node's device-degraded flag (from the gossip
+        device-health piggyback, or the local health manager's state
+        changes).  Returns True when the flag actually flipped (and the
+        health version bumped); unknown hosts are ignored."""
+        node = self.node_by_host(host)
+        if node is None or node.degraded == bool(degraded):
+            return False
+        with self._mu:
+            node.degraded = bool(degraded)
+            self._health_version += 1
+        return True
 
     @property
     def transition(self) -> Transition | None:

@@ -123,6 +123,24 @@ class CoalesceClosed(RuntimeError):
     (uncoalesced) launch."""
 
 
+def consume_abandoned(stats):
+    """Done-callback for a coalesce future whose waiter detached on
+    deadline expiry: retrieves the eventual batch-level launch error so
+    it is COUNTED (``exec.coalesce.abandonedErrors``) instead of
+    surfacing as per-future "exception was never retrieved" GC log spam
+    — with every waiter detached, nothing else would ever observe it."""
+
+    def _cb(fut):
+        try:
+            exc = fut.exception()
+        except Exception:  # noqa: BLE001 — cancelled futures
+            return
+        if exc is not None and stats is not None:
+            stats.count("exec.coalesce.abandonedErrors")
+
+    return _cb
+
+
 @dataclass
 class _Item:
     batch: object
@@ -169,9 +187,17 @@ class CoalesceScheduler:
         stats=None,
         fuse: bool = True,
         fuse_max_programs: int = DEFAULT_FUSE_MAX_PROGRAMS,
+        health=None,
     ):
         self.max_batch = max(1, int(max_batch))
         self.max_wait_us = max(0, int(max_wait_us))
+        # Device-health manager (device/health.py), wired by the Server
+        # alongside the executor's: collective-bearing launches run
+        # under its hung-collective watchdog and the collective path's
+        # quarantine breaker; errors cross the waiter futures, where
+        # each waiter's guard fails over to the host evaluator
+        # independently.  None = plain serialized collectives.
+        self.health = health
         # Multi-query fusion ([exec] fuse): a drain additionally pulls
         # every other queue whose entries share this key's PROGRAM key
         # (reduce kind, word geometry, device), lowers the distinct
@@ -379,6 +405,26 @@ class CoalesceScheduler:
                     if not it.future.done():
                         it.future.set_exception(exc)
 
+    def _run_collective(self, fn):
+        """One collective-bearing dispatch+fetch: watchdogged through
+        the health manager when wired (errors and trips cross the
+        waiter futures), plain serialized otherwise.  The chaos
+        checkpoint (``device.launch`` path=``collective``) sits inside
+        the watched body so an injected kind=hang wedges exactly where
+        a real all-reduce rendezvous would."""
+        from pilosa_tpu.testing import faults
+
+        def body():
+            faults.check("device.launch", path="collective")
+            return fn()
+
+        if self.health is not None:
+            return self.health.run_collective(body)
+        from pilosa_tpu.exec import plan
+
+        with plan.collective_launch():
+            return body()
+
     def _fuse_key(self, key) -> tuple | None:
         """The program-key tier's grouping token: queues whose entries
         share it may lower into ONE interpreter launch.  None = not
@@ -470,10 +516,18 @@ class CoalesceScheduler:
                 # The program psums over the mesh: serialize with every
                 # other collective launch in the process (see
                 # plan.collective_launch — racing dispatches can
-                # deadlock the all-reduce rendezvous).
-                with plan.collective_launch():
-                    out = plan.compiled_total_count(expr, mesh)(batch)
-                    res = np.asarray(jax.device_get(out))
+                # deadlock the all-reduce rendezvous).  With a health
+                # manager wired, the serialized dispatch+fetch also
+                # rides the launch watchdog: a hung rendezvous trips,
+                # fails the waiters (who fall over to the host path
+                # per-waiter), and quarantines the collective path.
+                res = self._run_collective(
+                    lambda: np.asarray(
+                        jax.device_get(
+                            plan.compiled_total_count(expr, mesh)(batch)
+                        )
+                    )
+                )
             else:
                 out = plan.compiled_total_count(expr, mesh)(batch)
                 res = np.asarray(jax.device_get(out))
@@ -765,10 +819,18 @@ class CoalesceScheduler:
             with device_mod.pool().pinned(*pins):
                 if reduce == "total" and sharded:
                     # The slice-axis limb sums psum over the mesh —
-                    # serialize with other collective launches.
-                    with plan.collective_launch():
-                        out = plan.interp_exec(reduce, combined, prog, out_idx)
-                        res = np.asarray(jax.device_get(out))
+                    # serialize with other collective launches (and,
+                    # with a health manager, run under the launch
+                    # watchdog; see _launch_total).
+                    res = self._run_collective(
+                        lambda: np.asarray(
+                            jax.device_get(
+                                plan.interp_exec(
+                                    reduce, combined, prog, out_idx
+                                )
+                            )
+                        )
+                    )
                 else:
                     out = plan.interp_exec(reduce, combined, prog, out_idx)
                     res = np.asarray(jax.device_get(out))

@@ -42,6 +42,7 @@ import numpy as np
 from pilosa_tpu import bsi
 from pilosa_tpu import device as device_mod
 from pilosa_tpu.bsi import ripple
+from pilosa_tpu.device import health as health_mod
 from pilosa_tpu.cluster import topology as topo
 from pilosa_tpu.cluster.topology import Cluster, Node
 from pilosa_tpu.parallel import mesh as pmesh
@@ -53,6 +54,7 @@ from pilosa_tpu.core import fragment as fragment_mod
 from pilosa_tpu.core.fragment import TopOptions
 from pilosa_tpu.core.view import VIEW_INVERSE, VIEW_STANDARD
 from pilosa_tpu.exec import coalesce as coalesce_mod
+from pilosa_tpu.exec import hosteval as hosteval_mod
 from pilosa_tpu.exec import plan
 from pilosa_tpu.exec import warmup
 from pilosa_tpu.net import resilience
@@ -300,6 +302,7 @@ class Executor:
         prefetcher=None,
         coalescer=None,
         replication=None,
+        device_health=None,
     ):
         self.holder = holder
         self.host = host
@@ -325,6 +328,20 @@ class Executor:
         # bench), not by this executor — several executors may share
         # one.  None = every query dispatches its own launch.
         self.coalescer = coalescer
+        # Device-health subsystem (device/health.py): classifies launch
+        # failures, drives the per-device/collective quarantine state
+        # machine, and owns the hung-collective watchdog.  The Server
+        # wires a configured instance (shared with its coalescer and
+        # gossiped to peers); bare library executors build a default so
+        # device-fault tolerance is never off.
+        self._owns_health = device_health is None
+        self.device_health = device_health or health_mod.DeviceHealth(
+            stats=getattr(holder, "stats", None)
+        )
+        # Host (numpy) evaluator over the authoritative host planes —
+        # the degraded-mode data plane a quarantined device falls back
+        # to, byte-identical by construction (exec/hosteval.py).
+        self.hosteval = hosteval_mod.HostEvaluator(self)
         # (expr, reduce, batch shape) programs this executor has already
         # dispatched — distinguishes compile-bearing first calls from
         # pure execution in the device span annotations.
@@ -355,6 +372,8 @@ class Executor:
     def close(self) -> None:
         fragment_mod.unregister_close_listener(self._drop_closed_fragment)
         self._pool.shutdown(wait=False, cancel_futures=True)
+        if self._owns_health:
+            self.device_health.close()
         # Deregister every cache entry from the residency pool so a
         # closed executor's device arrays stop counting as resident.
         pool = device_mod.pool()
@@ -1278,6 +1297,72 @@ class Executor:
         views = list(tq.views_by_time_range(view_name, start, end, quantum))
         return frame, str(quantum), views
 
+    def _fault_check_launch(self, site: str) -> None:
+        """Chaos hook at a device-launch site (testing/faults.py),
+        fired once per participating device so a rule can target ONE
+        flaky device of the mesh.  The exception is annotated with the
+        matched device ordinal, letting the health layer narrow the
+        blame to that device's path."""
+        host = self.host or None
+        for i in range(len(self.device_health.device_paths())):
+            try:
+                faults.check(
+                    "device.launch", host=host, path=site, device=i
+                )
+            except Exception as e:
+                if getattr(e, "fault_device", None) is None:
+                    try:
+                        e.fault_device = i
+                    except Exception:  # noqa: BLE001 — slots-only excs
+                        pass
+                raise
+
+    def _launch_guarded(self, paths, mode, device_fn, retry_fn, host_fn):
+        """Run one device launch under the health gate: classify a
+        failure (device/health.classify — non-device exceptions
+        re-raise unchanged), retry ONCE via ``retry_fn`` for transient
+        runtime errors, drive the quarantine state machine, and fall
+        back to ``host_fn`` (the byte-identical host evaluator) when
+        the launch finally fails.  ``mode`` is the pre-acquired
+        admission mode (possibly a half-open probe)."""
+        health = self.device_health
+        probe = mode == health_mod.MODE_PROBE
+        try:
+            res = device_fn()
+        except Exception as e:
+            kind = health_mod.classify(e)
+            if kind is None:
+                raise
+            dev = getattr(e, "fault_device", None)
+            if (
+                kind == health_mod.KIND_ERROR
+                and not probe
+                and retry_fn is not None
+            ):
+                # Transient runtime errors get ONE immediate retry
+                # before counting against the breaker — a single
+                # glitch must not start the quarantine clock.
+                self.holder.stats.count("device.launch.retries")
+                try:
+                    res = retry_fn()
+                except Exception as e2:
+                    kind2 = health_mod.classify(e2)
+                    if kind2 is None:
+                        raise
+                    health.failure(
+                        paths,
+                        kind2,
+                        probe=probe,
+                        device=getattr(e2, "fault_device", dev),
+                    )
+                    return host_fn()
+                health.success(paths, probe=probe)
+                return res
+            health.failure(paths, kind, probe=probe, device=dev)
+            return host_fn()
+        health.success(paths, probe=probe)
+        return res
+
     def _device_span(self, ent: dict, reduce: str):
         """Span for one fused device program dispatch+fetch, annotated
         with compile-vs-execute visibility: ``warm`` is whether this
@@ -1285,10 +1370,6 @@ class Executor:
         shape) program — a cold call bears XLA compilation unless the
         persistent compile cache (exec/warmup.py) serves it, which
         ``persistent_cache`` records."""
-        # Chaos hook: the device-launch boundary (testing/faults.py) —
-        # an injected fault here surfaces exactly like an XLA runtime
-        # error, exercising the map-error -> failover path.
-        faults.check("device.launch")
         shape = None if ent["batch"] is None else tuple(ent["batch"].shape)
         key = (ent["expr"], reduce, shape)
         warm = key in self._seen_programs
@@ -1311,7 +1392,11 @@ class Executor:
         padding) — the trace-level evidence that N queries rode one
         dispatch.  Compile-warmth bookkeeping matches _device_span so a
         coalesced first launch is as visible as a direct one."""
-        faults.check("device.launch")
+        # Chaos hook: an injected fault here surfaces exactly like a
+        # coalesced launch error — the waiter's health guard classifies
+        # it and fails over PER WAITER, never poisoning the shared
+        # batch.
+        self._fault_check_launch("coalesce")
         shape = tuple(ent["batch"].shape)
         pkey = (ent["expr"], reduce, shape)
         warm = pkey in self._seen_programs
@@ -1341,6 +1426,14 @@ class Executor:
                 res, info = fut.result(timeout=timeout)
             except FuturesTimeoutError:
                 sp.annotate(deadline="expired")
+                # The detached waiter will never call result() again,
+                # so a batch-level launch error landing later would sit
+                # unobserved (GC logs "exception was never retrieved"
+                # per abandoned waiter).  Hand the future a consumer
+                # that retrieves and COUNTS it instead.
+                fut.add_done_callback(
+                    coalesce_mod.consume_abandoned(self.holder.stats)
+                )
                 if dl is not None and dl.expired:
                     raise resilience.DeadlineExceeded(
                         "deadline expired waiting for coalesced launch"
@@ -1377,39 +1470,45 @@ class Executor:
         device program: leaves for all slices stack into a
         uint32[n_slices, n_leaves, 32768] array and the jitted tree fn is
         vmapped over the slice axis — the TPU-shaped replacement for the
-        reference's goroutine-per-slice mapperLocal."""
+        reference's goroutine-per-slice mapperLocal.
+
+        The launch rides the device-health gate: a quarantined device
+        answers from the authoritative host planes (byte-identical, no
+        device batch assembled at all), and a launch failure classifies,
+        retries once for transient errors, then quarantine-drives the
+        state machine and falls over to the host evaluator."""
         out: dict[int, object] = {}
         if not slices:
             return out
+        paths = self.device_health.device_paths()
+        mode = self.device_health.acquire(paths)
+        if mode == health_mod.MODE_DENY:
+            if reduce == "count":
+                return self.hosteval.counts(index, c, slices)
+            return self.hosteval.rows(index, c, slices)
         ent = self._cached_batch(index, c, slices)
 
         for s in ent["empties"]:
             out[s] = 0 if reduce == "count" else None
         if ent["batch"] is None:
+            if mode == health_mod.MODE_PROBE:
+                self.device_health.cancel_probe(paths)
             return out
 
-        # Coalesced path: concurrent queries sharing this compile key
-        # ride one launch; the scheduler pins every batch in the launch
-        # and scatters this entry's rows back.
-        if self.coalescer is not None:
-            res = self._coalesce_eval(ent, reduce)
-            if res is not None:
-                out.update({s: res[p] for s, p in ent["pos_of"].items()})
-                return out
-
-        # Pin lease for the duration of the fused program: the pool may
-        # not evict the batch out from under the dispatch+fetch.
-        with device_mod.pool().pinned(ent.get("pool_key")), self._device_span(
-            ent, reduce
-        ):
-            if ent["mesh"] is not None:
-                # plain-XLA formulation: partitions cleanly under SPMD
-                res = jax.device_get(
-                    plan.compiled_batched(ent["expr"], reduce)(
-                        ent["batch"]
+        def direct():
+            # Pin lease for the duration of the fused program: the pool
+            # may not evict the batch out from under the dispatch+fetch.
+            with device_mod.pool().pinned(
+                ent.get("pool_key")
+            ), self._device_span(ent, reduce):
+                self._fault_check_launch("direct")
+                if ent["mesh"] is not None:
+                    # plain-XLA formulation: partitions cleanly under SPMD
+                    return jax.device_get(
+                        plan.compiled_batched(ent["expr"], reduce)(
+                            ent["batch"]
+                        )
                     )
-                )
-            else:
                 res = plan.compiled_batched(ent["expr"], reduce)(ent["batch"])
                 if reduce == "row":
                     # Every consumer of row results materializes them on
@@ -1417,7 +1516,34 @@ class Executor:
                     # WHOLE batch in ONE transfer — per-slice lazy slices
                     # would each pay a device round trip when coerced.
                     res = np.asarray(res)
-        out.update({s: res[p] for s, p in ent["pos_of"].items()})
+                return res
+
+        def device_fn():
+            # Coalesced path: concurrent queries sharing this compile key
+            # ride one launch; the scheduler pins every batch in the
+            # launch and scatters this entry's rows back.
+            if self.coalescer is not None:
+                res = self._coalesce_eval(ent, reduce)
+                if res is not None:
+                    return res
+            return direct()
+
+        kept = list(ent["pos_of"])
+        res = self._launch_guarded(
+            paths,
+            mode,
+            device_fn,
+            retry_fn=direct,
+            host_fn=lambda: (
+                self.hosteval.counts(index, c, kept)
+                if reduce == "count"
+                else self.hosteval.rows(index, c, kept)
+            ),
+        )
+        if isinstance(res, dict):
+            out.update(res)
+        else:
+            out.update({s: res[p] for s, p in ent["pos_of"].items()})
         return out
 
     def _eval_tree_slices_host(
@@ -1447,10 +1573,20 @@ class Executor:
         limb partial budget or on single-device hosts."""
         if not slices:
             return 0
+        paths = self.device_health.device_paths()
+        mode = self.device_health.acquire(paths)
+        if mode == health_mod.MODE_DENY:
+            # Quarantined accelerator: host popcount over the
+            # authoritative planes, no device batch assembled.
+            return self.hosteval.count_total(index, c, slices)
         ent = self._cached_batch(index, c, slices)
         if ent["batch"] is None:
+            if mode == health_mod.MODE_PROBE:
+                self.device_health.cancel_probe(paths)
             return 0
         kept_slices = ent["kept"]
+        health = self.device_health
+        fits_limbs = len(kept_slices) <= plan.MAX_ONDEVICE_COUNT_PARTIALS
 
         # Coalesced path.  A MESH-SHARDED entry within the limb budget
         # rides the "total" reduce: the cross-slice sum happens ON
@@ -1468,51 +1604,101 @@ class Executor:
         # uncommitted) and warm (device-gathered, committed) builders —
         # distinct jit cache entries for one geometry, which would
         # break the totalCount family's hard cardinality bound.
-        if self.coalescer is not None:
+        # A quarantined or watchdog-tripped COLLECTIVE path falls back
+        # to the per-slice partials launch — single-device semantics on
+        # the same sharded batch, no psum rendezvous to hang on.
+        def coalesced():
             if (
                 ent["mesh"] is not None
-                and len(kept_slices) <= plan.MAX_ONDEVICE_COUNT_PARTIALS
+                and fits_limbs
+                and health.collective_allowed()
             ):
-                res = self._coalesce_eval(ent, "total")
-                if res is not None:
-                    return plan.recombine_count_limbs(res)
-            else:
-                res = self._coalesce_eval(ent, "count")
-                if res is not None:
-                    return sum(int(res[p]) for p in ent["pos_of"].values())
+                try:
+                    res = self._coalesce_eval(ent, "total")
+                except (
+                    health_mod.LaunchWatchdogTimeout,
+                    health_mod.CollectiveUnavailable,
+                ):
+                    res = None  # collective quarantined: partials below
+                else:
+                    if res is not None:
+                        return plan.recombine_count_limbs(res)
+            res = self._coalesce_eval(ent, "count")
+            if res is not None:
+                return sum(int(res[p]) for p in ent["pos_of"].values())
+            return None
 
-        with device_mod.pool().pinned(ent.get("pool_key")), self._device_span(
-            ent, "count"
-        ):
-            if ent["mesh"] is not None:
-                # Zero pad slices contribute nothing, so the budget is on
-                # the real slice count, not the padded batch size.
-                if len(kept_slices) <= plan.MAX_ONDEVICE_COUNT_PARTIALS:
-                    # The program psums over the mesh: one collective
-                    # launch in flight per process (plan.collective_launch).
-                    with plan.collective_launch():
-                        limbs = plan.compiled_total_count(
-                            ent["expr"], ent["mesh"]
-                        )(ent["batch"])
-                        return plan.recombine_count_limbs(
-                            jax.device_get(limbs)
+        def direct():
+            with device_mod.pool().pinned(
+                ent.get("pool_key")
+            ), self._device_span(ent, "count"):
+                self._fault_check_launch("direct")
+                if ent["mesh"] is not None:
+                    # Zero pad slices contribute nothing, so the budget
+                    # is on the real slice count, not the padded batch
+                    # size.
+                    if fits_limbs and health.collective_allowed():
+                        # The program psums over the mesh: one
+                        # collective launch in flight per process,
+                        # serialized AND watchdogged
+                        # (health.run_collective wraps
+                        # plan.collective_launch) — a hung all-reduce
+                        # trips instead of wedging the process.  The
+                        # chaos checkpoint sits INSIDE the watched body
+                        # so an injected kind=hang wedges where a real
+                        # rendezvous would.
+                        def _collective_body():
+                            self._fault_check_launch("collective")
+                            return jax.device_get(
+                                plan.compiled_total_count(
+                                    ent["expr"], ent["mesh"]
+                                )(ent["batch"])
+                            )
+
+                        try:
+                            limbs = health.run_collective(_collective_body)
+                            return plan.recombine_count_limbs(limbs)
+                        except (
+                            health_mod.LaunchWatchdogTimeout,
+                            health_mod.CollectiveUnavailable,
+                        ):
+                            pass  # mesh path quarantined: partials
+                    res = jax.device_get(
+                        plan.compiled_batched(ent["expr"], "count")(
+                            ent["batch"]
                         )
-                res = jax.device_get(
-                    plan.compiled_batched(ent["expr"], "count")(
+                    )
+                    return int(
+                        sum(int(res[p]) for p in ent["pos_of"].values())
+                    )
+
+                # Single device: same limb total-count program, no
+                # collective — 8 bytes home instead of a per-slice
+                # partial vector (zero pad slices contribute nothing).
+                if fits_limbs:
+                    limbs = plan.compiled_total_count(ent["expr"])(
                         ent["batch"]
                     )
-                )
-                return int(sum(int(res[p]) for p in ent["pos_of"].values()))
+                    return plan.recombine_count_limbs(jax.device_get(limbs))
+                res = plan.compiled_batched(ent["expr"], "count")(ent["batch"])
+                res = jax.device_get(res)
+                return sum(int(res[p]) for p in ent["pos_of"].values())
 
-            # Single device: same limb total-count program, no collective
-            # — 8 bytes home instead of a per-slice partial vector (zero
-            # pad slices contribute nothing).
-            if len(kept_slices) <= plan.MAX_ONDEVICE_COUNT_PARTIALS:
-                limbs = plan.compiled_total_count(ent["expr"])(ent["batch"])
-                return plan.recombine_count_limbs(jax.device_get(limbs))
-            res = plan.compiled_batched(ent["expr"], "count")(ent["batch"])
-            res = jax.device_get(res)
-            return sum(int(res[p]) for p in ent["pos_of"].values())
+        def device_fn():
+            if self.coalescer is not None:
+                total = coalesced()
+                if total is not None:
+                    return total
+            return direct()
+
+        kept = list(ent["pos_of"])
+        return self._launch_guarded(
+            paths,
+            mode,
+            device_fn,
+            retry_fn=direct,
+            host_fn=lambda: self.hosteval.count_total(index, c, kept),
+        )
 
     def _assemble_mesh_batch(self, stacks, kept_slices, mesh):
         """Group slices by home device (slice mod n_devices, matching
@@ -1683,40 +1869,73 @@ class Executor:
 
     def _bsi_agg_slices(self, index: str, c: Call, slices: list[int]):
         """One node's aggregate partial over its local slices:
-        ValCount, or None when no slice holds a valued column."""
+        ValCount, or None when no slice holds a valued column.  Rides
+        the device-health gate like the Count path: a quarantined (or
+        finally-failed) launch decodes host-computed partial vectors —
+        the same ripple arithmetic through the numpy backend."""
         if not slices:
             return None
         rc = self._rewrite_bsi_agg(index, c)
         bucket = int(rc.args["nplanes"])
+        paths = self.device_health.device_paths()
+        mode = self.device_health.acquire(paths)
+        if mode == health_mod.MODE_DENY:
+            parts = self.hosteval.agg_partials(index, rc, slices)
+            return self._decode_agg_parts(c, bucket, parts.values())
         ent = self._cached_batch(index, rc, slices)
         if ent["batch"] is None:
+            if mode == health_mod.MODE_PROBE:
+                self.device_health.cancel_probe(paths)
             return None
 
-        res = None
-        if self.coalescer is not None:
-            res = self._coalesce_eval(ent, "agg")
-        if res is None:
+        def direct():
             with device_mod.pool().pinned(
                 ent.get("pool_key")
             ), self._device_span(ent, "agg"):
-                res = np.asarray(
+                self._fault_check_launch("direct")
+                return np.asarray(
                     jax.device_get(
                         plan.compiled_batched(ent["expr"], "agg")(ent["batch"])
                     )
                 )
-        res = np.asarray(res)
 
+        def device_fn():
+            if self.coalescer is not None:
+                res = self._coalesce_eval(ent, "agg")
+                if res is not None:
+                    return np.asarray(res)
+            return direct()
+
+        kept = list(ent["pos_of"])
+        res = self._launch_guarded(
+            paths,
+            mode,
+            device_fn,
+            retry_fn=direct,
+            host_fn=lambda: self.hosteval.agg_partials(index, rc, kept),
+        )
+        if isinstance(res, dict):
+            vecs = list(res.values())
+        else:
+            res = np.asarray(res)
+            vecs = [res[p] for p in ent["pos_of"].values()]
+        return self._decode_agg_parts(c, bucket, vecs)
+
+    @staticmethod
+    def _decode_agg_parts(c: Call, bucket: int, vecs):
+        """Reduce per-slice aggregate partial vectors (device OR host
+        produced — identical layout) into one ValCount."""
         if c.name == "Sum":
             total = 0
             count = 0
-            for p in ent["pos_of"].values():
-                part, n = ripple.decode_sum(res[p], bucket)
+            for vec in vecs:
+                part, n = ripple.decode_sum(vec, bucket)
                 total += part
                 count += n
             return bsi.ValCount(total, count) if count else None
         best = None
-        for p in ent["pos_of"].values():
-            decoded = ripple.decode_minmax(res[p], bucket)
+        for vec in vecs:
+            decoded = ripple.decode_minmax(vec, bucket)
             if decoded is None:
                 continue
             val, n = decoded
@@ -1791,10 +2010,11 @@ class Executor:
         operations and host<->device transfers as possible and fill
         each ``TopState.counts``.
 
-        ``parts``: list of (TopState, SubRef, src_words, src_spec) —
-        the first three from the ``*_parts`` fragment APIs, ``src_spec``
-        from ``_attach_dev_src`` (None when the src tree is not a plain
-        Bitmap leaf).  Entries with a SubRef group by program shape
+        ``parts``: list of (TopState, SubRef, src_words, src_spec,
+        fragment) — the first three from the ``*_parts`` fragment APIs,
+        ``src_spec`` from ``_attach_dev_src`` (None when the src tree
+        is not a plain Bitmap leaf), ``fragment`` for the host scoring
+        fallback.  Entries with a SubRef group by program shape
         (sub shape, plane rows, home device); each group runs ONE fused
         program (bp.score_planes) that reads candidate AND src rows
         straight from the fragments' resident HBM mirrors — no stacked
@@ -1803,53 +2023,77 @@ class Executor:
         fetch PER SLICE: 444 ms/query at 100 slices through the
         tunnel.
 
+        Rides the device-health gate: a quarantined device (or a
+        finally-failed scorer launch) fills the count vectors from the
+        fragments' authoritative host rows instead
+        (hosteval.score_topn_parts) — identical arithmetic, identical
+        vectors.
+
         The ``topn.dispatch`` / ``topn.fetch`` spans split the device
         cost: dispatch covers gather prep + the async program launches,
         fetch the blocking device->host transfer — with ``topn.select``
         in the callers, the per-stage TopN(src) breakdown ROADMAP 5
         needs before attacking the 5-7 ms residual."""
-        groups: dict[tuple, list] = {}
-        for entry in parts:
-            ref = entry[1]
-            if ref is None:
-                continue
-            groups.setdefault(
-                (ref.shape, ref.plane_rows, ref.device), []
-            ).append(entry)
-        dev_outs = []  # (device array, [states]) fetched in one pass
-        with self.tracer.span("topn.dispatch", groups=len(groups)):
-            for _gkey, members in groups.items():
-                # Pad the group to a power-of-two bucket by repeating
-                # the last member (the row dimension is already
-                # pad_rows-bucketed): an unpadded group size would
-                # compile a fresh XLA program per distinct slice count.
-                # Surplus rows are simply not consumed when the fetched
-                # scores distribute.
-                n_pad = 1 << (len(members) - 1).bit_length()
-                padded = members + [members[-1]] * (n_pad - len(members))
-                planes = tuple(m[1].plane for m in padded)
-                slots = np.stack([m[1].slots for m in padded])
-                # Same-plane src slot for every member -> zero src bytes
-                # cross the host boundary (and no extra leaf shapes in
-                # the jit key); otherwise one stacked host-snapshot
-                # transfer for the group.
-                if all(m[3] is not None for m in padded):
-                    src_slots = np.asarray(
-                        [m[3] for m in padded], dtype=np.int32
-                    )
-                    out = bp.score_planes(planes, slots, src_slots=src_slots)
-                else:
-                    srcs = np.stack([m[2] for m in padded])
-                    out = bp.score_planes(planes, slots, srcs=srcs)
-                dev_outs.append((out, [m[0] for m in members]))
-        if not dev_outs:
+        live = [e for e in parts if e[1] is not None]
+        if not live:
             return
-        with self.tracer.span("topn.fetch", arrays=len(dev_outs)) as sp:
-            fetched = self._shared_fetch([o for o, _ in dev_outs], sp)
-        for arr, (_, sts) in zip(fetched, dev_outs):
-            arr = np.asarray(arr)
-            for i, st in enumerate(sts):
-                st.counts = arr[i]
+        paths = self.device_health.device_paths()
+        mode = self.device_health.acquire(paths)
+        if mode == health_mod.MODE_DENY:
+            self.hosteval.score_topn_parts(live)
+            return
+
+        def device_fn():
+            groups: dict[tuple, list] = {}
+            for entry in live:
+                ref = entry[1]
+                groups.setdefault(
+                    (ref.shape, ref.plane_rows, ref.device), []
+                ).append(entry)
+            dev_outs = []  # (device array, [states]) fetched in one pass
+            with self.tracer.span("topn.dispatch", groups=len(groups)):
+                self._fault_check_launch("topn")
+                for _gkey, members in groups.items():
+                    # Pad the group to a power-of-two bucket by repeating
+                    # the last member (the row dimension is already
+                    # pad_rows-bucketed): an unpadded group size would
+                    # compile a fresh XLA program per distinct slice count.
+                    # Surplus rows are simply not consumed when the fetched
+                    # scores distribute.
+                    n_pad = 1 << (len(members) - 1).bit_length()
+                    padded = members + [members[-1]] * (n_pad - len(members))
+                    planes = tuple(m[1].plane for m in padded)
+                    slots = np.stack([m[1].slots for m in padded])
+                    # Same-plane src slot for every member -> zero src bytes
+                    # cross the host boundary (and no extra leaf shapes in
+                    # the jit key); otherwise one stacked host-snapshot
+                    # transfer for the group.
+                    if all(m[3] is not None for m in padded):
+                        src_slots = np.asarray(
+                            [m[3] for m in padded], dtype=np.int32
+                        )
+                        out = bp.score_planes(
+                            planes, slots, src_slots=src_slots
+                        )
+                    else:
+                        srcs = np.stack([m[2] for m in padded])
+                        out = bp.score_planes(planes, slots, srcs=srcs)
+                    dev_outs.append((out, [m[0] for m in members]))
+            with self.tracer.span("topn.fetch", arrays=len(dev_outs)) as sp:
+                fetched = self._shared_fetch([o for o, _ in dev_outs], sp)
+            for arr, (_, sts) in zip(fetched, dev_outs):
+                arr = np.asarray(arr)
+                for i, st in enumerate(sts):
+                    st.counts = arr[i]
+            return True
+
+        self._launch_guarded(
+            paths,
+            mode,
+            device_fn,
+            retry_fn=device_fn,
+            host_fn=lambda: self.hosteval.score_topn_parts(live),
+        )
 
     def _shared_fetch(self, arrays, sp):
         """Fetch device arrays to the host, batching the BLOCKING
@@ -1874,6 +2118,12 @@ class Executor:
                     res, info = fut.result(timeout=timeout)
                 except FuturesTimeoutError:
                     sp.annotate(deadline="expired")
+                    # Same abandoned-waiter contract as _coalesce_eval:
+                    # the eventual fetch error must be consumed, not
+                    # left for GC log spam.
+                    fut.add_done_callback(
+                        coalesce_mod.consume_abandoned(self.holder.stats)
+                    )
                     if dl is not None and dl.expired:
                         raise resilience.DeadlineExceeded(
                             "deadline expired waiting for shared fetch"
@@ -2207,7 +2457,7 @@ class Executor:
         ]:
             st = replace(st_proto, counts=None, dev_counts=None)
             states.append((frag, topt, cand_ids, cand_mask, st))
-            score_parts.append((st, sub_ref, srcw, src_slot))
+            score_parts.append((st, sub_ref, srcw, src_slot, frag))
         # Score ONCE per validated entry: concurrent queries of the
         # same TopN shape single-flight (one leader dispatches +
         # fetches; everyone else waits on an Event — never on a lock —
@@ -2341,7 +2591,7 @@ class Executor:
             ):
                 self._score_topn_parts(
                     [
-                        self._attach_dev_src(index, c, frag, part)
+                        (*self._attach_dev_src(index, c, frag, part), frag)
                         for frag, part in states
                     ]
                 )
@@ -2678,16 +2928,22 @@ class Executor:
 
         The cluster's ``routing_version`` keys the cache (per-slice
         cutover flips during a rebalance change placement without an
-        epoch bump), and ``epoch`` — when the caller captured one at
-        query start — is verified here: a ring mutation mid-query
-        raises :class:`~pilosa_tpu.cluster.topology.MixedEpochError`
+        epoch bump) together with its ``health_version`` (a replica
+        whose DEVICE is quarantined — learned via the gossip
+        device-health piggyback — is deprioritized: the first
+        non-degraded owner serves, falling back to the primary when
+        every replica is degraded), and ``epoch`` — when the caller
+        captured one at query start — is verified here: a ring mutation
+        mid-query raises
+        :class:`~pilosa_tpu.cluster.topology.MixedEpochError`
         loudly instead of reducing over a half-old, half-new route."""
         rv = getattr(self.cluster, "routing_version", 0)
+        hv = getattr(self.cluster, "health_version", 0)
         if epoch is not None:
             cur = getattr(self.cluster, "epoch", 0)
             if cur != epoch:
                 raise topo.MixedEpochError(epoch, cur)
-        key = (rv, tuple(n.host for n in nodes), index, tuple(slices))
+        key = (rv, hv, tuple(n.host for n in nodes), index, tuple(slices))
         with self._batch_mu:
             hit = self._slice_group_cache.get(key)
             if hit is not None:
@@ -2696,12 +2952,18 @@ class Executor:
         m: dict[str, tuple[Node, list[int]]] = {}
         node_hosts = {n.host for n in nodes}
         for s in slices:
-            for owner in self.cluster.fragment_nodes(index, s):
-                if owner.host in node_hosts:
-                    m.setdefault(owner.host, (owner, []))[1].append(s)
-                    break
-            else:
+            owners = [
+                o
+                for o in self.cluster.fragment_nodes(index, s)
+                if o.host in node_hosts
+            ]
+            if not owners:
                 raise SliceUnavailableError()
+            owner = next(
+                (o for o in owners if not getattr(o, "degraded", False)),
+                owners[0],
+            )
+            m.setdefault(owner.host, (owner, []))[1].append(s)
         with self._batch_mu:
             self._slice_group_cache[key] = m
             while len(self._slice_group_cache) > 8:

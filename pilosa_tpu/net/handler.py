@@ -1583,6 +1583,12 @@ class Handler:
             # Per-class gate state: concurrency/queue bounds, live
             # occupancy, EWMA service time, admitted/shed totals.
             out["admission"] = self.admission.snapshot()
+        dh = getattr(self.executor, "device_health", None)
+        if dh is not None:
+            # Device-health state machine (device/health.py): per-path
+            # healthy/suspect/quarantined, watchdog trips, and the
+            # node-level degraded flag peers see via gossip.
+            out["device"] = dh.snapshot()
         return Response.json(out)
 
     def handle_get_hbm(self, req: Request) -> Response:
@@ -1619,6 +1625,14 @@ class Handler:
             # must render even without a stats backend.
             try:
                 snap.setdefault("gauges", {}).update(self.admission.gauges())
+            except Exception:  # noqa: BLE001 — stats must not fail the scrape
+                pass
+        dh = getattr(self.executor, "device_health", None)
+        if dh is not None:
+            # Scrape-time device-health gauges (device.health.state per
+            # path, device.health.degraded, device.watchdogTrips).
+            try:
+                snap.setdefault("gauges", {}).update(dh.gauges())
             except Exception:  # noqa: BLE001 — stats must not fail the scrape
                 pass
         body = prom.render(

@@ -59,6 +59,10 @@ class Server:
         device_prefetch: bool = True,
         device_stage: bool = True,
         stage_throttle_ms: float = 0.0,
+        launch_watchdog_ms: float = 60_000.0,
+        quarantine_threshold: int = 3,
+        quarantine_open_ms: float = 10_000.0,
+        quarantine_probe_successes: int = 1,
         coalesce: bool = True,
         coalesce_max_batch: int = 64,
         coalesce_max_wait_us: int = 0,
@@ -133,6 +137,24 @@ class Server:
         self.device_stage = device_stage
         self.stage_throttle_ms = stage_throttle_ms
         self.staging_job = None
+        # Device-fault tolerance ([device] launch-watchdog-ms /
+        # quarantine-*, device/health.py): per-device + collective-path
+        # quarantine state machine with half-open probes, and the
+        # hung-collective launch watchdog.  Shared by the executor and
+        # the coalescer; state changes flip the local node's degraded
+        # flag (and, with gossip, every peer's view), and a HEAL kicks
+        # the staging lane to re-materialize HBM mirrors.
+        from pilosa_tpu.device.health import DeviceHealth
+
+        self.device_health = DeviceHealth(
+            quarantine_threshold=quarantine_threshold,
+            open_ms=quarantine_open_ms,
+            probe_successes=quarantine_probe_successes,
+            watchdog_ms=launch_watchdog_ms,
+            stats=stats,
+            logger=self.logger,
+            on_state_change=self._on_device_health_change,
+        )
         # Cross-query coalescing ([exec] config): concurrent queries
         # sharing a compile key ride one fused launch (exec/coalesce.py).
         self.coalesce = coalesce
@@ -375,6 +397,7 @@ class Server:
                 stats=self.stats,
                 fuse=self.fuse,
                 fuse_max_programs=self.fuse_max_programs,
+                health=self.device_health,
             )
         if self.prewarm:
             # With coalescing on, also compile the coalescer's
@@ -480,6 +503,12 @@ class Server:
                 # ping/ack, so restarting peers stage what the cluster
                 # is being asked about FIRST.
                 ns.hot_provider = self.holder.hot_slices
+            if hasattr(ns, "health_provider") and ns.health_provider is None:
+                # Device-health piggyback: the degraded flag rides
+                # every ping/ack; receivers deprioritize this node as a
+                # replica while its accelerator is quarantined.
+                ns.health_provider = self.device_health.degraded
+                ns.on_peer_health = self.cluster.note_degraded
             if hasattr(ns, "on_membership_change"):
                 ns.on_membership_change = self._on_membership_change
             ns.open()
@@ -498,6 +527,7 @@ class Server:
             ),
             coalescer=self.coalescer,
             replication=self.replication,
+            device_health=self.device_health,
             **kwargs,
         )
         self.handler.executor = self.executor
@@ -574,6 +604,7 @@ class Server:
             # After the executor: in-flight queries fall back to the
             # direct launch path when submit() raises CoalesceClosed.
             self.coalescer.close()
+        self.device_health.close()
         self.holder.close()
         # Release stats transports (the StatsD UDP socket) last: the
         # close path above may still observe.
@@ -671,6 +702,43 @@ class Server:
                     )
         except Exception:  # noqa: BLE001 — device stats are best-effort
             pass
+
+    def _on_device_health_change(self, path: str, state: str) -> None:
+        """Device-health transitions (quarantine/heal) from the health
+        manager: mirror the node's degraded flag into the local routing
+        table (gossip carries it to peers), and on a DEVICE-path heal
+        re-materialize HBM mirrors through the staging lane — the mesh
+        re-resolves to the healthy device set on the next launch
+        (parallel/mesh.default_slices_mesh is derived per call), and
+        staging restores the plane mirrors host-fallback service never
+        touched."""
+        try:
+            self.cluster.note_degraded(self.host, self.device_health.degraded())
+        except Exception as e:  # noqa: BLE001 — advisory path
+            self.logger(f"degraded-flag routing update error: {e}")
+        from pilosa_tpu.device.health import STATE_HEALTHY
+
+        if (
+            state == STATE_HEALTHY
+            and path.startswith("device:")
+            and self.device_stage
+            and self.holder is not None
+        ):
+            from pilosa_tpu import device as device_mod
+
+            try:
+                job = self.holder.stage_device_mirrors(
+                    device_mod.prefetcher(),
+                    throttle_s=self.stage_throttle_ms / 1000.0,
+                    tracer=self.tracer,
+                )
+                if job.total:
+                    self.logger(
+                        f"device health: {path} healed — re-materializing "
+                        f"{job.total} fragment mirrors via the staging lane"
+                    )
+            except Exception as e:  # noqa: BLE001 — staging is best-effort
+                self.logger(f"post-heal staging error: {e}")
 
     def _gossip_hot_slices(self) -> dict[str, list[int]]:
         """Peers' fresh hot-slice announcements (union), when the

@@ -121,8 +121,24 @@ def _count_tree(expr: tuple, leaf_planes: jax.Array) -> jax.Array:
     return jnp.sum(jax.lax.population_count(out).astype(jnp.int32), axis=-1)
 
 
+def _collective(fn, health=None):
+    """Run one collective-bearing dispatch+fetch serialized with every
+    other collective in the process — and, when a device-health manager
+    (device/health.py) is passed, under its hung-collective watchdog
+    and quarantine breaker (``LaunchWatchdogTimeout`` /
+    ``CollectiveUnavailable`` propagate to the caller, who falls back
+    to the non-collective path)."""
+    if health is not None:
+        return health.run_collective(fn)
+    with plan.collective_launch():
+        return fn()
+
+
 def distributed_count(
-    expr: tuple, leaf_planes: jax.Array, n_partials: int | None = None
+    expr: tuple,
+    leaf_planes: jax.Array,
+    n_partials: int | None = None,
+    health=None,
 ) -> int:
     """Count(tree) where each leaf is a full sharded plane.
 
@@ -132,16 +148,40 @@ def distributed_count(
     whenever the partial count fits the int32 budget; beyond that the
     per-partial host sum (int64) takes over.  Callers whose planes carry
     zero padding (shard_planes) may pass the REAL slice-row count as
-    ``n_partials`` — zero pads cannot overflow the budget.
+    ``n_partials`` — zero pads cannot overflow the budget.  A watchdog
+    trip or a quarantined collective path (``health``) degrades to the
+    per-partial host sum instead of wedging.
     """
     if n_partials is None:
         n_partials = leaf_planes.shape[0] * leaf_planes.shape[2]
     sh = leaf_planes.sharding
     if isinstance(sh, NamedSharding) and n_partials <= plan.MAX_ONDEVICE_COUNT_PARTIALS:
-        with plan.collective_launch():
-            limbs = plan.compiled_total_count(expr, sh.mesh)(leaf_planes)
-            return plan.recombine_count_limbs(jax.device_get(limbs))
+        try:
+            limbs = _collective(
+                lambda: jax.device_get(
+                    plan.compiled_total_count(expr, sh.mesh)(leaf_planes)
+                ),
+                health,
+            )
+            return plan.recombine_count_limbs(limbs)
+        except Exception as e:
+            if not _collective_degraded(e, health):
+                raise
     return int(np.asarray(_count_tree(expr, leaf_planes), dtype=np.int64).sum())
+
+
+def _collective_degraded(exc, health) -> bool:
+    """Whether a collective failure should degrade to the
+    non-collective path (watchdog trip / quarantined) rather than
+    propagate."""
+    if health is None:
+        return False
+    from pilosa_tpu.device import health as health_mod
+
+    return isinstance(
+        exc,
+        (health_mod.LaunchWatchdogTimeout, health_mod.CollectiveUnavailable),
+    )
 
 
 @jax.jit
@@ -186,21 +226,30 @@ def _topn_total_fn(mesh: Mesh):
     return jax.jit(fn, out_shardings=rep)
 
 
-def distributed_topn(plane: jax.Array, src: jax.Array, k: int):
+def distributed_topn(plane: jax.Array, src: jax.Array, k: int, health=None):
     """TopN(Src=...) over a sharded fragment-stack: returns (counts,
     row_ids) host arrays, count-descending, ties toward lower id —
     matching the reference Pair sort (reference: cache.go:316-330).
 
     The cross-slice per-row reduce runs on-device (all-reduce) within
     the limb budget; the final rank (a [rows] vector) keeps the
-    host stable-argsort for the exact reference tie-break."""
+    host stable-argsort for the exact reference tie-break.  Like
+    distributed_count, a watchdog trip / quarantined collective
+    (``health``) degrades to the per-partial host sum."""
+    per = None
     sh = plane.sharding
     if isinstance(sh, NamedSharding) and plane.shape[0] <= plan.MAX_ONDEVICE_COUNT_PARTIALS:
-        with plan.collective_launch():
+        try:
             per = plan.recombine_count_limbs(
-                jax.device_get(_topn_total_fn(sh.mesh)(plane, src))
+                _collective(
+                    lambda: jax.device_get(_topn_total_fn(sh.mesh)(plane, src)),
+                    health,
+                )
             )
-    else:
+        except Exception as e:
+            if not _collective_degraded(e, health):
+                raise
+    if per is None:
         per = np.asarray(_topn_partials(plane, src), dtype=np.int64).sum(axis=0)
     k = min(k, per.shape[0])
     ids = np.argsort(-per, kind="stable")[:k]

@@ -716,20 +716,26 @@ class TestChaosEndToEnd:
             with suppress(Exception):
                 s1.close()
 
-    def test_device_fault_drops_into_partial_machinery(self, tmp_path):
-        """An injected device-launch fault behaves like an XLA runtime
-        error: with no replica to fail over to, allowPartial still
-        answers (empty) and the next fault-free query is whole."""
+    def test_device_fault_serves_whole_via_host_fallback(self, tmp_path):
+        """An injected device-launch fault no longer turns the node
+        into a brick the cluster must route around: the device-health
+        layer (device/health.py) classifies the failure, retries once,
+        and answers the SAME query byte-identically from the
+        authoritative host planes — whole, not partial — and the next
+        fault-free query rides the device path again."""
         s0, s1 = _two_servers(
             tmp_path, replicas=1, retry_attempts=1
         )
         try:
             _seed_slices(s0, s1)
             c0 = InternalClient(s0.host, timeout=10.0)
-            faults.install("device.launch:times=2,mode=error")
+            # Persistent while installed: initial launch + the health
+            # layer's single retry both fault on every mapper.
+            faults.install("device.launch:mode=error")
             st, payload = _query_json(c0, "i", COUNT_Q, allow_partial=True)
             assert st == 200
-            assert payload.get("partial") is True
+            assert payload.get("partial") is not True
+            assert payload["results"][0] == 6
             faults.clear()
             st, payload = _query_json(c0, "i", COUNT_Q)
             assert st == 200 and payload["results"][0] == 6

@@ -227,6 +227,40 @@ def main() -> int:
     if tt.get("hydrate_p99_ms", 0) <= 0:
         print(f"FAIL: tiered tier missing hydration latency: {tt}", file=sys.stderr)
         return 1
+    dg = out.get("degraded")
+    if not isinstance(dg, dict):
+        print(f"FAIL: artifact missing degraded tier: {out}", file=sys.stderr)
+        return 1
+    for section in ("healthy", "degraded"):
+        sec = dg.get(section)
+        if not isinstance(sec, dict) or sec.get("gcols_s", 0) <= 0 or (
+            sec.get("p99_ms", 0) <= 0
+        ):
+            print(
+                f"FAIL: degraded tier {section!r} implausible: {dg}",
+                file=sys.stderr,
+            )
+            return 1
+    if not dg.get("byte_identical"):
+        print(
+            f"FAIL: degraded tier host fallback not byte-identical: {dg}",
+            file=sys.stderr,
+        )
+        return 1
+    # The breaker must engage within its configured threshold (+ the
+    # single transient retry), and the watchdog trip must recover in
+    # bounded time — not the injected wedge's full duration.
+    if dg.get("quarantine_queries", 99) > dg.get("quarantine_threshold", 0) + 1:
+        print(f"FAIL: quarantine never engaged at threshold: {dg}", file=sys.stderr)
+        return 1
+    wd = dg.get("watchdog")
+    if (
+        not isinstance(wd, dict)
+        or wd.get("trips", 0) < 1
+        or not (0 < wd.get("trip_recovery_ms", 0) < wd.get("watchdog_ms", 0) * 4)
+    ):
+        print(f"FAIL: degraded tier watchdog implausible: {wd}", file=sys.stderr)
+        return 1
     pc = out.get("program_cache")
     if not isinstance(pc, dict) or "entries" not in pc or "bounds" not in pc:
         print(f"FAIL: artifact missing program_cache: {out}", file=sys.stderr)
@@ -255,7 +289,10 @@ def main() -> int:
         f" = {hl['gcols_per_s']} Gcols/s, grid {sorted(ngrid)};"
         f" cold restart first answer {cold['first_answer_ms']} ms;"
         f" tiered p99 {tt['p99_ms']} ms ({tt['demotions']} demotions,"
-        f" {tt['hydrations']} hydrations, cold-hit {tt['cold_hit_rate']})"
+        f" {tt['hydrations']} hydrations, cold-hit {tt['cold_hit_rate']});"
+        f" degraded {dg['degraded']['gcols_s']} vs healthy"
+        f" {dg['healthy']['gcols_s']} Gcols/s, watchdog recovery"
+        f" {dg['watchdog']['trip_recovery_ms']} ms"
     )
     return 0
 
