@@ -5,7 +5,7 @@
 
 PYTHON ?= python
 
-.PHONY: default test lint analyze typecheck check bench bench-smoke chaos-smoke device-chaos-smoke load-smoke resize-smoke multichip-smoke tier-smoke replication-smoke churn-soak install build docker clean generate
+.PHONY: default test lint analyze typecheck check bench bench-smoke chaos-smoke device-chaos-smoke load-smoke resize-smoke multichip-smoke tier-smoke replication-smoke subscribe-smoke churn-soak install build docker clean generate
 
 default: build test
 
@@ -129,6 +129,17 @@ tier-smoke:
 # BLOCKING in CI (.github/workflows/check.yml), like resize-smoke.
 replication-smoke:
 	$(PYTHON) tools/replication_smoke.py
+
+# Standing-query smoke (tools/subscribe_smoke.py): two real nodes,
+# 100+ standing PQL subscriptions (single-row counts, compound trees,
+# TopN) under a live import stream; grows the cluster to three nodes
+# MID-STREAM, then asserts every subscription converges to the pull
+# oracle, updates are version-monotonic, the topology move re-stamped
+# subscription epochs, and update lag p99 stays bounded.  CI also runs
+# it under PILOSA_LOCK_CHECK=1.  BLOCKING in CI
+# (.github/workflows/check.yml), like resize-smoke.
+subscribe-smoke:
+	$(PYTHON) tools/subscribe_smoke.py
 
 # Gossip churn soak (tools/churn_soak.py): 20-50 virtual members under
 # seeded datagram loss + member flapping; asserts membership converges

@@ -483,6 +483,45 @@ def main() -> None:
         except Exception as e:
             log(f"degraded tier failed: {e}")
 
+    # Standing-query tier (ISSUE 16): N >= 1000 push-based PQL
+    # subscriptions under a live import stream — registration ms/sub,
+    # update-lag p50/p99, delta-eval tier counts, and the query-path
+    # p99 with subscriptions on vs the identical node with them off
+    # (tools/standing_bench.py subprocess, CPU: the subscribe engine is
+    # host-side — listener fan-out, coalescing, incremental eval).
+    standing_tier = None
+    if os.environ.get("BENCH_SKIP_STANDING_TIER") != "1":
+        import subprocess
+
+        sbt = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "tools",
+            "standing_bench.py",
+        )
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+        try:
+            out = subprocess.run(
+                [sys.executable, sbt], env=env, capture_output=True,
+                timeout=900, text=True,
+            )
+            if out.returncode == 0 and out.stdout.strip():
+                for line in out.stderr.strip().splitlines():
+                    if line.startswith("[standing]"):
+                        log(line)
+                standing_tier = json.loads(out.stdout.strip().splitlines()[-1])
+                log(
+                    "standing tier: "
+                    f"{standing_tier['subscriptions']} subscriptions, "
+                    f"update lag p99 {standing_tier['lag_ms']['p99']} ms, "
+                    "query-path p99 ratio "
+                    f"{standing_tier['query_path']['p99_ratio']}x off"
+                )
+            else:
+                log(f"standing tier failed: rc={out.returncode} "
+                    f"stderr={out.stderr.strip()[-300:]!r}")
+        except Exception as e:
+            log(f"standing tier failed: {e}")
+
     # Mesh-scaling tier (ISSUE 12 / ROADMAP 2): the mesh-sharded data
     # plane end to end — devices-vs-Gcols/s curve at 1/2/4/8 devices,
     # the 10B-column Intersect+Count headline over the full mesh (ICI-
@@ -874,6 +913,8 @@ def main() -> None:
         out["replication"] = replication_tier
     if degraded_tier is not None:
         out["degraded"] = degraded_tier
+    if standing_tier is not None:
+        out["standing"] = standing_tier
     out["program_cache"] = {
         "entries": plan.program_cache_stats(),
         "bounds": plan.program_cache_bounds(),

@@ -148,7 +148,10 @@ def _caller_site():
     """(relpath, line) of the package frame creating a lock; None when
     the creator is outside the package (leave those locks alone)."""
     f = sys._getframe(2)
-    filename = f.f_code.co_filename
+    # normpath: imports via a relative sys.path entry (the tools/
+    # scripts do ``sys.path.insert(0, ".")``) yield co_filenames like
+    # ``/root/x/./pilosa_tpu/...`` that a raw prefix test rejects.
+    filename = os.path.normpath(f.f_code.co_filename)
     if _pkg_dir is None or not filename.startswith(_pkg_dir + os.sep):
         return None
     return (_relpath(filename), f.f_lineno)

@@ -137,6 +137,7 @@ def build_server(cfg: config_mod.Config):
         admission_write_concurrency=cfg.net.admission_write_concurrency,
         admission_internal_concurrency=cfg.net.admission_internal_concurrency,
         admission_queue_depth=cfg.net.admission_queue_depth,
+        admission_subscribe_concurrency=cfg.net.admission_subscribe_concurrency,
         rebalance_throttle_mbps=cfg.cluster.rebalance_throttle_mbps,
         rebalance_verify_rounds=cfg.cluster.rebalance_verify_rounds,
         rebalance_delta_cap=cfg.cluster.rebalance_delta_cap,
@@ -152,6 +153,12 @@ def build_server(cfg: config_mod.Config):
         tier_retention_age_s=cfg.tier.retention_age_s,
         tier_retention_delete_s=cfg.tier.retention_delete_s,
         tier_sweep_interval_s=cfg.tier.sweep_interval_s,
+        subscribe_enabled=cfg.subscribe.enabled,
+        subscribe_max_subscriptions=cfg.subscribe.max_subscriptions,
+        subscribe_queue_cap=cfg.subscribe.queue_cap,
+        subscribe_delta_cap=cfg.subscribe.delta_cap,
+        subscribe_coalesce_ms=cfg.subscribe.coalesce_ms,
+        subscribe_refresh_ms=cfg.subscribe.refresh_interval_ms,
     )
 
 

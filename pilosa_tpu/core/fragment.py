@@ -135,11 +135,20 @@ def _notify_close(frag) -> None:
 
 
 # Fragment WRITE listeners: called with (fragment, set_rows, set_cols,
-# clear_rows, clear_cols) — absolute column ids — after every
+# clear_rows, clear_cols, exact) — absolute column ids — after every
 # successful content change (point writes, bulk imports, sync merges).
-# The rebalance delta log rides this hook to capture the write stream
-# of a migrating slice; when nothing is registered the cost is one
-# list-truthiness check per write.
+# ``exact`` is True only when every reported bit provably CHANGED state
+# (the point-write paths, which skip notification on no-ops); bulk
+# imports report the requested lists, which may include already-set
+# bits, so incremental consumers (the subscribe delta engine) must
+# treat exact=False entries as dirtiness, not arithmetic.  The
+# rebalance delta log rides this hook to capture the write stream of a
+# migrating slice; when nothing is registered the cost is one
+# list-truthiness check per write.  Listeners register module-wide
+# (every fragment) or per-fragment (Fragment.add_write_listener);
+# per-fragment listeners are dropped automatically when the fragment
+# leaves service (close/retire) so churning subscribers cannot leak
+# callbacks on rebalanced-away slices.
 _write_listeners: list = []
 _write_listeners_mu = threading.Lock()
 
@@ -155,10 +164,12 @@ def unregister_write_listener(fn) -> None:
         _write_listeners[:] = [f for f in _write_listeners if f is not fn]
 
 
-def _notify_write(frag, set_rows, set_cols, clear_rows, clear_cols) -> None:
-    for fn in list(_write_listeners):
+def _notify_write(
+    frag, set_rows, set_cols, clear_rows, clear_cols, exact=False
+) -> None:
+    for fn in list(_write_listeners) + list(frag._frag_write_listeners):
         try:
-            fn(frag, set_rows, set_cols, clear_rows, clear_cols)
+            fn(frag, set_rows, set_cols, clear_rows, clear_cols, exact)
         except Exception:  # noqa: BLE001 — listeners must not break writes
             pass
 
@@ -338,6 +349,10 @@ class Fragment:
         self._max_row_id = 0
         self._op_n = 0
         self._version = 0
+        # Per-fragment write listeners (add_write_listener): cleared on
+        # close/retire so a fragment leaving service holds zero
+        # registered callbacks (no leak across rebalance or tier churn).
+        self._frag_write_listeners: list = []
         # Incremental per-row popcounts (reference keeps cached counts,
         # bitmap.go:184-217); avoids an O(row) recount on every SetBit.
         self._count_of: dict[int, int] = {}
@@ -486,6 +501,25 @@ class Fragment:
         # replayed-op count feeds snapshot bookkeeping
         self._op_n = op_n
 
+    def add_write_listener(self, fn) -> None:
+        """Register a write listener on THIS fragment only (same call
+        signature as the module-wide hook).  Dropped automatically when
+        the fragment leaves service — close, retire, tier demotion —
+        so callers need no unhook path for slices that churn away."""
+        with self._mu:
+            if fn not in self._frag_write_listeners:
+                self._frag_write_listeners.append(fn)
+
+    def remove_write_listener(self, fn) -> None:
+        with self._mu:
+            self._frag_write_listeners[:] = [
+                f for f in self._frag_write_listeners if f is not fn
+            ]
+
+    def write_listener_count(self) -> int:
+        with self._mu:
+            return len(self._frag_write_listeners)
+
     def close(self) -> None:
         with self._mu:
             if self._file is not None:
@@ -507,6 +541,10 @@ class Fragment:
             # deletes would otherwise serve stale batches until some
             # unrelated write moved the epoch.
             _bump_write_epoch()
+            # A closed fragment must hold zero registered listeners —
+            # per-fragment callbacks die with the fragment's service
+            # life, never with its garbage collection.
+            self._frag_write_listeners.clear()
         # Outside the lock: listeners may take their own locks.
         _notify_close(self)
 
@@ -523,6 +561,9 @@ class Fragment:
     def mark_retired(self) -> None:
         with self._mu:
             self._retired = True
+            # Retirement blocks writes permanently, so per-fragment
+            # write listeners can never fire again — drop them now.
+            self._frag_write_listeners.clear()
 
     def mark_retired_if_version(self, version: int) -> bool:
         """Atomically retire ONLY if no write landed since ``version``
@@ -1345,8 +1386,10 @@ class Fragment:
                     # reference: fragment.go:421-423
                     self.stats.gauge("rows", float(self._max_row_id))
                 self._maybe_promote(row_id)
-                if _write_listeners:
-                    _notify_write(self, (row_id,), (column_id,), (), ())
+                if _write_listeners or self._frag_write_listeners:
+                    _notify_write(
+                        self, (row_id,), (column_id,), (), (), exact=True
+                    )
             return changed
 
     def clear_bit(self, row_id: int, column_id: int) -> bool:
@@ -1367,8 +1410,10 @@ class Fragment:
                 self._append_op(roaring.OP_REMOVE, pos)
                 self._after_write(row_id, -1)
                 self.stats.count("clearBit")  # reference: fragment.go:470
-                if _write_listeners:
-                    _notify_write(self, (), (), (row_id,), (column_id,))
+                if _write_listeners or self._frag_write_listeners:
+                    _notify_write(
+                        self, (), (), (row_id,), (column_id,), exact=True
+                    )
             return changed
 
     def _sparse_insert(self, row_id: int, offset: int) -> bool:
@@ -1576,7 +1621,7 @@ class Fragment:
             self.cache.invalidate()
             self.cache.recalculate()
             self.stats.count("ImportBit", len(row_ids))  # ref: fragment.go:969
-            if _write_listeners:
+            if _write_listeners or self._frag_write_listeners:
                 _notify_write(
                     self, row_ids, column_ids, clear_row_ids, clear_column_ids
                 )

@@ -51,8 +51,18 @@ CLASS_POINT = "point"
 CLASS_HEAVY = "heavy"
 CLASS_WRITE = "write"
 CLASS_INTERNAL = "internal"
+# Standing-query notification batches (pilosa_tpu/subscribe): a
+# dedicated bounded lane so push evaluation can never occupy a query
+# slot — subscribers starve before queries do, by construction.
+CLASS_SUBSCRIBE = "subscribe"
 
-CLASSES = (CLASS_POINT, CLASS_HEAVY, CLASS_WRITE, CLASS_INTERNAL)
+CLASSES = (
+    CLASS_POINT,
+    CLASS_HEAVY,
+    CLASS_WRITE,
+    CLASS_INTERNAL,
+    CLASS_SUBSCRIBE,
+)
 
 # EWMA smoothing for observed service times: new = a*obs + (1-a)*old.
 _EWMA_ALPHA = 0.2
@@ -235,6 +245,7 @@ class AdmissionController:
         heavy_concurrency: int = 8,
         write_concurrency: int = 16,
         internal_concurrency: int = 128,
+        subscribe_concurrency: int = 4,
         queue_depth: int = 64,
         stats=None,
     ):
@@ -258,6 +269,13 @@ class AdmissionController:
                 internal_concurrency,
                 max(1, int(internal_concurrency)),
                 stats,
+            ),
+            # The subscribe lane gates standing-query work — the
+            # registration snapshot and the notifier's batch
+            # evaluation.  Narrow by design: push freshness degrades
+            # under load, pull latency doesn't.
+            CLASS_SUBSCRIBE: _ClassGate(
+                CLASS_SUBSCRIBE, subscribe_concurrency, queue_depth, stats
             ),
         }
 

@@ -56,14 +56,20 @@ from pilosa_tpu.core import attr as attr_mod
 from pilosa_tpu.core import timequantum as tq
 from pilosa_tpu.core.bitmap import RowBitmap
 from pilosa_tpu.exec import plan as plan_mod
-from pilosa_tpu.exec.executor import ExecOptions, TooManyWritesError
+from pilosa_tpu.exec.executor import (
+    ExecOptions,
+    ExecutorError,
+    TooManyWritesError,
+)
 from pilosa_tpu.net import admission as adm
 from pilosa_tpu.net import codec
 from pilosa_tpu.net import resilience as rz
 from pilosa_tpu.net import wire_pb2 as wire
 from pilosa_tpu.obs import prom, trace
-from pilosa_tpu.pql.parser import parse_string
+from pilosa_tpu.pql.parser import ParseError, parse_string
 from pilosa_tpu.replicate import quorum as replicate_mod
+from pilosa_tpu.subscribe import registry as subscribe_reg
+from pilosa_tpu.subscribe import sse as sse_mod
 from pilosa_tpu.testing import faults
 
 PROTOBUF = "application/x-protobuf"
@@ -204,6 +210,11 @@ class Handler:
         # overrides, and the X-Write-Version stamp on remote write
         # legs.  None = static single-copy surface (endpoints 501).
         self.replication = replication
+        # Standing queries (pilosa_tpu/subscribe): POST /subscribe
+        # registration, SSE / long-poll delivery, /debug/subscriptions.
+        # Wired by the Server after the executor exists (like
+        # ``executor`` itself); None = endpoints answer 501.
+        self.subscribe = None
         # Staging-lane prefetcher (device/prefetch.py), wired by the
         # Server: fragments restored with ?stage=true (migration
         # arrivals) register their HBM mirrors through it.
@@ -260,6 +271,11 @@ class Handler:
             ("POST", r"/replicate/versions", self.handle_post_replicate_versions),
             ("POST", r"/replicate/hint", self.handle_post_replicate_hint),
             ("POST", r"/replicate/replay", self.handle_post_replicate_replay),
+            ("POST", r"/subscribe", self.handle_post_subscribe),
+            ("GET", r"/subscribe/(?P<sid>[^/]+)/stream", self.handle_get_subscribe_stream),
+            ("GET", r"/subscribe/(?P<sid>[^/]+)/poll", self.handle_get_subscribe_poll),
+            ("DELETE", r"/subscribe/(?P<sid>[^/]+)", self.handle_delete_subscribe),
+            ("GET", r"/debug/subscriptions", self.handle_get_subscriptions),
             ("GET", r"/debug/replication", self.handle_get_replication),
             ("GET", r"/debug/tier", self.handle_get_tier),
             ("GET", r"/debug/rebalance", self.handle_get_rebalance),
@@ -1527,6 +1543,126 @@ class Handler:
             if ticket is not None:
                 ticket.release()
 
+    # ------------------------------------------------------------------
+    # standing queries (pilosa_tpu/subscribe)
+    # ------------------------------------------------------------------
+
+    def handle_post_subscribe(self, req: Request) -> Response:
+        """Register a standing query.  Body: JSON ``{"index": ...,
+        "query": "Subscribe(Count(...))"}``.  Returns the subscription
+        id plus the registration snapshot (version 1) — clients then
+        stream or long-poll from that version.  The registration
+        evaluation rides the dedicated subscribe admission lane."""
+        if self.subscribe is None:
+            return Response.error("subscribe not configured", 501)
+        ticket, shed = self._admit(adm.CLASS_SUBSCRIBE, req)
+        if shed is not None:
+            return shed
+        try:
+            try:
+                payload = json.loads(req.body or b"{}")
+            except ValueError as e:
+                return Response.error(f"bad request body: {e}", 400)
+            if not isinstance(payload, dict):
+                return Response.error("bad request body: expected object", 400)
+            index = payload.get("index") or req.query.get("index", "")
+            query = payload.get("query", "")
+            if not index or not query:
+                return Response.error("index and query required", 400)
+            try:
+                sub = self.subscribe.register(index, query)
+            except (
+                subscribe_reg.SubscribeError,
+                ParseError,
+                plan_mod.PlanError,
+                ExecutorError,
+            ) as e:
+                # Registration compiles AND snapshot-evaluates the
+                # expression, so executor-level rejections (unknown
+                # field, bad Range bounds) are client errors here.
+                return Response.error(str(e), 400)
+            return Response.json(
+                {
+                    "id": sub.id,
+                    "index": sub.index,
+                    "kind": sub.kind,
+                    "version": sub.version,
+                    "epoch": sub.epoch,
+                    "value": sub.value_json,
+                },
+                status=201,
+            )
+        finally:
+            if ticket is not None:
+                ticket.release()
+
+    def _subscription_for(self, sid: str):
+        if self.subscribe is None:
+            return None, Response.error("subscribe not configured", 501)
+        sub = self.subscribe.get(sid)
+        if sub is None:
+            return None, Response.error(f"no such subscription: {sid}", 404)
+        return sub, None
+
+    def handle_get_subscribe_stream(self, req: Request, sid: str) -> Response:
+        """SSE delivery: every retained update newer than ``?after=``
+        (version-monotonic, at-least-once), then live updates as
+        notification batches publish them; keepalive comments while
+        idle.  The wait itself holds no admission slot — evaluation
+        already paid on the notifier's lane."""
+        sub, err = self._subscription_for(sid)
+        if err is not None:
+            return err
+        try:
+            after = int(req.query.get("after", "0"))
+        except ValueError:
+            return Response.error("invalid after", 400)
+        gen = sse_mod.event_stream(self.subscribe, sub, after)
+        return Response(
+            body_iter=sse_mod.EventBody(gen),
+            content_type=sse_mod.CONTENT_TYPE,
+        )
+
+    def handle_get_subscribe_poll(self, req: Request, sid: str) -> Response:
+        """Long-poll delivery: block until the subscription moves past
+        ``?after=`` or ``?timeout_ms=`` elapses (bounded).  A timeout
+        answers 200 with ``"timeout": true`` so clients distinguish
+        quiet from gone (410 = unsubscribed mid-wait)."""
+        sub, err = self._subscription_for(sid)
+        if err is not None:
+            return err
+        try:
+            after = int(req.query.get("after", "0"))
+            timeout_ms = float(req.query.get("timeout_ms", "30000"))
+        except ValueError:
+            return Response.error("invalid after/timeout_ms", 400)
+        timeout_ms = max(0.0, min(timeout_ms, 120_000.0))
+        upd = self.subscribe.wait_update(sub, after, timeout=timeout_ms / 1000.0)
+        if upd is None:
+            if sub.closed:
+                return Response.error("subscription closed", 410)
+            return Response.json(
+                {"id": sub.id, "version": after, "timeout": True}
+            )
+        return Response.json(upd)
+
+    def handle_delete_subscribe(self, req: Request, sid: str) -> Response:
+        if self.subscribe is None:
+            return Response.error("subscribe not configured", 501)
+        if not self.subscribe.unregister(sid):
+            return Response.error(f"no such subscription: {sid}", 404)
+        return Response.json({"unsubscribed": sid})
+
+    def handle_get_subscriptions(self, req: Request) -> Response:
+        """Standing-query observability: registry size, pending delta
+        backlog, notification lag percentiles, lifetime counters, and
+        the first page of subscriptions."""
+        if self.subscribe is None:
+            return Response.json(
+                {"count": 0, "note": "subscribe not configured"}
+            )
+        return Response.json(self.subscribe.snapshot())
+
     def handle_get_replication(self, req: Request) -> Response:
         """Replication observability: consistency defaults, per-replica
         hint backlog (entries/bits/slices, last replay outcome), local
@@ -1633,6 +1769,13 @@ class Handler:
             # path, device.health.degraded, device.watchdogTrips).
             try:
                 snap.setdefault("gauges", {}).update(dh.gauges())
+            except Exception:  # noqa: BLE001 — stats must not fail the scrape
+                pass
+        if self.subscribe is not None:
+            # Scrape-time standing-query gauges (active subscriptions,
+            # pending delta bits).
+            try:
+                snap.setdefault("gauges", {}).update(self.subscribe.gauges())
             except Exception:  # noqa: BLE001 — stats must not fail the scrape
                 pass
         body = prom.render(

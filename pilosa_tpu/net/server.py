@@ -95,6 +95,13 @@ class Server:
         tier_retention_age_s: float = 0.0,
         tier_retention_delete_s: float = 0.0,
         tier_sweep_interval_s: float = 60.0,
+        subscribe_enabled: bool = True,
+        subscribe_max_subscriptions: int = 10_000,
+        subscribe_queue_cap: int = 256,
+        subscribe_delta_cap: int = 50_000,
+        subscribe_coalesce_ms: float = 5.0,
+        subscribe_refresh_ms: float = 500.0,
+        admission_subscribe_concurrency: int = 4,
     ):
         self.data_dir = data_dir
         self.host = host
@@ -199,6 +206,7 @@ class Server:
                 heavy_concurrency=admission_heavy_concurrency,
                 write_concurrency=admission_write_concurrency,
                 internal_concurrency=admission_internal_concurrency,
+                subscribe_concurrency=admission_subscribe_concurrency,
                 queue_depth=admission_queue_depth,
                 stats=stats,
             )
@@ -255,6 +263,17 @@ class Server:
         self.tier_retention_delete_s = tier_retention_delete_s
         self.tier_sweep_interval_s = tier_sweep_interval_s
         self.tier = None
+        # Standing queries ([subscribe] config, pilosa_tpu/subscribe):
+        # built at open() AFTER the executor exists (the delta engine
+        # pulls through it on overflow/TopN/topology change); None when
+        # disabled.
+        self.subscribe_enabled = subscribe_enabled
+        self.subscribe_max_subscriptions = subscribe_max_subscriptions
+        self.subscribe_queue_cap = subscribe_queue_cap
+        self.subscribe_delta_cap = subscribe_delta_cap
+        self.subscribe_coalesce_ms = subscribe_coalesce_ms
+        self.subscribe_refresh_ms = subscribe_refresh_ms
+        self.subscribe = None
         self.executor: Executor | None = None
         self.handler: Handler | None = None
         self._http = None
@@ -532,6 +551,30 @@ class Server:
         )
         self.handler.executor = self.executor
 
+        # Standing queries ([subscribe], pilosa_tpu/subscribe): the
+        # manager registers its own fragment write/close listeners and
+        # runs the notifier thread; built after the executor because
+        # overflow/TopN/topology-change evaluation pulls through it.
+        if self.subscribe_enabled:
+            from pilosa_tpu.subscribe import SubscriptionManager
+
+            self.subscribe = SubscriptionManager(
+                executor=self.executor,
+                cluster=self.cluster,
+                stats=self.stats,
+                tracer=self.tracer,
+                admission=self.admission,
+                data_dir=self.data_dir,
+                logger=self.logger,
+                max_subscriptions=self.subscribe_max_subscriptions,
+                queue_cap=self.subscribe_queue_cap,
+                delta_cap=self.subscribe_delta_cap,
+                coalesce_ms=self.subscribe_coalesce_ms,
+                refresh_interval_ms=self.subscribe_refresh_ms,
+            )
+            self.subscribe.open()
+            self.handler.subscribe = self.subscribe
+
         # Lazy overlapped cold staging: serving starts NOW; fragment
         # mirrors stream into HBM behind it — gossip-announced hot
         # slices first, then the pre-restart residency table (MRU
@@ -583,6 +626,10 @@ class Server:
 
     def close(self) -> None:
         self._closing.set()
+        # Stop push delivery first: the notifier must not evaluate
+        # against a holder/executor that is mid-teardown.
+        if self.subscribe is not None:
+            self.subscribe.close()
         self.rebalance.close()
         # Stops the hint replayer and persists the per-slice write
         # versions (.replication.json) so a clean restart compares.

@@ -36,6 +36,13 @@ class DeltaLog:
         self._mu = threading.Lock()
         # (index, slice) -> {"entries": list, "bits": int, "overflowed": bool}
         self._logs: dict[tuple[str, int], dict] = {}
+        # Lifetime overflow count per (index, slice) — survives
+        # start/stop cycles so /debug/rebalance shows WHICH slices keep
+        # outrunning the cap (the subscribe engine's re-run-on-overflow
+        # path and capacity planning both need the per-slice view; the
+        # untagged cluster.rebalance.deltaOverflow counter only says
+        # that overflows happened somewhere).
+        self._overflows: dict[tuple[str, int], int] = {}
         self.stats = stats
 
     # -- lifecycle (driven by the coordinator via /rebalance/delta) ----
@@ -99,18 +106,30 @@ class DeltaLog:
                     "entries": len(log["entries"]),
                     "bits": log["bits"],
                     "overflowed": log["overflowed"],
+                    "overflows": self._overflows.get((i, s), 0),
                 }
                 for (i, s), log in self._logs.items()
             }
 
+    def overflow_counts(self) -> dict:
+        """Lifetime per-slice overflow counts (``{"idx/slice": n}``) —
+        includes slices whose log has since closed."""
+        with self._mu:
+            return {f"{i}/{s}": n for (i, s), n in self._overflows.items()}
+
     # -- the fragment write-listener hook ------------------------------
 
-    def record(self, frag, set_rows, set_cols, clear_rows, clear_cols) -> None:
+    def record(
+        self, frag, set_rows, set_cols, clear_rows, clear_cols, exact=True
+    ) -> None:
         """Append one write to the slice's log (no-op when the slice is
         not migrating).  ``*_cols`` are ABSOLUTE column ids, matching
-        the import-view replay wire format.  Called under the fragment
-        lock so log order equals application order; only takes the log
-        lock (a leaf in the lock hierarchy)."""
+        the import-view replay wire format.  ``exact`` (the listener
+        protocol's changed-bits flag) is irrelevant here: replay is
+        idempotent set/clear, so already-true bits are harmless.
+        Called under the fragment lock so log order equals application
+        order; only takes the log lock (a leaf in the lock
+        hierarchy)."""
         key = (frag.index, frag.slice)
         with self._mu:
             log = self._logs.get(key)
@@ -125,8 +144,14 @@ class DeltaLog:
                 log["entries"] = []
                 log["bits"] = 0
                 log["overflowed"] = True
+                self._overflows[key] = self._overflows.get(key, 0) + 1
                 if self.stats is not None:
                     self.stats.count("cluster.rebalance.deltaOverflow")
+                    self.stats.count_with_custom_tags(
+                        "rebalance.deltalog.overflows",
+                        1,
+                        [f"slice:{frag.index}/{frag.slice}"],
+                    )
                 return
             log["entries"].append(
                 (

@@ -857,6 +857,7 @@ class Rebalancer:
             "transition": self._cluster.transition_snapshot(),
             "running": self.running(),
             "deltaLog": self.delta_log.snapshot(),
+            "deltaOverflows": self.delta_log.overflow_counts(),
             "joinCandidates": sorted(self._candidates),
         }
         if self._last_error:

@@ -192,10 +192,13 @@ class Replication:
 
     # -- write-listener leg (registered by the server) -----------------
 
-    def on_local_write(self, frag, set_rows, set_cols, clear_rows, clear_cols):
+    def on_local_write(
+        self, frag, set_rows, set_cols, clear_rows, clear_cols, exact=True
+    ):
         """Fragment write hook: advance the slice's version and feed the
-        coordinator's capture scope.  Called under the fragment lock —
-        leaf locks only.
+        coordinator's capture scope (``exact`` is irrelevant: version
+        bumps and hint capture are idempotent per bit).  Called under
+        the fragment lock — leaf locks only.
 
         The listener registry is PROCESS-global while servers are
         per-node: in-process multi-server setups (tests, benches) would

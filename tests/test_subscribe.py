@@ -1,0 +1,544 @@
+"""Standing queries (pilosa_tpu/subscribe): registry compilation, the
+per-fragment listener lifecycle, incremental delta evaluation against
+the hosteval oracle (randomized storm), overflow re-basing, delivery
+semantics (at-least-once, version-monotonic), and a subscription
+surviving a live 2->3 resize."""
+
+from __future__ import annotations
+
+import json
+import random
+import time
+
+import pytest
+
+from pilosa_tpu.cluster.topology import Cluster
+from pilosa_tpu.core.fragment import Fragment
+from pilosa_tpu.net.client import ClientError, InternalClient
+from pilosa_tpu.net.server import Server
+from pilosa_tpu.ops.bitplane import SLICE_WIDTH
+from pilosa_tpu.pql.parser import Query, parse_string
+from pilosa_tpu.rebalance.deltalog import DeltaLog
+from pilosa_tpu.subscribe import registry as reg
+from pilosa_tpu.subscribe.registry import SubscribeError
+
+
+# ---------------------------------------------------------------------------
+# registry compilation
+# ---------------------------------------------------------------------------
+
+
+def _compile(pql: str):
+    q = parse_string(pql)
+    return reg.compile_subscription(q.calls[0])
+
+
+class TestRegistry:
+    def test_count_bitmap(self):
+        kind, inner, tree, keys, force = _compile(
+            "Subscribe(Count(Bitmap(rowID=3, frame=f)))"
+        )
+        assert kind == reg.KIND_COUNT
+        assert inner.name == "Count"
+        assert tree.name == "Bitmap"
+        assert keys == {("f", 3)}
+        assert not force
+
+    def test_bare_tree_wrapped_in_count(self):
+        kind, inner, tree, keys, _ = _compile(
+            "Subscribe(Intersect(Bitmap(rowID=1, frame=f), Bitmap(rowID=2, frame=g)))"
+        )
+        assert kind == reg.KIND_COUNT
+        assert inner.name == "Count"
+        assert keys == {("f", 1), ("g", 2)}
+
+    def test_topn_forces_pull(self):
+        kind, inner, tree, keys, force = _compile("Subscribe(TopN(frame=f, n=5))")
+        assert kind == reg.KIND_TOPN
+        assert tree is None
+        assert keys == {("f", None)}
+        assert force
+
+    def test_range_is_frame_wildcard(self):
+        _, _, _, keys, _ = _compile("Subscribe(Count(Range(frame=f, v > 10)))")
+        assert keys == {("f", None)}
+
+    def test_rejects_bad_shapes(self):
+        for pql in (
+            "Subscribe()",
+            "Subscribe(Count(Bitmap(rowID=1)), Count(Bitmap(rowID=2)))",
+            "Subscribe(SetBit(rowID=1, frame=f, columnID=2))",
+            "Subscribe(Sum(frame=f, field=v))",
+        ):
+            with pytest.raises(SubscribeError):
+                _compile(pql)
+
+
+# ---------------------------------------------------------------------------
+# fragment listener lifecycle (regression: a closed fragment must hold
+# zero registered listeners)
+# ---------------------------------------------------------------------------
+
+
+class TestFragmentListeners:
+    def _frag(self, tmp_path):
+        return Fragment(
+            path=str(tmp_path / "frag"), index="i", frame="f",
+            view="standard", slice_i=0,
+        )
+
+    def test_close_clears_listeners(self, tmp_path):
+        frag = self._frag(tmp_path)
+        calls = []
+        frag.add_write_listener(lambda *a, **k: calls.append(a))
+        frag.open()
+        frag.set_bit(1, 2)
+        assert calls, "listener must fire on a point write"
+        assert frag.write_listener_count() == 1
+        frag.close()
+        assert frag.write_listener_count() == 0
+
+    def test_retire_clears_listeners(self, tmp_path):
+        frag = self._frag(tmp_path)
+        frag.open()
+        frag.add_write_listener(lambda *a, **k: None)
+        assert frag.write_listener_count() == 1
+        frag.mark_retired()
+        assert frag.write_listener_count() == 0
+        frag.close()
+
+    def test_add_remove_dedupe(self, tmp_path):
+        frag = self._frag(tmp_path)
+        fn = lambda *a, **k: None  # noqa: E731
+        frag.add_write_listener(fn)
+        frag.add_write_listener(fn)
+        assert frag.write_listener_count() == 1
+        frag.remove_write_listener(fn)
+        assert frag.write_listener_count() == 0
+
+    def test_point_writes_are_exact_imports_are_not(self, tmp_path):
+        frag = self._frag(tmp_path)
+        frag.open()
+        seen = []
+        frag.add_write_listener(
+            lambda f, sr, sc, cr, cc, exact: seen.append(
+                (list(sr), list(cr), exact)
+            )
+        )
+        frag.set_bit(1, 2)
+        frag.set_bit(1, 2)  # no-op: must NOT notify
+        frag.clear_bit(1, 2)
+        frag.import_bulk([1, 1], [3, 3])  # raw request, dupes included
+        frag.close()
+        assert seen[0] == ([1], [], True)
+        assert seen[1] == ([], [1], True)
+        assert seen[2] == ([1, 1], [], False)
+        assert len(seen) == 3
+
+
+# ---------------------------------------------------------------------------
+# per-slice delta-log overflow observability
+# ---------------------------------------------------------------------------
+
+
+class _Frag:
+    def __init__(self, index="i", frame="f", view="standard", slice_i=0):
+        self.index, self.frame, self.view, self.slice = index, frame, view, slice_i
+
+
+class TestDeltaLogOverflowCounters:
+    def test_overflow_counts_per_slice(self):
+        log = DeltaLog(cap=2)
+        log.start("i", 0)
+        log.start("i", 1)
+        f0, f1 = _Frag(slice_i=0), _Frag(slice_i=1)
+        for c in range(5):
+            log.record(f0, [1], [c], [], [])
+        log.record(f1, [1], [0], [], [])
+        assert log.overflow_counts() == {"i/0": 1}
+        snap = log.snapshot()
+        assert snap["i/0"]["overflows"] == 1
+        assert snap["i/0"]["overflowed"] is True
+        assert snap["i/1"]["overflows"] == 0
+        # lifetime: survives stop/start of the same slice
+        log.stop("i", 0)
+        log.start("i", 0)
+        for c in range(5):
+            log.record(f0, [1], [c], [], [])
+        assert log.overflow_counts() == {"i/0": 2}
+
+
+# ---------------------------------------------------------------------------
+# engine integration over one real node
+# ---------------------------------------------------------------------------
+
+
+def _boot(tmp_path, name, **kwargs):
+    s = Server(
+        data_dir=str(tmp_path / name),
+        host="127.0.0.1:0",
+        cluster=Cluster(replica_n=1),
+        anti_entropy_interval=3600,
+        polling_interval=3600,
+        cache_flush_interval=3600,
+        **kwargs,
+    )
+    s.open()
+    return s
+
+
+def _drain(client, sid, after):
+    """Drain the retained update queue past ``after``; returns
+    (last_update_or_None, new_cursor) and asserts version monotonicity."""
+    last = None
+    while True:
+        status, data = client._request(
+            "GET", f"/subscribe/{sid}/poll?after={after}&timeout_ms=100"
+        )
+        doc = json.loads(client._check(status, data))
+        if doc.get("timeout"):
+            return last, after
+        assert doc["version"] > after, "versions must be monotonic"
+        last, after = doc, doc["version"]
+
+
+class TestEngineIncremental:
+    def test_adjust_slice_and_full_paths(self, tmp_path):
+        s = _boot(tmp_path, "node")
+        try:
+            c = InternalClient(s.host, timeout=10.0)
+            c.create_index("i")
+            c.create_frame("i", "f", {})
+            mgr = s.subscribe
+
+            single = mgr.register("i", 'Subscribe(Count(Bitmap(rowID=1, frame="f")))')
+            compound = mgr.register(
+                "i",
+                'Subscribe(Count(Union(Bitmap(rowID=1, frame="f"),'
+                ' Bitmap(rowID=2, frame="f"))))',
+            )
+            assert single.value == 0 and compound.value == 0
+            assert single.fast_row == 1  # the exact ±n path compiled
+
+            for col in range(8):
+                c.execute_query("i", f'SetBit(frame="f", rowID=1, columnID={col})')
+            c.execute_query("i", 'SetBit(frame="f", rowID=2, columnID=100)')
+            assert mgr.flush()
+            assert single.value == 8
+            assert compound.value == 9
+            assert mgr.evals["adjust"] > 0, "single-leaf counts must ±n"
+            assert mgr.evals["slice"] > 0, "compound trees re-eval the slice"
+
+            c.execute_query("i", 'ClearBit(frame="f", rowID=1, columnID=3)')
+            assert mgr.flush()
+            assert single.value == 7 and compound.value == 8
+
+            # a duplicate point write changes nothing and emits nothing
+            v = single.version
+            c.execute_query("i", 'SetBit(frame="f", rowID=1, columnID=0)')
+            assert mgr.flush()
+            assert single.value == 7 and single.version == v
+        finally:
+            s.close()
+
+    def test_overflow_forces_full_reeval(self, tmp_path):
+        s = _boot(tmp_path, "node", subscribe_delta_cap=4)
+        try:
+            c = InternalClient(s.host, timeout=10.0)
+            c.create_index("i")
+            c.create_frame("i", "f", {})
+            mgr = s.subscribe
+            sub = mgr.register("i", 'Subscribe(Count(Bitmap(rowID=1, frame="f")))')
+            # One import far over the 4-bit budget: the pending budget
+            # overflows and the subscription re-bases from the planes.
+            c.import_bits("i", "f", 0, [(1, col) for col in range(64)])
+            assert mgr.flush()
+            assert sub.value == 64
+            assert mgr.overflows >= 1
+            snap = mgr.snapshot()
+            assert snap["counters"]["overflows"] >= 1
+        finally:
+            s.close()
+
+    def test_unregister_and_limit(self, tmp_path):
+        s = _boot(tmp_path, "node", subscribe_max_subscriptions=2)
+        try:
+            c = InternalClient(s.host, timeout=10.0)
+            c.create_index("i")
+            c.create_frame("i", "f", {})
+            mgr = s.subscribe
+            a = mgr.register("i", 'Subscribe(Count(Bitmap(rowID=1, frame="f")))')
+            mgr.register("i", 'Subscribe(Count(Bitmap(rowID=2, frame="f")))')
+            with pytest.raises(SubscribeError):
+                mgr.register("i", 'Subscribe(Count(Bitmap(rowID=3, frame="f")))')
+            assert mgr.unregister(a.id)
+            assert a.closed
+            assert not mgr.unregister(a.id)
+            mgr.register("i", 'Subscribe(Count(Bitmap(rowID=3, frame="f")))')
+        finally:
+            s.close()
+
+    def test_http_surface(self, tmp_path):
+        s = _boot(tmp_path, "node")
+        try:
+            c = InternalClient(s.host, timeout=10.0)
+            c.create_index("i")
+            c.create_frame("i", "f", {})
+            status, data = c._request(
+                "POST",
+                "/subscribe",
+                body=json.dumps(
+                    {"index": "i", "query": 'Subscribe(Count(Bitmap(rowID=1, frame="f")))'}
+                ).encode(),
+            )
+            assert status == 201
+            doc = json.loads(data)
+            assert doc["version"] == 1 and doc["value"] == 0
+
+            c.execute_query("i", 'SetBit(frame="f", rowID=1, columnID=9)')
+            assert s.subscribe.flush()
+            upd, _ = _drain(c, doc["id"], doc["version"])
+            assert upd is not None and upd["value"] == 1
+
+            # bad queries are client errors, not 500s
+            for q in (
+                "Count(Bitmap(rowID=1))",  # not a Subscribe
+                "Subscribe(Count(Range(rowID=1, frame=f, start=0, end=1)))",
+                "not pql",
+            ):
+                status, _ = c._request(
+                    "POST", "/subscribe",
+                    body=json.dumps({"index": "i", "query": q}).encode(),
+                )
+                assert status == 400, q
+            status, _ = c._request("GET", "/subscribe/nope/poll")
+            assert status == 404
+
+            status, data = c._request("GET", "/debug/subscriptions")
+            snap = json.loads(c._check(status, data))
+            assert snap["count"] == 1
+            assert snap["subscriptions"][0]["id"] == doc["id"]
+
+            status, data = c._request("DELETE", f"/subscribe/{doc['id']}")
+            assert status == 200
+            # a poll against the unregistered subscription reports gone
+            status, _ = c._request("GET", f"/subscribe/{doc['id']}/poll")
+            assert status in (404, 410)
+        finally:
+            s.close()
+
+
+# ---------------------------------------------------------------------------
+# randomized byte-identity storm: every delivered value equals the
+# from-scratch hosteval pull at quiescence
+# ---------------------------------------------------------------------------
+
+
+class TestStorm:
+    def test_randomized_storm_matches_oracle(self, tmp_path):
+        rng = random.Random(0xC0FFEE)
+        s = _boot(tmp_path, "node", subscribe_delta_cap=200)
+        try:
+            c = InternalClient(s.host, timeout=10.0)
+            c.create_index("i")
+            c.create_frame("i", "f", {})
+            c.create_frame("i", "g", {})
+            c.create_frame("i", "b", {"rangeEnabled": True})
+            c.create_field("i", "b", "v", 0, 1000)
+            mgr = s.subscribe
+
+            subs = []
+            for row in range(4):
+                subs.append(mgr.register(
+                    "i", f'Subscribe(Count(Bitmap(rowID={row}, frame="f")))'
+                ))
+            subs.append(mgr.register(
+                "i",
+                'Subscribe(Count(Intersect(Bitmap(rowID=0, frame="f"),'
+                ' Bitmap(rowID=1, frame="f"))))',
+            ))
+            subs.append(mgr.register(
+                "i",
+                'Subscribe(Count(Union(Bitmap(rowID=2, frame="f"),'
+                ' Bitmap(rowID=0, frame="g"))))',
+            ))
+            subs.append(mgr.register(
+                "i",
+                'Subscribe(Count(Difference(Bitmap(rowID=0, frame="f"),'
+                ' Bitmap(rowID=1, frame="f"))))',
+            ))
+            subs.append(mgr.register("i", 'Subscribe(Count(Range(frame="b", v > 500)))'))
+            topn = mgr.register("i", 'Subscribe(TopN(frame="f", n=3))')
+            subs.append(topn)
+            cursors = {sub.id: sub.version for sub in subs}
+
+            def check_all():
+                assert mgr.flush()
+                for sub in subs:
+                    want = s.executor.execute(
+                        "i", Query(calls=[sub.inner])
+                    )[0]
+                    assert sub.value == want, (sub.pql, sub.value, want)
+                    # the update stream is monotonic and ends at the
+                    # oracle value
+                    upd, cursors[sub.id] = _drain(c, sub.id, cursors[sub.id])
+                    if upd is not None:
+                        assert upd["value"] == sub.value_json
+
+            for burst in range(6):
+                for _ in range(40):
+                    op = rng.random()
+                    row = rng.randrange(4)
+                    col = rng.randrange(2 * SLICE_WIDTH)
+                    frame = rng.choice(["f", "f", "f", "g"])
+                    if op < 0.55:
+                        c.execute_query(
+                            "i",
+                            f'SetBit(frame="{frame}", rowID={row}, columnID={col})',
+                        )
+                    elif op < 0.8:
+                        c.execute_query(
+                            "i",
+                            f'ClearBit(frame="{frame}", rowID={row}, columnID={col})',
+                        )
+                    else:
+                        c.import_value(
+                            "i", "b", "v", col // SLICE_WIDTH,
+                            [col], [rng.randrange(1000)],
+                        )
+                if burst == 3:
+                    # bulk import mid-storm: inexact notifications +
+                    # possible overflow re-base
+                    c.import_bits(
+                        "i", "f", 0,
+                        [(rng.randrange(4), rng.randrange(SLICE_WIDTH))
+                         for _ in range(300)],
+                    )
+                s._tick_max_slices()
+                check_all()
+
+            # incremental arithmetic never drifted: byte-identical to a
+            # from-scratch hosteval pull over every slice
+            idx = s.holder.index("i")
+            all_slices = list(range(idx.max_slice() + 1))
+            for sub in subs:
+                if sub.kind != reg.KIND_COUNT:
+                    continue
+                want = s.executor.hosteval.count_total(
+                    "i", sub.tree, all_slices
+                )
+                assert sub.value == want, sub.pql
+        finally:
+            s.close()
+
+
+# ---------------------------------------------------------------------------
+# a subscription survives a live 2->3 resize
+# ---------------------------------------------------------------------------
+
+
+def _wire(servers, hosts):
+    for s in servers:
+        for h in hosts:
+            if s.cluster.node_by_host(h) is None:
+                s.cluster.add_node(h)
+        s.cluster.nodes.sort(key=lambda n: n.host)
+
+
+def _wait_resize(host, timeout=90.0):
+    client = InternalClient(host, timeout=10.0)
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        status, data = client._request("GET", "/debug/rebalance")
+        snap = json.loads(client._check(status, data))
+        if not snap.get("running") and snap.get("transition") is None:
+            return snap
+        time.sleep(0.1)
+    raise AssertionError("resize did not complete")
+
+
+class TestResizeSurvival:
+    def test_subscription_survives_2_to_3_resize(self, tmp_path):
+        def boot(name, ring):
+            cluster = Cluster(replica_n=1)
+            for h in ring:
+                cluster.add_node(h)
+            s = Server(
+                data_dir=str(tmp_path / name),
+                host="127.0.0.1:0",
+                cluster=cluster,
+                anti_entropy_interval=3600,
+                polling_interval=3600,
+                cache_flush_interval=3600,
+                rebalance_release_delay_ms=0.0,
+                subscribe_refresh_ms=100.0,
+            )
+            s.open()
+            return s
+
+        s1 = boot("n1", ())
+        s2 = boot("n2", (s1.host,))
+        servers = [s1, s2]
+        try:
+            hosts2 = sorted([s1.host, s2.host])
+            _wire(servers, hosts2)
+            for s in servers:
+                s.holder.create_index_if_not_exists("i")
+                s.holder.index("i").create_frame_if_not_exists("f")
+            c = InternalClient(s1.host, timeout=10.0)
+            n_slices = 4
+            for sl in range(n_slices):
+                c.execute_query(
+                    "i",
+                    f'SetBit(frame="f", rowID=1, columnID={sl * SLICE_WIDTH + sl})',
+                )
+            for s in servers:
+                s._tick_max_slices()
+
+            sub = s1.subscribe.register(
+                "i", 'Subscribe(Count(Bitmap(rowID=1, frame="f")))'
+            )
+            assert sub.value == n_slices
+            cursor = sub.version
+            epoch0 = sub.epoch
+
+            s3 = boot("n3", hosts2)
+            servers.append(s3)
+            hosts3 = sorted(hosts2 + [s3.host])
+            status, data = c._request(
+                "POST", "/cluster/resize",
+                body=json.dumps({"hosts": hosts3}).encode(),
+            )
+            c._check(status, data)
+            _wait_resize(s1.host)
+
+            # writes keep landing after the cutover; the subscription
+            # keeps tracking them through the new topology
+            for sl in range(n_slices):
+                for attempt in range(20):
+                    try:
+                        c.execute_query(
+                            "i",
+                            f'SetBit(frame="f", rowID=1,'
+                            f' columnID={sl * SLICE_WIDTH + 500})',
+                        )
+                        break
+                    except (ClientError, ConnectionError):
+                        time.sleep(0.1)
+
+            want = 2 * n_slices
+            deadline = time.time() + 30
+            while time.time() < deadline and sub.value != want:
+                time.sleep(0.1)
+            assert sub.value == want, (sub.value, want)
+            assert not sub.closed
+            assert sub.epoch > epoch0, "topology move must re-stamp the epoch"
+            assert s1.subscribe.epoch_flips >= 1
+
+            # no lost updates: the stream drains monotonically to the
+            # final absolute value
+            upd, _ = _drain(c, sub.id, cursor)
+            assert upd is not None and upd["value"] == want
+        finally:
+            for s in servers:
+                s.close()

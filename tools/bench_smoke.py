@@ -261,6 +261,43 @@ def main() -> int:
     ):
         print(f"FAIL: degraded tier watchdog implausible: {wd}", file=sys.stderr)
         return 1
+    st = out.get("standing")
+    if not isinstance(st, dict):
+        print(f"FAIL: artifact missing standing tier: {out}", file=sys.stderr)
+        return 1
+    if st.get("subscriptions", 0) < 1000:
+        print(
+            f"FAIL: standing tier must run >= 1000 subscriptions: {st}",
+            file=sys.stderr,
+        )
+        return 1
+    lag = st.get("lag_ms")
+    if (
+        not isinstance(lag, dict)
+        or lag.get("samples", 0) < 1
+        or not isinstance(lag.get("p50"), (int, float))
+        or not isinstance(lag.get("p99"), (int, float))
+        or lag["p99"] <= 0
+    ):
+        print(f"FAIL: standing tier lag implausible: {st}", file=sys.stderr)
+        return 1
+    if st.get("updates", 0) < 1:
+        print(f"FAIL: standing tier emitted no updates: {st}", file=sys.stderr)
+        return 1
+    qp = st.get("query_path")
+    ratio = (qp or {}).get("p99_ratio")
+    if not isinstance(qp, dict) or not isinstance(ratio, (int, float)):
+        print(f"FAIL: standing tier missing query_path: {st}", file=sys.stderr)
+        return 1
+    # "Unchanged" with CI-runner headroom: the write-side listener
+    # fan-out must not visibly tax the synchronous read path.
+    if not (0 < ratio <= 3.0):
+        print(
+            f"FAIL: query-path p99 with subscriptions on is {ratio}x the"
+            f" subscriptions-off baseline: {qp}",
+            file=sys.stderr,
+        )
+        return 1
     pc = out.get("program_cache")
     if not isinstance(pc, dict) or "entries" not in pc or "bounds" not in pc:
         print(f"FAIL: artifact missing program_cache: {out}", file=sys.stderr)
@@ -292,7 +329,9 @@ def main() -> int:
         f" {tt['hydrations']} hydrations, cold-hit {tt['cold_hit_rate']});"
         f" degraded {dg['degraded']['gcols_s']} vs healthy"
         f" {dg['healthy']['gcols_s']} Gcols/s, watchdog recovery"
-        f" {dg['watchdog']['trip_recovery_ms']} ms"
+        f" {dg['watchdog']['trip_recovery_ms']} ms;"
+        f" standing {st['subscriptions']} subs, lag p99 {lag['p99']} ms,"
+        f" query-path p99 ratio {ratio}x"
     )
     return 0
 

@@ -1,0 +1,208 @@
+"""Standing-query bench tier (bench.py ``standing`` section).
+
+Boots ONE real in-process node twice — standing queries enabled, then
+the identical node with ``subscribe_enabled=False`` — and measures, on
+the enabled node, N >= 1000 registered subscriptions under a live
+SetBit stream:
+
+* registration throughput (ms/subscription, compile + snapshot eval),
+* update lag p50/p99 (write-arrival -> notification-batch done; the
+  manager's recorded per-batch lag ring, read via
+  ``/debug/subscriptions``),
+* delta-evaluation tier counts (adjust / slice / full) — proof the
+  incremental paths, not blanket re-pulls, carried the load,
+
+and on BOTH nodes the query-path p50/p99 for a synchronous PQL storm
+racing the same writer — the subscriptions-off run is the baseline the
+``p99_ratio`` figure is taken against (the write-path listener fan-out
+must not tax readers).
+
+A CPU subprocess tier like cluster_bench/rebalance_bench: one JSON
+line on stdout, progress on stderr prefixed ``[standing]``.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, ".")
+
+import os  # noqa: E402
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from pilosa_tpu.cluster.topology import Cluster  # noqa: E402
+from pilosa_tpu.net.client import ClientError, InternalClient  # noqa: E402
+from pilosa_tpu.net.server import Server  # noqa: E402
+from pilosa_tpu.ops.bitplane import SLICE_WIDTH  # noqa: E402
+from pilosa_tpu.pql.parser import Query  # noqa: E402
+
+N_SUBS = int(os.environ.get("STANDING_SUBS", "1000"))
+N_ROWS = 64
+N_SLICES = 2
+N_QUERIES = int(os.environ.get("STANDING_QUERIES", "150"))
+
+
+def log(msg: str) -> None:
+    print(f"[standing] {msg}", file=sys.stderr, flush=True)
+
+
+def pcts(lats: list) -> dict:
+    lats = sorted(lats)
+    return {
+        "p50_ms": round(lats[len(lats) // 2] * 1e3, 2),
+        "p99_ms": round(
+            lats[min(len(lats) - 1, int(len(lats) * 0.99))] * 1e3, 2
+        ),
+    }
+
+
+def boot(tmp: str, name: str, enabled: bool) -> Server:
+    s = Server(
+        data_dir=f"{tmp}/{name}",
+        cluster=Cluster(replica_n=1),
+        anti_entropy_interval=3600,
+        polling_interval=3600,
+        cache_flush_interval=3600,
+        subscribe_enabled=enabled,
+        subscribe_max_subscriptions=max(10_000, N_SUBS * 2),
+    )
+    s.open()
+    s.cluster.add_node(s.host)
+    s.holder.create_index_if_not_exists("i")
+    s.holder.index("i").create_frame_if_not_exists("f")
+    c = InternalClient(s.host, timeout=10.0)
+    for sl in range(N_SLICES):
+        c.execute_query(
+            "i", f'SetBit(frame="f", rowID=0, columnID={sl * SLICE_WIDTH})'
+        )
+    s._tick_max_slices()
+    return s
+
+
+def query_storm(host: str, stop: threading.Event) -> tuple[list, list]:
+    """(query latencies, confirmed writes) for a synchronous PQL storm
+    racing a 5 ms-interval SetBit writer — identical on both boots."""
+    confirmed: list = []
+
+    def writer():
+        cw = InternalClient(host, timeout=10.0)
+        k = 0
+        while not stop.is_set():
+            row = k % N_ROWS
+            col = (k % N_SLICES) * SLICE_WIDTH + 1000 + k // N_SLICES
+            try:
+                cw.execute_query(
+                    "i", f'SetBit(frame="f", rowID={row}, columnID={col})'
+                )
+                confirmed.append((row, col))
+            except (ClientError, ConnectionError):
+                pass
+            k += 1
+            time.sleep(0.005)
+
+    t = threading.Thread(target=writer, daemon=True)
+    t.start()
+    c = InternalClient(host, timeout=10.0)
+    pql = (
+        'Count(Intersect(Bitmap(rowID=0, frame="f"),'
+        ' Bitmap(rowID=1, frame="f")))'
+    )
+    c.execute_query("i", pql)  # warm the program outside the timed loop
+    lats = []
+    for _ in range(N_QUERIES):
+        t0 = time.perf_counter()
+        c.execute_query("i", pql)
+        lats.append(time.perf_counter() - t0)
+    stop.set()
+    t.join(timeout=10)
+    return lats, confirmed
+
+
+def main() -> int:
+    tmp = tempfile.mkdtemp(prefix="standing-bench-")
+    out: dict = {"subscriptions": N_SUBS}
+
+    # --- baseline: identical node + storm, subscriptions OFF ----------
+    s = boot(tmp, "off", enabled=False)
+    try:
+        lats, confirmed = query_storm(s.host, threading.Event())
+        off = pcts(lats)
+        off["writes"] = len(confirmed)
+    finally:
+        s.close()
+    log(f"subscriptions-off query path: p50 {off['p50_ms']} ms "
+        f"p99 {off['p99_ms']} ms over {N_QUERIES} queries")
+
+    # --- enabled: N subs registered, same storm -----------------------
+    s = boot(tmp, "on", enabled=True)
+    try:
+        mgr = s.subscribe
+        t0 = time.perf_counter()
+        subs = []
+        for i in range(N_SUBS - 2):
+            subs.append(
+                mgr.register(
+                    "i",
+                    f'Subscribe(Count(Bitmap(rowID={i % N_ROWS}, frame="f")))',
+                )
+            )
+        subs.append(
+            mgr.register(
+                "i",
+                'Subscribe(Count(Union(Bitmap(rowID=0, frame="f"),'
+                ' Bitmap(rowID=1, frame="f"))))',
+            )
+        )
+        subs.append(mgr.register("i", 'Subscribe(TopN(frame="f", n=5))'))
+        reg_s = time.perf_counter() - t0
+        assert len(subs) == N_SUBS
+        out["registration_ms_per_sub"] = round(reg_s / N_SUBS * 1e3, 3)
+        log(f"registered {N_SUBS} subscriptions in {reg_s:.2f}s "
+            f"({out['registration_ms_per_sub']} ms/sub)")
+
+        lats, confirmed = query_storm(s.host, threading.Event())
+        on = pcts(lats)
+        on["writes"] = len(confirmed)
+
+        # Quiesce, then spot-check convergence against the pull oracle:
+        # a lag number for updates that are WRONG would be meaningless.
+        assert mgr.flush(timeout=60.0), "pending deltas never drained"
+        for sub in subs[:: max(1, N_SUBS // 50)]:
+            want = s.executor.execute("i", Query(calls=[sub.inner]))[0]
+            assert sub.value == want, (sub.pql, sub.value, want)
+
+        c = InternalClient(s.host, timeout=10.0)
+        status, data = c._request("GET", "/debug/subscriptions")
+        dbg = json.loads(c._check(status, data))
+        out["lag_ms"] = dbg["lagMs"]
+        out["updates"] = dbg["counters"]["updates"]
+        out["batches"] = dbg["counters"]["batches"]
+        out["evals"] = dbg["counters"]["evals"]
+        assert out["lag_ms"]["samples"] > 0, "no notification batches"
+        assert out["updates"] > 0, "no updates emitted"
+    finally:
+        s.close()
+    log(f"subscriptions-on query path: p50 {on['p50_ms']} ms "
+        f"p99 {on['p99_ms']} ms; update lag p50 {out['lag_ms']['p50']} ms "
+        f"p99 {out['lag_ms']['p99']} ms over {out['batches']} batches "
+        f"({out['updates']} updates; evals {out['evals']})")
+
+    out["query_path"] = {
+        "off": off,
+        "on": on,
+        "p99_ratio": (
+            round(on["p99_ms"] / off["p99_ms"], 2) if off["p99_ms"] else None
+        ),
+    }
+    print(json.dumps(out))
+    log(f"query-path p99 ratio on/off: {out['query_path']['p99_ratio']}x")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,250 @@
+"""Standing-query smoke (`make subscribe-smoke`, BLOCKING in CI).
+
+Boots two real in-process HTTP nodes, registers N >= 100 standing
+queries, streams live imports at them, live-grows the cluster to three
+nodes MID-STREAM, and asserts:
+
+* every subscription converges to the from-scratch pull oracle after
+  the stream quiesces (no lost or phantom updates),
+* the update streams are version-monotonic and carry absolute values,
+* the topology move re-stamped subscription epochs (snapshot-then-
+  stream across the cutover) and nothing was dropped,
+* update lag stays bounded (p99 from /debug/subscriptions),
+* under PILOSA_LOCK_CHECK=1 the observed lock acquisition order stays
+  consistent with the static lock graph (pilosa_tpu/analyze).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, ".")
+
+import os  # noqa: E402
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from pilosa_tpu.cluster.topology import Cluster  # noqa: E402
+from pilosa_tpu.net.client import ClientError, InternalClient  # noqa: E402
+from pilosa_tpu.net.server import Server  # noqa: E402
+from pilosa_tpu.ops.bitplane import SLICE_WIDTH  # noqa: E402
+from pilosa_tpu.pql.parser import Query  # noqa: E402
+
+N_SUBS = 100
+N_SLICES = 4
+# Generous on a shared CPU runner: the bound catches unbounded growth
+# (a stuck notifier, a leak), not jitter.
+LAG_P99_BOUND_MS = 20_000.0
+
+
+def boot(tmp, name, ring=()):
+    cluster = Cluster(replica_n=1)
+    for h in ring:
+        cluster.add_node(h)
+    s = Server(
+        data_dir=f"{tmp}/{name}",
+        cluster=cluster,
+        anti_entropy_interval=3600,
+        polling_interval=3600,
+        cache_flush_interval=3600,
+        rebalance_release_delay_ms=0.0,
+        subscribe_refresh_ms=200.0,
+    )
+    s.open()
+    return s
+
+
+def drain(client, sid, after):
+    """Drain one subscription's retained updates past ``after``,
+    asserting version monotonicity; returns (last, cursor)."""
+    last = None
+    while True:
+        status, data = client._request(
+            "GET", f"/subscribe/{sid}/poll?after={after}&timeout_ms=50"
+        )
+        doc = json.loads(client._check(status, data))
+        if doc.get("timeout"):
+            return last, after
+        assert doc["version"] > after, "versions must be monotonic"
+        last, after = doc, doc["version"]
+
+
+def main() -> int:
+    tmp = tempfile.mkdtemp(prefix="subscribe-smoke-")
+    s0 = boot(tmp, "n0")
+    s1 = boot(tmp, "n1")
+    s2 = None
+    stop = threading.Event()
+    try:
+        hosts2 = sorted([s0.host, s1.host])
+        for s in (s0, s1):
+            for h in hosts2:
+                if s.cluster.node_by_host(h) is None:
+                    s.cluster.add_node(h)
+            s.cluster.nodes.sort(key=lambda n: n.host)
+            s.holder.create_index_if_not_exists("i")
+            s.holder.index("i").create_frame_if_not_exists("f")
+
+        c0 = InternalClient(s0.host, timeout=10.0)
+        for sl in range(N_SLICES):
+            c0.execute_query(
+                "i", f'SetBit(frame="f", rowID=0, columnID={sl * SLICE_WIDTH + sl})'
+            )
+        for s in (s0, s1):
+            s._tick_max_slices()
+
+        # N single-row counts + a few compound trees + a TopN: every
+        # write stream row has a watcher.
+        mgr = s0.subscribe
+        subs = []
+        for row in range(N_SUBS - 3):
+            subs.append(
+                mgr.register(
+                    "i", f'Subscribe(Count(Bitmap(rowID={row % 16}, frame="f")))'
+                )
+            )
+        subs.append(
+            mgr.register(
+                "i",
+                'Subscribe(Count(Union(Bitmap(rowID=0, frame="f"),'
+                ' Bitmap(rowID=1, frame="f"))))',
+            )
+        )
+        subs.append(
+            mgr.register(
+                "i",
+                'Subscribe(Count(Intersect(Bitmap(rowID=0, frame="f"),'
+                ' Bitmap(rowID=2, frame="f"))))',
+            )
+        )
+        subs.append(mgr.register("i", 'Subscribe(TopN(frame="f", n=5))'))
+        assert len(subs) >= 100, len(subs)
+        cursors = {sub.id: sub.version for sub in subs}
+        epoch0 = {sub.id: sub.epoch for sub in subs}
+
+        confirmed: list[tuple[int, int]] = []
+
+        def writer():
+            cw = InternalClient(s0.host, timeout=10.0)
+            k = 0
+            while not stop.is_set():
+                row = k % 16
+                col = (k % N_SLICES) * SLICE_WIDTH + 500 + k // N_SLICES
+                try:
+                    cw.execute_query(
+                        "i", f'SetBit(frame="f", rowID={row}, columnID={col})'
+                    )
+                    confirmed.append((row, col))
+                except (ClientError, ConnectionError):
+                    pass  # retried next loop; only confirmed writes count
+                k += 1
+                time.sleep(0.005)
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        time.sleep(1.0)
+
+        # Live 2->3 grow MID-STREAM.
+        s2 = boot(tmp, "n2", ring=hosts2)
+        hosts3 = sorted(hosts2 + [s2.host])
+        status, data = c0._request(
+            "POST", "/cluster/resize",
+            body=json.dumps({"hosts": hosts3}).encode(),
+        )
+        c0._check(status, data)
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            st, d = c0._request("GET", "/debug/rebalance")
+            snap = json.loads(c0._check(st, d))
+            if not snap.get("running") and snap.get("transition") is None:
+                break
+            time.sleep(0.2)
+        else:
+            raise SystemExit("FAIL: resize did not complete in 120s")
+
+        time.sleep(1.0)
+        stop.set()
+        t.join(timeout=10)
+        assert confirmed, "writer confirmed no writes"
+
+        # Quiesce, then every subscription must equal the pull oracle.
+        assert mgr.flush(timeout=30.0), "pending deltas never drained"
+        deadline = time.time() + 60
+        stale = subs
+        while time.time() < deadline and stale:
+            nxt = []
+            for sub in stale:
+                want = s0.executor.execute("i", Query(calls=[sub.inner]))[0]
+                if sub.value != want:
+                    nxt.append(sub)
+            stale = nxt
+            if stale:
+                time.sleep(0.2)
+        assert not stale, (
+            f"{len(stale)} subscriptions never converged; first: "
+            f"{stale[0].pql} = {stale[0].value}"
+        )
+
+        # Delivery: monotonic versions ending at the oracle value, and
+        # the topology move re-stamped every subscription's epoch.
+        flipped = 0
+        for sub in subs[:20] + subs[-3:]:
+            upd, cursors[sub.id] = drain(c0, sub.id, cursors[sub.id])
+            if upd is not None:
+                assert upd["value"] == sub.value_json, sub.pql
+            if sub.epoch > epoch0[sub.id]:
+                flipped += 1
+        assert flipped > 0, "no subscription saw the topology epoch move"
+        assert mgr.epoch_flips >= 1, "manager never observed the flip"
+
+        status, data = c0._request("GET", "/debug/subscriptions")
+        dbg = json.loads(c0._check(status, data))
+        assert dbg["count"] == len(subs), dbg["count"]
+        lag = dbg["lagMs"]
+        assert lag["samples"] > 0, "no notification batches measured"
+        assert lag["p99"] is not None and lag["p99"] < LAG_P99_BOUND_MS, lag
+        assert dbg["pending"]["bits"] == 0, dbg["pending"]
+
+        print(
+            json.dumps(
+                {
+                    "ok": True,
+                    "subscriptions": len(subs),
+                    "confirmed_writes": len(confirmed),
+                    "updates": dbg["counters"]["updates"],
+                    "batches": dbg["counters"]["batches"],
+                    "epoch_flips": dbg["counters"]["epochFlips"],
+                    "evals": dbg["counters"]["evals"],
+                    "lag_ms": lag,
+                }
+            )
+        )
+        print("subscribe smoke OK", file=sys.stderr)
+    finally:
+        stop.set()
+        for s in (s0, s1, s2):
+            if s is not None:
+                s.close()
+    if os.environ.get("PILOSA_LOCK_CHECK"):
+        # Runtime lock-order validation: every acquisition order the
+        # standing-query engine produced (fragment lock -> pending
+        # lock, notifier evaluation, delivery) must be consistent with
+        # the static lock graph (pilosa_tpu/analyze).
+        from pilosa_tpu.analyze import runtime as lock_check
+
+        problems = lock_check.verify()
+        print(lock_check.report().splitlines()[0])
+        if problems:
+            for p in problems:
+                print("lock-check DISAGREEMENT:", p)
+            return 1
+        print("lock-check ok: runtime order consistent with static graph")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
