@@ -29,6 +29,16 @@ Incremental strategy per (subscription, batch):
   listener), or the topology moved: re-run the whole query through the
   executor — the same fused-interpreter pull path clients use.
 
+Incremental bases are race-free against in-flight writes: a write
+commits to the plane, bumps the fragment's ``_version``, and notifies
+listeners inside ONE fragment-lock critical section, and the plane
+read that (re)bases a slice captures ``(_serial, _version)`` under the
+same lock.  So an adj delta stamped at or below the base version is
+provably already inside the base (dropped, never double-applied), one
+stamped above it is provably not (applied), and a range straddling the
+base — or a recreated fragment's incomparable serial — degrades to a
+dirty re-evaluation instead of arithmetic on a guess.
+
 Epoch-following: every batch compares ``cluster.routing_version``
 (bumped on ring changes AND per-slice flips) against the last value it
 saw; a change forces a full snapshot re-evaluation of every
@@ -40,6 +50,7 @@ so a coalesced-away intermediate version loses no information.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 import uuid
@@ -103,8 +114,20 @@ class Subscription:
         self.streams = 0            # live SSE connections
         self.delivered = 0          # updates handed to any waiter
         self.created = time.time()
+        # False until the registration snapshot (version 1) is
+        # published: the sub is in the watch index — so no write is
+        # missed — but the notifier requeues its pending deltas
+        # instead of racing the registering thread's evaluation.
+        self.ready = False
+        # Consecutive notifier-eval failures; drained deltas are
+        # requeued (as full) until a small strike cap gives up.
+        self.eval_failures = 0
         # Incremental per-slice counts — owned by the notifier thread.
         self.slice_counts: dict[int, int] = {}
+        # Per-slice (fragment serial, write version) captured with the
+        # plane read that produced slice_counts — the double-apply
+        # fence for adj deltas (see module docstring).
+        self.slice_vers: dict[int, tuple] = {}
 
     def watches(self, frame: str, rows) -> bool:
         """Does a write to ``frame`` touching ``rows`` intersect this
@@ -142,6 +165,11 @@ class SubscriptionManager:
         self.tracer = tracer or trace.NOP_TRACER
         self.admission = admission
         self.data_dir = str(data_dir or "")
+        # Node filter: normalized prefix WITH trailing separator so a
+        # sibling data dir can never cross-match (/data/n1 vs /data/n10).
+        self._data_dir_prefix = (
+            os.path.normpath(self.data_dir) + os.sep if self.data_dir else ""
+        )
         self.logger = logger or (lambda msg: None)
         self.max_subscriptions = int(max_subscriptions)
         self.queue_cap = int(queue_cap)
@@ -162,7 +190,8 @@ class SubscriptionManager:
         # fragment lock and takes only this.
         self._pending_mu = threading.Lock()
         self._pending_cv = threading.Condition(self._pending_mu)
-        # sid -> {"adj": {slice: ±n}, "dirty": {slice}, "full": bool,
+        # sid -> {"adj": {slice: [±n, frag_serial, ver_min, ver_max]},
+        #         "dirty": {slice}, "full": bool,
         #         "t0": monotonic-first-touch}
         self._pending: dict[str, dict] = {}
         # (index, slice) -> bits accumulated since the last drain.
@@ -216,9 +245,14 @@ class SubscriptionManager:
     # -- registration --------------------------------------------------
 
     def register(self, index: str, pql: str) -> Subscription:
-        """Parse, compile, snapshot-evaluate, and index one standing
+        """Parse, compile, index, THEN snapshot-evaluate one standing
         query; returns the live subscription with version 1 == the
-        registration snapshot (snapshot-then-stream from birth)."""
+        registration snapshot.  Publishing into the watch index before
+        the snapshot is taken closes the registration window: a write
+        landing during the evaluation is captured in the pending map
+        and re-applied by the notifier (stale deltas are version-
+        filtered on apply), so snapshot-then-stream holds from birth
+        even on a single node with no refresh tick."""
         q = parse_string(pql)
         if len(q.calls) != 1:
             raise reg.SubscribeError("exactly one Subscribe(...) call required")
@@ -240,11 +274,6 @@ class SubscriptionManager:
         )
         if kind == reg.KIND_COUNT:
             self._compile(sub)
-        # Snapshot evaluation OUTSIDE any engine lock (takes fragment
-        # locks via the host planes / executor).
-        value = self._evaluate_full(sub)
-        routing = self.cluster.routing_version if self.cluster else 0
-        self._emit(sub, value, routing, force=True)
         with self._mu:
             if len(self._subs) >= self.max_subscriptions:
                 raise reg.SubscribeError(
@@ -254,6 +283,29 @@ class SubscriptionManager:
             subs[sub.id] = sub
             self._subs = subs
             self._rebuild_watch_locked()
+        try:
+            # Snapshot evaluation OUTSIDE any engine lock (takes
+            # fragment locks via the host planes / executor).  The
+            # notifier sees the sub but defers its deltas until ready.
+            value = self._evaluate_full(sub)
+            routing = self.cluster.routing_version if self.cluster else 0
+            self._emit(sub, value, routing, force=True)
+        except BaseException:
+            with self._mu:
+                if sub.id in self._subs:
+                    subs = dict(self._subs)
+                    del subs[sub.id]
+                    self._subs = subs
+                    self._rebuild_watch_locked()
+            with self._pending_cv:
+                self._pending.pop(sub.id, None)
+            raise
+        with self._pending_cv:
+            sub.ready = True
+            if sub.id in self._pending:
+                # Writes landed mid-snapshot: have the notifier fold
+                # them in now rather than on the next matching write.
+                self._pending_cv.notify()
         self.registered += 1
         self.stats.count("exec.subscribe.registered")
         return sub
@@ -318,9 +370,7 @@ class SubscriptionManager:
         lock hierarchy, like DeltaLog.record).  ``exact`` gates the ±n
         fast path: only bits that provably changed may adjust a count
         without re-evaluation."""
-        if self.data_dir and not str(getattr(frag, "path", "")).startswith(
-            self.data_dir
-        ):
+        if self._foreign(frag):
             return  # another in-process node's fragment
         watch = self._watch
         if not watch:
@@ -367,8 +417,23 @@ class SubscriptionManager:
                     d = sum(1 for r in set_rows if int(r) == sub.fast_row)
                     d -= sum(1 for r in clear_rows if int(r) == sub.fast_row)
                     if d:
+                        # Stamp with the fragment's write version
+                        # (already bumped for this write, same lock
+                        # hold) — the apply side drops deltas the
+                        # slice base provably includes.
                         adj = p["adj"]
-                        adj[frag.slice] = adj.get(frag.slice, 0) + d
+                        ver = frag._version
+                        cur = adj.get(frag.slice)
+                        if cur is None:
+                            adj[frag.slice] = [d, frag._serial, ver, ver]
+                        elif cur[1] == frag._serial:
+                            cur[0] += d
+                            cur[3] = ver  # monotonic per fragment
+                        else:
+                            # Recreated fragment under this slice:
+                            # stamps incomparable — degrade to dirty.
+                            adj.pop(frag.slice, None)
+                            p["dirty"].add(frag.slice)
                 else:
                     p["dirty"].add(frag.slice)
             if touched:
@@ -379,11 +444,21 @@ class SubscriptionManager:
                 "exec.subscribe.overflows", 1, [f"slice:{frag.index}/{s}"]
             )
 
+    def _foreign(self, frag) -> bool:
+        """True when the fragment belongs to another in-process node
+        (multi-server tests/benches share the module-wide listener)."""
+        if not self._data_dir_prefix:
+            return False
+        path = os.path.normpath(str(getattr(frag, "path", "")))
+        return not path.startswith(self._data_dir_prefix)
+
     def on_fragment_close(self, frag) -> None:
         """Fragment left service (close/retire/demotion, including a
         rebalanced-away slice): drop its pending budget and force the
         affected subscriptions to re-base that slice — incremental
         state must never survive the plane it was computed from."""
+        if self._foreign(frag):
+            return
         watch = self._watch
         entries = watch.get((frag.index, frag.frame)) if watch else None
         with self._pending_cv:
@@ -412,9 +487,19 @@ class SubscriptionManager:
                 self.logger(f"subscribe: notify loop error: {e}")
                 self._stop.wait(0.2)
 
+    def _actionable_locked(self) -> bool:
+        """Any pending entry whose subscription is ready (or gone)?
+        Entries for subs whose registration snapshot is still in
+        flight are deferred — they must not wake or spin the loop."""
+        for sid in self._pending:
+            sub = self._subs.get(sid)
+            if sub is None or sub.ready:
+                return True
+        return False
+
     def _drain_once(self) -> None:
         with self._pending_cv:
-            if not self._pending:
+            if not self._actionable_locked():
                 self._pending_cv.wait(self.refresh_s)
         if self._stop.is_set():
             return
@@ -423,8 +508,12 @@ class SubscriptionManager:
             # batch instead of one notification per bit.
             self._stop.wait(self.coalesce_s)
         with self._pending_cv:
-            batch = self._pending
-            self._pending = {}
+            batch = {}
+            for sid in list(self._pending):
+                sub = self._subs.get(sid)
+                if sub is not None and not sub.ready:
+                    continue  # deferred until the snapshot publishes
+                batch[sid] = self._pending.pop(sid)
             self._pending_bits = {}
             self._busy = bool(batch)
 
@@ -466,8 +555,17 @@ class SubscriptionManager:
                 self._busy = False
 
     def _process_batch(self, batch: dict, routing: int, force: bool) -> None:
+        """Evaluate one drained batch.  The deltas are already out of
+        the pending map, so every failure path — the admission lane
+        shedding (shared with POST /subscribe), a per-subscription
+        eval error, anything unexpected — must push its unprocessed
+        entries BACK via _requeue: a silently dropped adj delta is
+        permanent drift, a dropped dirty mark permanent staleness."""
         t0 = min(p["t0"] for p in batch.values())
         root = self.tracer.start_trace("subscribe", subscriptions=len(batch))
+        remaining = dict(batch)
+        requeue: dict[str, dict] = {}
+        inflight: str | None = None
         ticket = None
         try:
             if self.admission is not None:
@@ -480,18 +578,52 @@ class SubscriptionManager:
                 for sid, p in batch.items():
                     sub = self._subs.get(sid)
                     if sub is None or sub.closed:
+                        del remaining[sid]
+                        continue
+                    if not sub.ready:
+                        # Registration snapshot still in flight —
+                        # defer, don't race the registering thread.
+                        requeue[sid] = p
+                        del remaining[sid]
                         continue
                     try:
+                        inflight = sid
                         changed = self._reevaluate(sub, p, routing, force)
+                        inflight = None
+                        sub.eval_failures = 0
                     except Exception as e:  # noqa: BLE001
+                        inflight = None
+                        sub.eval_failures += 1
+                        if sub.eval_failures <= 3:
+                            p["full"] = True
+                            requeue[sid] = p
+                        else:
+                            # Give up on the entry, but invalidate the
+                            # (possibly half-adjusted) bases so the
+                            # next delta re-evaluates from planes.
+                            sub.slice_counts = {}
+                            sub.slice_vers = {}
                         self.logger(
                             f"subscribe: eval failed for {sid}: {e}"
                         )
+                        del remaining[sid]
                         continue
+                    del remaining[sid]
                     if changed:
                         n_updates += 1
                 sp.annotate(updates=n_updates)
+        except BaseException:
+            # Batch-level failure (admission shed, ...): everything
+            # not yet individually settled goes back on the map; the
+            # notify loop logs and retries after a short backoff.  An
+            # eval interrupted mid-flight may have half-applied its
+            # adj deltas — force that one to re-base in full.
+            if inflight is not None and inflight in remaining:
+                remaining[inflight]["full"] = True
+            requeue.update(remaining)
+            raise
         finally:
+            self._requeue(requeue)
             if ticket is not None:
                 ticket.release()
             self.tracer.finish_root(root)
@@ -500,6 +632,41 @@ class SubscriptionManager:
         self.batches += 1
         self.stats.count("exec.subscribe.notifyBatches")
         self.stats.histogram("exec.subscribe.lagMs", lag_ms)
+
+    def _requeue(self, entries: dict) -> None:
+        """Merge drained-but-unprocessed entries back into the live
+        pending map (see _process_batch)."""
+        if not entries:
+            return
+        with self._pending_cv:
+            for sid, src in entries.items():
+                p = self._pending.get(sid)
+                if p is None:
+                    self._pending[sid] = src
+                else:
+                    self._merge_entry(p, src)
+            self._pending_cv.notify()
+
+    @staticmethod
+    def _merge_entry(p: dict, src: dict) -> None:
+        """Fold ``src`` (an older drained entry) into live entry ``p``."""
+        p["full"] = p["full"] or src["full"]
+        p["t0"] = min(p["t0"], src["t0"])
+        p["dirty"] |= src["dirty"]
+        adj = p["adj"]
+        for s, (d, serial, vmin, vmax) in src["adj"].items():
+            if s in p["dirty"]:
+                continue  # the dirty re-evaluation subsumes the delta
+            cur = adj.get(s)
+            if cur is None:
+                adj[s] = [d, serial, vmin, vmax]
+            elif cur[1] == serial:
+                adj[s] = [
+                    cur[0] + d, serial, min(cur[2], vmin), max(cur[3], vmax)
+                ]
+            else:
+                adj.pop(s, None)
+                p["dirty"].add(s)
 
     def _multi_node(self) -> bool:
         return self.cluster is not None and len(self.cluster.nodes) > 1
@@ -532,28 +699,47 @@ class SubscriptionManager:
             idx = self.ex.holder.index(sub.index)
             if idx is None:
                 sub.slice_counts = {}
+                sub.slice_vers = {}
                 return 0
             slices = list(range(idx.max_slice() + 1))
-            sub.slice_counts = self._slice_count(sub, slices)
+            sub.slice_counts, sub.slice_vers = self._slice_count(sub, slices)
             return sum(sub.slice_counts.values())
         sub.slice_counts = {}
+        sub.slice_vers = {}
         res = self.ex.execute(sub.index, Query(calls=[sub.inner]))
         return res[0]
 
     def _evaluate_incremental(self, sub, p: dict):
         """Single-node count kind: ±adjust exact deltas, re-evaluate
-        only the dirty slices' compiled program over the host planes."""
+        only the dirty slices' compiled program over the host planes.
+
+        An adj delta is applied ONLY when its whole write-version
+        range lies above the slice base's stamp; at or below the stamp
+        it was already counted by the plane read that produced the
+        base (the double-apply fence — see the module docstring), and
+        a straddling range or recreated-fragment serial degrades to a
+        dirty re-evaluation."""
         dirty = set(p["dirty"])
         counts = sub.slice_counts
-        for s, d in p["adj"].items():
+        vers = sub.slice_vers
+        for s, (d, serial, vmin, vmax) in p["adj"].items():
             if s in dirty:
                 continue  # the re-evaluation below subsumes the delta
-            if s in counts:
+            base = vers.get(s)
+            if s not in counts or base is None:
+                dirty.add(s)  # no stamped base yet — evaluate, don't guess
+            elif serial != base[0]:
+                dirty.add(s)  # fragment recreated: stamps incomparable
+            elif vmax <= base[1]:
+                continue      # fully inside the base plane read already
+            elif vmin > base[1]:
                 counts[s] += d
             else:
-                dirty.add(s)  # no base yet — evaluate, don't guess
+                dirty.add(s)  # straddles the base read — re-evaluate
         if dirty:
-            counts.update(self._slice_count(sub, sorted(dirty)))
+            new_counts, new_vers = self._slice_count(sub, sorted(dirty))
+            counts.update(new_counts)
+            vers.update(new_vers)
             self.evals["slice"] += 1
             self.stats.count_with_custom_tags(
                 "exec.subscribe.evals", 1, ["mode:slice"]
@@ -565,10 +751,36 @@ class SubscriptionManager:
             )
         return sum(counts.values())
 
-    def _slice_count(self, sub, slices) -> dict[int, int]:
+    def _slice_count(self, sub, slices) -> tuple[dict, dict]:
         """Per-slice counts of the compiled program over the
         authoritative host planes (word-local numpy — the hosteval
-        evaluation, reusing the registration-time compile)."""
+        evaluation, reusing the registration-time compile); returns
+        ``(counts, version stamps)``.
+
+        For the single-leaf fast path the plane read captures the
+        fragment's ``(_serial, _version)`` under the SAME fragment-lock
+        hold — anchoring exactly which adj deltas the base includes.
+        Compound trees take no stamp: they only ever receive dirty
+        marks, which are idempotent."""
+        out: dict[int, int] = {}
+        vers: dict[int, tuple] = {}
+        if sub.fast_row is not None:
+            for s in slices:
+                frag = self.ex.holder.fragment(
+                    sub.index, sub.fast_frame, "standard", s
+                )
+                if frag is None:
+                    # No fragment yet: serial -1 never matches a real
+                    # write's stamp, so the first delta re-evaluates.
+                    out[s] = 0
+                    vers[s] = (-1, -1)
+                    continue
+                with frag._mu:
+                    stamp = (frag._serial, frag._version)
+                    row = frag._row_words_host(sub.fast_row)
+                out[s] = 0 if row is None else popcount_words(row)
+                vers[s] = stamp
+            return out, vers
         expr, leaves = sub.expr, sub.leaves
         if sub.has_bsi:
             # BSI depth grows with written values (new high limbs add
@@ -576,14 +788,13 @@ class SubscriptionManager:
             # byte-identical to a pull.
             rewritten = self.ex._rewrite_bsi(sub.index, sub.tree)
             expr, leaves = plan.decompose(rewritten)
-        out: dict[int, int] = {}
         for s in slices:
             rows = [
                 self.ex._leaf_row_host(sub.index, leaf, s) for leaf in leaves
             ]
             r = plan.eval_expr_np(expr, rows, bp.WORDS_PER_SLICE)
             out[s] = 0 if r is None else popcount_words(r)
-        return out
+        return out, vers
 
     # -- delivery ------------------------------------------------------
 

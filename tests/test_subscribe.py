@@ -329,6 +329,145 @@ class TestEngineIncremental:
 
 
 # ---------------------------------------------------------------------------
+# review regressions: the drain/plane-read double-apply race, the
+# registration window, admission-shed batch loss, sibling data dirs
+# ---------------------------------------------------------------------------
+
+
+class TestDeltaFences:
+    def test_write_landing_during_rebase_not_double_applied(self, tmp_path):
+        s = _boot(tmp_path, "node")
+        try:
+            c = InternalClient(s.host, timeout=10.0)
+            c.create_index("i")
+            c.create_frame("i", "f", {})
+            mgr = s.subscribe
+            sub = mgr.register("i", 'Subscribe(Count(Bitmap(rowID=1, frame="f")))')
+
+            orig = mgr._slice_count
+            raced = []
+
+            def racing(sub_, slices):
+                # Exact point write lands AFTER the drain but BEFORE
+                # the plane read: the new base includes it, so its adj
+                # delta (stamped at or below the base version) must be
+                # dropped on the next batch, not re-applied.
+                if not raced:
+                    raced.append(True)
+                    c.execute_query(
+                        "i", 'SetBit(frame="f", rowID=1, columnID=7)'
+                    )
+                return orig(sub_, slices)
+
+            mgr._slice_count = racing
+            # an inexact single-bit import marks the slice dirty,
+            # forcing the re-base that opens the race window
+            c.import_bits("i", "f", 0, [(1, 3)])
+            assert mgr.flush()
+            mgr._slice_count = orig
+            assert mgr.flush()
+            assert sub.value == 2, "col 7 must be counted exactly once"
+            want = s.executor.execute("i", Query(calls=[sub.inner]))[0]
+            assert sub.value == want
+        finally:
+            s.close()
+
+    def test_write_during_registration_snapshot_not_lost(self, tmp_path):
+        s = _boot(tmp_path, "node")
+        try:
+            c = InternalClient(s.host, timeout=10.0)
+            c.create_index("i")
+            c.create_frame("i", "f", {})
+            mgr = s.subscribe
+
+            orig = mgr._slice_count
+            hit = []
+
+            def racing(sub_, slices):
+                # One write BEFORE the snapshot's plane read (included
+                # in the base; its pending delta must be dropped) and
+                # one AFTER it (not in the base; must be applied by
+                # the notifier) — both inside the registration window.
+                if not hit:
+                    hit.append(True)
+                    c.execute_query(
+                        "i", 'SetBit(frame="f", rowID=1, columnID=1)'
+                    )
+                    res = orig(sub_, slices)
+                    c.execute_query(
+                        "i", 'SetBit(frame="f", rowID=1, columnID=2)'
+                    )
+                    return res
+                return orig(sub_, slices)
+
+            mgr._slice_count = racing
+            sub = mgr.register("i", 'Subscribe(Count(Bitmap(rowID=1, frame="f")))')
+            mgr._slice_count = orig
+            assert mgr.flush()
+            assert sub.value == 2, (
+                "a write in the registration window must be neither "
+                "lost nor double-counted"
+            )
+        finally:
+            s.close()
+
+    def test_admission_shed_requeues_batch(self, tmp_path):
+        from pilosa_tpu.net.resilience import ShedError
+
+        s = _boot(tmp_path, "node")
+        try:
+            c = InternalClient(s.host, timeout=10.0)
+            c.create_index("i")
+            c.create_frame("i", "f", {})
+            mgr = s.subscribe
+            sub = mgr.register("i", 'Subscribe(Count(Bitmap(rowID=1, frame="f")))')
+
+            class _Ticket:
+                def release(self):
+                    pass
+
+            class _Shedding:
+                def __init__(self, fails):
+                    self.fails = fails
+                    self.sheds = 0
+
+                def acquire(self, cls, deadline=None):
+                    if self.fails > 0:
+                        self.fails -= 1
+                        self.sheds += 1
+                        raise ShedError("subscribe lane saturated")
+                    return _Ticket()
+
+            gate = _Shedding(fails=2)
+            mgr.admission = gate
+            c.execute_query("i", 'SetBit(frame="f", rowID=1, columnID=5)')
+            assert mgr.flush(timeout=15.0)
+            assert gate.sheds == 2, "the shed path must have been taken"
+            assert sub.value == 1, "drained deltas must survive a shed"
+        finally:
+            s.close()
+
+    def test_sibling_data_dir_not_cross_matched(self, tmp_path):
+        s = _boot(tmp_path, "n1")
+        try:
+            mgr = s.subscribe
+
+            class F:
+                pass
+
+            own = F()
+            own.path = str(tmp_path / "n1" / "i" / "f" / "standard" / "0")
+            sibling = F()
+            sibling.path = str(tmp_path / "n10" / "i" / "f" / "standard" / "0")
+            assert not mgr._foreign(own)
+            assert mgr._foreign(sibling), (
+                "/…/n10 must not prefix-match the /…/n1 node"
+            )
+        finally:
+            s.close()
+
+
+# ---------------------------------------------------------------------------
 # randomized byte-identity storm: every delivered value equals the
 # from-scratch hosteval pull at quiescence
 # ---------------------------------------------------------------------------
