@@ -5,7 +5,7 @@
 
 PYTHON ?= python
 
-.PHONY: default test lint analyze typecheck check bench bench-smoke chaos-smoke device-chaos-smoke load-smoke resize-smoke multichip-smoke tier-smoke replication-smoke subscribe-smoke churn-soak install build docker clean generate
+.PHONY: default test lint analyze typecheck metrics-lint check bench bench-smoke chaos-smoke device-chaos-smoke load-smoke resize-smoke multichip-smoke tier-smoke replication-smoke subscribe-smoke churn-soak install build docker clean generate
 
 default: build test
 
@@ -31,6 +31,13 @@ lint:
 analyze:
 	$(PYTHON) -m pilosa_tpu.analyze --json analyze-report.json
 
+# Metrics-documentation lint (tools/metrics_lint.py): AST-extracts
+# every metric name from the stats calls in pilosa_tpu/ and fails if
+# one is absent from the docs/administration.md metrics reference
+# table.  BLOCKING in CI (.github/workflows/check.yml).
+metrics-lint:
+	$(PYTHON) tools/metrics_lint.py
+
 # mypy non-strict baseline (pyproject [tool.mypy]): the promoted
 # modules (exec/plan, device/pool, net/resilience, analyze/*) check
 # for real; everything else must import-check.  Skips with a notice
@@ -45,7 +52,7 @@ typecheck:
 # The CI gate (.github/workflows/check.yml): lint + analyzer + types
 # plus the tier-1 test suite (everything not marked slow) on the
 # forced CPU backend.
-check: lint analyze typecheck
+check: lint analyze typecheck metrics-lint
 	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow' \
 		--continue-on-collection-errors -p no:cacheprovider
 

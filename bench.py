@@ -919,6 +919,29 @@ def main() -> None:
         "entries": plan.program_cache_stats(),
         "bounds": plan.program_cache_bounds(),
     }
+    # Launch telemetry snapshot (obs/perf.py): the per-site roofline
+    # view — achieved GB/s (and % of measured stream floor when the
+    # probe ran) for every device launch path the run exercised, plus
+    # per-cache first-compile cost.  The bench asserts on this block
+    # (tools/bench_smoke.py), so keep keys stable.
+    try:
+        from pilosa_tpu.obs import perf as perf_mod
+
+        psnap = perf_mod.registry().snapshot()
+        out["perf"] = {
+            "floor_gbps": psnap.get("floor_gbps"),
+            "sites": {
+                name: {
+                    "launches": s["launches"],
+                    "gbps": s["gbps"],
+                    "floor_pct": s.get("floor_pct"),
+                }
+                for name, s in psnap.get("sites", {}).items()
+            },
+            "compile_ms": plan.program_cache_compile_ms(),
+        }
+    except Exception as e:  # noqa: BLE001 — the artifact must survive
+        log(f"perf snapshot FAILED ({e!r:.300})")
     print(json.dumps(out))
 
 

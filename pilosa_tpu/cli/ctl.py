@@ -111,6 +111,10 @@ def build_server(cfg: config_mod.Config):
         stream_chunk_bytes=cfg.net.stream_chunk_bytes,
         slow_query_ms=cfg.obs.slow_query_ms,
         trace_ring=cfg.obs.trace_ring,
+        latency_buckets_ms=(cfg.obs.latency_buckets_ms or None),
+        slo_ms=cfg.obs.slo_ms,
+        slo_objective=cfg.obs.slo_objective,
+        floor_probe=cfg.obs.floor_probe,
         mesh_devices=cfg.device.mesh_devices,
         hbm_budget_bytes=cfg.device.hbm_budget_bytes,
         device_prefetch=cfg.device.prefetch,

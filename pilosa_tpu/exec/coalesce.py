@@ -82,6 +82,7 @@ from dataclasses import dataclass
 import numpy as np
 
 from pilosa_tpu import device as device_mod
+from pilosa_tpu.obs import perf as perf_mod
 from pilosa_tpu.obs.stats import NopStatsClient
 
 DEFAULT_MAX_BATCH = 64
@@ -152,6 +153,10 @@ class _Item:
     # pass streams each distinct plane row once however many queries
     # reference it.  None = no identities known (columns stay unique).
     leaf_keys: "tuple | None" = None
+    # Submitting query's trace id, captured at submit time: the
+    # dispatcher thread has no trace contextvar, so the launch
+    # telemetry's slowest-launch attribution rides the item.
+    trace_id: str = ""
 
 
 def _placement(batch) -> tuple:
@@ -255,6 +260,7 @@ class CoalesceScheduler:
             future=fut,
             pin_keys=tuple(k for k in pin_keys if k is not None),
             leaf_keys=leaf_keys,
+            trace_id=perf_mod.current_trace_id(),
         )
         with self._cv:
             if self._closed:
@@ -274,7 +280,12 @@ class CoalesceScheduler:
         queries this way (PR-10's single-flight only covered identical
         ones)."""
         fut: Future = Future()
-        item = _Item(batch=list(arrays), future=fut, pin_keys=())
+        item = _Item(
+            batch=list(arrays),
+            future=fut,
+            pin_keys=(),
+            trace_id=perf_mod.current_trace_id(),
+        )
         with self._cv:
             if self._closed:
                 raise CoalesceClosed("coalescer closed")
@@ -511,6 +522,7 @@ class CoalesceScheduler:
             mesh = None
         pins = {k for it in items for k in it.pin_keys}
         t0 = time.monotonic()
+        t_disp = [t0]  # set when the async dispatch returns (pre-fetch)
         with device_mod.pool().pinned(*pins):
             if mesh is not None:
                 # The program psums over the mesh: serialize with every
@@ -521,17 +533,31 @@ class CoalesceScheduler:
                 # rides the launch watchdog: a hung rendezvous trips,
                 # fails the waiters (who fall over to the host path
                 # per-waiter), and quarantines the collective path.
-                res = self._run_collective(
-                    lambda: np.asarray(
-                        jax.device_get(
-                            plan.compiled_total_count(expr, mesh)(batch)
-                        )
-                    )
-                )
+                def _body():
+                    out = plan.compiled_total_count(expr, mesh)(batch)
+                    t_disp[0] = time.monotonic()
+                    return np.asarray(jax.device_get(out))
+
+                res = self._run_collective(_body)
             else:
                 out = plan.compiled_total_count(expr, mesh)(batch)
+                t_disp[0] = time.monotonic()
                 res = np.asarray(jax.device_get(out))
-        launch_ms = (time.monotonic() - t0) * 1e3
+        t1 = time.monotonic()
+        launch_ms = (t1 - t0) * 1e3
+        if perf_mod.enabled():
+            perf_mod.record_launch(
+                "collective" if mesh is not None else "total",
+                reduce="total",
+                queries=len(items),
+                rows=int(batch.shape[0]),
+                n_bytes=perf_mod.plane_bytes(
+                    int(batch.shape[0]), int(np.prod(batch.shape[1:]))
+                ),
+                dispatch_ms=(t_disp[0] - t0) * 1e3,
+                total_ms=launch_ms,
+                trace_id=items[0].trace_id,
+            )
         with self._mu:
             self._launches += 1
             self._queries += len(items)
@@ -612,8 +638,25 @@ class CoalesceScheduler:
         t0 = time.monotonic()
         with device_mod.pool().pinned(*pins):
             out = plan.compiled_batched(expr, reduce)(dev_in)
+            t_disp = time.monotonic()
             res = np.asarray(jax.device_get(out))
-        launch_ms = (time.monotonic() - t0) * 1e3
+        t1 = time.monotonic()
+        launch_ms = (t1 - t0) * 1e3
+        # Logical bytes are the PRE-pad rows: pad rows are bucketing
+        # overhead, not useful plane traffic.
+        if perf_mod.enabled():
+            perf_mod.record_launch(
+                "coalesce",
+                reduce=reduce,
+                queries=len(items),
+                rows=total,
+                n_bytes=perf_mod.plane_bytes(
+                    total, int(np.prod(segs[0].shape[1:]))
+                ),
+                dispatch_ms=(t_disp - t0) * 1e3,
+                total_ms=launch_ms,
+                trace_id=items[0].trace_id,
+            )
         with self._mu:
             self._launches += 1
             self._queries += len(items)
@@ -816,25 +859,42 @@ class CoalesceScheduler:
             except Exception:  # noqa: BLE001 — unit-test stand-ins
                 sharded = False
             t0 = time.monotonic()
+            t_disp = [t0]
             with device_mod.pool().pinned(*pins):
                 if reduce == "total" and sharded:
                     # The slice-axis limb sums psum over the mesh —
                     # serialize with other collective launches (and,
                     # with a health manager, run under the launch
                     # watchdog; see _launch_total).
-                    res = self._run_collective(
-                        lambda: np.asarray(
-                            jax.device_get(
-                                plan.interp_exec(
-                                    reduce, combined, prog, out_idx
-                                )
-                            )
+                    def _body():
+                        out = plan.interp_exec(
+                            reduce, combined, prog, out_idx
                         )
-                    )
+                        t_disp[0] = time.monotonic()
+                        return np.asarray(jax.device_get(out))
+
+                    res = self._run_collective(_body)
                 else:
                     out = plan.interp_exec(reduce, combined, prog, out_idx)
+                    t_disp[0] = time.monotonic()
                     res = np.asarray(jax.device_get(out))
-            launch_ms = (time.monotonic() - t0) * 1e3
+            t1 = time.monotonic()
+            launch_ms = (t1 - t0) * 1e3
+            # Logical bytes: the deduped union leaf set (streamed once
+            # per pass), pad leaves excluded.
+            if perf_mod.enabled():
+                perf_mod.record_launch(
+                    "collective" if (reduce == "total" and sharded) else "interp",
+                    reduce=reduce,
+                    queries=len(fused),
+                    rows=n_rows * l_union,
+                    n_bytes=perf_mod.plane_bytes(
+                        n_rows * l_union, int(combined.shape[-1])
+                    ),
+                    dispatch_ms=(t_disp[0] - t0) * 1e3,
+                    total_ms=launch_ms,
+                    trace_id=fused[0][0].trace_id,
+                )
             with self._mu:
                 self._launches += 1
                 self._queries += len(fused)
@@ -918,6 +978,15 @@ class CoalesceScheduler:
         t0 = time.monotonic()
         fetched = jax.device_get(arrays)
         fetch_ms = (time.monotonic() - t0) * 1e3
+        if perf_mod.enabled():
+            perf_mod.record_launch(
+                "fetch",
+                reduce="fetch",
+                queries=len(items),
+                n_bytes=sum(int(getattr(a, "nbytes", 0) or 0) for a in arrays),
+                total_ms=fetch_ms,
+                trace_id=items[0].trace_id,
+            )
         with self._mu:
             self._fetch_launches += 1
             self._fetch_arrays += len(arrays)

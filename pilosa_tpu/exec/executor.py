@@ -58,6 +58,7 @@ from pilosa_tpu.exec import hosteval as hosteval_mod
 from pilosa_tpu.exec import plan
 from pilosa_tpu.exec import warmup
 from pilosa_tpu.net import resilience
+from pilosa_tpu.obs import perf as perf_mod
 from pilosa_tpu.obs import trace
 from pilosa_tpu.testing import faults
 from pilosa_tpu.ops import bitplane as bp
@@ -1381,6 +1382,36 @@ class Executor:
             persistent_cache=bool(warmup.enabled_cache_dir()),
         )
 
+    def _record_direct_launch(
+        self, ent: dict, reduce: str, t0, t_disp, t1, site: str = "direct"
+    ) -> None:
+        """Launch telemetry for an uncoalesced executor launch
+        (obs/perf.py): logical plane bytes from the KEPT slice rows
+        (pad slices are bucketing overhead, not plane traffic) times
+        the batch's leaf x word geometry."""
+        if not perf_mod.enabled():
+            return
+        geom = ent.get("perf_geom")
+        if geom is None:
+            # Computed once per (cached) batch entry: poking a sharded
+            # device array's shape metadata costs tens of microseconds,
+            # which would land on every query of a hot cached batch.
+            # Benign race — the value is idempotent.
+            batch = ent["batch"]
+            rows = len(ent.get("pos_of") or ()) or int(batch.shape[0])
+            words = int(np.prod(batch.shape[1:]))
+            ent["perf_geom"] = geom = (rows, words)
+        rows, words = geom
+        perf_mod.record_launch(
+            site,
+            reduce=reduce,
+            rows=rows,
+            n_bytes=perf_mod.plane_bytes(rows, words),
+            dispatch_ms=(t_disp - t0) * 1e3,
+            total_ms=(t1 - t0) * 1e3,
+            trace_id=perf_mod.current_trace_id(),
+        )
+
     def _coalesce_eval(self, ent: dict, reduce: str):
         """Route one assembled batch through the coalescing scheduler;
         returns the host result rows for THIS entry (``[n, words]`` for
@@ -1502,20 +1533,28 @@ class Executor:
                 ent.get("pool_key")
             ), self._device_span(ent, reduce):
                 self._fault_check_launch("direct")
+                t0 = time.monotonic()
                 if ent["mesh"] is not None:
                     # plain-XLA formulation: partitions cleanly under SPMD
-                    return jax.device_get(
-                        plan.compiled_batched(ent["expr"], reduce)(
-                            ent["batch"]
-                        )
+                    out_dev = plan.compiled_batched(ent["expr"], reduce)(
+                        ent["batch"]
                     )
-                res = plan.compiled_batched(ent["expr"], reduce)(ent["batch"])
-                if reduce == "row":
-                    # Every consumer of row results materializes them on
-                    # the host (client responses, merges), so fetch the
-                    # WHOLE batch in ONE transfer — per-slice lazy slices
-                    # would each pay a device round trip when coerced.
-                    res = np.asarray(res)
+                    t_disp = time.monotonic()
+                    res = jax.device_get(out_dev)
+                else:
+                    res = plan.compiled_batched(ent["expr"], reduce)(
+                        ent["batch"]
+                    )
+                    t_disp = time.monotonic()
+                    if reduce == "row":
+                        # Every consumer of row results materializes them
+                        # on the host (client responses, merges), so fetch
+                        # the WHOLE batch in ONE transfer — per-slice lazy
+                        # slices would each pay a device round trip when
+                        # coerced.
+                        res = np.asarray(res)
+                t1 = time.monotonic()
+                self._record_direct_launch(ent, reduce, t0, t_disp, t1)
                 return res
 
         def device_fn():
@@ -1649,11 +1688,17 @@ class Executor:
                         # rendezvous would.
                         def _collective_body():
                             self._fault_check_launch("collective")
-                            return jax.device_get(
-                                plan.compiled_total_count(
-                                    ent["expr"], ent["mesh"]
-                                )(ent["batch"])
+                            t0 = time.monotonic()
+                            out = plan.compiled_total_count(
+                                ent["expr"], ent["mesh"]
+                            )(ent["batch"])
+                            t_disp = time.monotonic()
+                            res = jax.device_get(out)
+                            self._record_direct_launch(
+                                ent, "total", t0, t_disp,
+                                time.monotonic(), site="collective",
                             )
+                            return res
 
                         try:
                             limbs = health.run_collective(_collective_body)
@@ -1663,10 +1708,14 @@ class Executor:
                             health_mod.CollectiveUnavailable,
                         ):
                             pass  # mesh path quarantined: partials
-                    res = jax.device_get(
-                        plan.compiled_batched(ent["expr"], "count")(
-                            ent["batch"]
-                        )
+                    t0 = time.monotonic()
+                    out = plan.compiled_batched(ent["expr"], "count")(
+                        ent["batch"]
+                    )
+                    t_disp = time.monotonic()
+                    res = jax.device_get(out)
+                    self._record_direct_launch(
+                        ent, "count", t0, t_disp, time.monotonic()
                     )
                     return int(
                         sum(int(res[p]) for p in ent["pos_of"].values())
@@ -1676,12 +1725,24 @@ class Executor:
                 # collective — 8 bytes home instead of a per-slice
                 # partial vector (zero pad slices contribute nothing).
                 if fits_limbs:
+                    t0 = time.monotonic()
                     limbs = plan.compiled_total_count(ent["expr"])(
                         ent["batch"]
                     )
-                    return plan.recombine_count_limbs(jax.device_get(limbs))
+                    t_disp = time.monotonic()
+                    limbs = jax.device_get(limbs)
+                    self._record_direct_launch(
+                        ent, "total", t0, t_disp,
+                        time.monotonic(), site="total",
+                    )
+                    return plan.recombine_count_limbs(limbs)
+                t0 = time.monotonic()
                 res = plan.compiled_batched(ent["expr"], "count")(ent["batch"])
+                t_disp = time.monotonic()
                 res = jax.device_get(res)
+                self._record_direct_launch(
+                    ent, "count", t0, t_disp, time.monotonic()
+                )
                 return sum(int(res[p]) for p in ent["pos_of"].values())
 
         def device_fn():
@@ -2051,6 +2112,7 @@ class Executor:
                     (ref.shape, ref.plane_rows, ref.device), []
                 ).append(entry)
             dev_outs = []  # (device array, [states]) fetched in one pass
+            t0 = time.monotonic()
             with self.tracer.span("topn.dispatch", groups=len(groups)):
                 self._fault_check_launch("topn")
                 for _gkey, members in groups.items():
@@ -2079,12 +2141,31 @@ class Executor:
                         srcs = np.stack([m[2] for m in padded])
                         out = bp.score_planes(planes, slots, srcs=srcs)
                     dev_outs.append((out, [m[0] for m in members]))
+            t_disp = time.monotonic()
             with self.tracer.span("topn.fetch", arrays=len(dev_outs)) as sp:
                 fetched = self._shared_fetch([o for o, _ in dev_outs], sp)
             for arr, (_, sts) in zip(fetched, dev_outs):
                 arr = np.asarray(arr)
                 for i, st in enumerate(sts):
                     st.counts = arr[i]
+            # Scorer roofline accounting: each live member's fused
+            # scoring pass streams its whole plane snapshot (group pad
+            # repeats are bucketing, not counted).
+            if perf_mod.enabled():
+                perf_mod.record_launch(
+                    "topn",
+                    reduce="topn",
+                    rows=sum(int(e[1].plane_rows) for e in live),
+                    n_bytes=sum(
+                        perf_mod.plane_bytes(
+                            int(e[1].plane_rows), bp.WORDS_PER_SLICE
+                        )
+                        for e in live
+                    ),
+                    dispatch_ms=(t_disp - t0) * 1e3,
+                    total_ms=(time.monotonic() - t0) * 1e3,
+                    trace_id=perf_mod.current_trace_id(),
+                )
             return True
 
         self._launch_guarded(

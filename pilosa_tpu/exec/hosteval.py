@@ -31,9 +31,12 @@ fallback visible per query.
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 
 from pilosa_tpu.exec import plan
+from pilosa_tpu.obs import perf as perf_mod
 from pilosa_tpu.ops import bitplane as bp
 
 
@@ -58,16 +61,30 @@ class HostEvaluator:
             "exec.hostEval.queries", n, [f"kind:{what}"]
         )
 
-    def _slice_rows(self, index: str, c, slices):
+    def _slice_rows(self, index: str, c, slices, reduce: str = "row"):
         """Per-slice evaluated result rows (uint32[words] or None) for
-        an already-BSI-rewritten call tree."""
+        an already-BSI-rewritten call tree.  The pass streams each
+        slice's leaf rows once, so it records into the launch telemetry
+        as a ``hosteval`` site launch (the degraded-mode row of the
+        /debug/perf roofline table)."""
         expr, leaves = plan.decompose(c)
+        t0 = time.monotonic()
         out = {}
         for s in slices:
             rows = [
                 self.ex._leaf_row_host(index, leaf, s) for leaf in leaves
             ]
             out[s] = plan.eval_expr_np(expr, rows, bp.WORDS_PER_SLICE)
+        n_rows = len(slices) * len(leaves)
+        if perf_mod.enabled():
+            perf_mod.record_launch(
+                "hosteval",
+                reduce=reduce,
+                rows=n_rows,
+                n_bytes=perf_mod.plane_bytes(n_rows, bp.WORDS_PER_SLICE),
+                total_ms=(time.monotonic() - t0) * 1e3,
+                trace_id=perf_mod.current_trace_id(),
+            )
         return out
 
     def rows(self, index: str, c, slices: list[int]) -> dict:
@@ -82,7 +99,8 @@ class HostEvaluator:
         with self.ex.tracer.span("hosteval", kind="count", slices=len(slices)):
             self._count("count")
             rows = self._slice_rows(
-                index, self.ex._rewrite_bsi(index, c), slices
+                index, self.ex._rewrite_bsi(index, c), slices,
+                reduce="count",
             )
             return {
                 s: (0 if r is None else popcount_words(r))
@@ -107,6 +125,7 @@ class HostEvaluator:
         with self.ex.tracer.span("hosteval", kind="agg", slices=len(slices)):
             self._count("agg")
             expr, leaves = plan.decompose(rc)
+            t0 = time.monotonic()
             out = {}
             for s in slices:
                 rows = [
@@ -120,6 +139,16 @@ class HostEvaluator:
                     continue
                 out[s] = np.asarray(
                     plan.eval_expr_np(expr, rows, bp.WORDS_PER_SLICE)
+                )
+            n_rows = len(slices) * len(leaves)
+            if perf_mod.enabled():
+                perf_mod.record_launch(
+                    "hosteval",
+                    reduce="agg",
+                    rows=n_rows,
+                    n_bytes=perf_mod.plane_bytes(n_rows, bp.WORDS_PER_SLICE),
+                    total_ms=(time.monotonic() - t0) * 1e3,
+                    trace_id=perf_mod.current_trace_id(),
                 )
             return out
 
@@ -139,6 +168,8 @@ class HostEvaluator:
         vectors."""
         with self.ex.tracer.span("hosteval", kind="topn", parts=len(parts)):
             self._count("topn")
+            t0 = time.monotonic()
+            n_rows = 0
             for st, sub_ref, srcw, _slot, frag in parts:
                 if sub_ref is None or st.dense_pos is None:
                     continue
@@ -150,3 +181,13 @@ class HostEvaluator:
                     if row is not None:
                         counts[i] = popcount_words(row & src)
                 st.counts = counts
+                n_rows += len(ids)
+            if perf_mod.enabled():
+                perf_mod.record_launch(
+                    "hosteval",
+                    reduce="topn",
+                    rows=n_rows,
+                    n_bytes=perf_mod.plane_bytes(n_rows, bp.WORDS_PER_SLICE),
+                    total_ms=(time.monotonic() - t0) * 1e3,
+                    trace_id=perf_mod.current_trace_id(),
+                )

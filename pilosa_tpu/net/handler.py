@@ -37,8 +37,12 @@ from __future__ import annotations
 import base64
 import io
 import json
+import os
 import re
+import shutil
 import sys
+import tarfile
+import tempfile
 import threading
 import time
 import traceback
@@ -65,6 +69,7 @@ from pilosa_tpu.net import admission as adm
 from pilosa_tpu.net import codec
 from pilosa_tpu.net import resilience as rz
 from pilosa_tpu.net import wire_pb2 as wire
+from pilosa_tpu.obs import perf as perf_mod
 from pilosa_tpu.obs import prom, trace
 from pilosa_tpu.pql.parser import ParseError, parse_string
 from pilosa_tpu.replicate import quorum as replicate_mod
@@ -110,6 +115,15 @@ def stream_body(fn):
     dispatch will not materialize the body first."""
     fn.streams_body = True
     return fn
+
+
+def _route_template(pattern: str) -> str:
+    """Route regex -> bounded metric label: named groups become
+    ``{name}`` placeholders (``/index/(?P<index>[^/]+)/query`` ->
+    ``/index/{index}/query``), so the HTTP latency histogram's ``path``
+    label set is the route table, never raw request paths."""
+    tmpl = re.sub(r"\(\?P<(\w+)>[^)]*\)", r"{\1}", pattern)
+    return tmpl.replace("?", "") or "/"
 
 
 @dataclass
@@ -169,6 +183,9 @@ class Handler:
         rebalance=None,
         tier=None,
         replication=None,
+        latency_buckets_ms=None,
+        slo_ms: float = 0.0,
+        slo_objective: float = 0.999,
     ):
         self.holder = holder
         self.executor = executor
@@ -219,6 +236,22 @@ class Handler:
         # Server: fragments restored with ?stage=true (migration
         # arrivals) register their HBM mirrors through it.
         self.prefetcher = None
+        # Native fixed-bucket latency histograms + SLO burn rate
+        # (obs/perf.py): query latency per admission class, HTTP
+        # latency per route template — rendered as Prometheus
+        # histogram families on /metrics alongside the Expvar
+        # summaries.
+        self.latency = perf_mod.LatencyHistograms(
+            buckets_ms=latency_buckets_ms,
+            slo_ms=slo_ms,
+            slo_objective=slo_objective,
+        )
+        # Base dir for /debug/profile trace tarballs, wired by the
+        # Server (data dir); bare handlers fall back to a tempdir.
+        self.profile_dir = None
+        # Single-flight guard for /debug/profile: one device trace at a
+        # time, concurrent requests answer 409.
+        self._profile_mu = threading.Lock()
         # Chunk size for streamed (chunked transfer encoding) bodies:
         # CSV export and fragment archives move in writes of this size.
         self.stream_chunk_bytes = stream_chunk_bytes or stream_mod.DEFAULT_CHUNK_BYTES
@@ -282,12 +315,16 @@ class Handler:
             ("GET", r"/debug/vars", self.handle_get_vars),
             ("GET", r"/debug/health", self.handle_get_health),
             ("GET", r"/debug/hbm", self.handle_get_hbm),
+            ("GET", r"/debug/perf", self.handle_get_perf),
+            ("GET", r"/debug/profile", self.handle_get_profile),
+            ("GET", r"/debug/stacks", self.handle_get_stacks),
             ("GET", r"/debug/traces", self.handle_get_traces),
             ("GET", r"/metrics", self.handle_get_metrics),
             ("GET", r"/debug/pprof(?P<rest>/.*)?", self.handle_get_pprof),
         ]
         self._compiled = [
-            (m, re.compile("^" + p + "$"), fn) for m, p, fn in self._routes
+            (m, re.compile("^" + p + "$"), fn, _route_template(p))
+            for m, p, fn in self._routes
         ]
         self._start_time = time.time()
 
@@ -297,6 +334,7 @@ class Handler:
 
     def dispatch(self, req: Request) -> Response:
         t0 = time.monotonic()
+        route = None  # matched route TEMPLATE (bounded label cardinality)
         try:
             # Chaos hook: the RPC-receive boundary (testing/faults.py).
             # An injected error here answers 500 — the shape of a node
@@ -306,9 +344,10 @@ class Handler:
                 host=getattr(self.executor, "host", "") or None,
                 path=req.path,
             )
-            for method, pattern, fn in self._compiled:
+            for method, pattern, fn, tmpl in self._compiled:
                 m = pattern.match(req.path.rstrip("/") or "/")
                 if m and method == req.method:
+                    route = tmpl
                     if req.stream is not None and not getattr(
                         fn, "streams_body", False
                     ):
@@ -326,7 +365,7 @@ class Handler:
         # backend must not silence the slow-query log: each observes
         # independently.
         try:
-            self._observe_stats(req, elapsed)
+            self._observe_stats(req, elapsed, route)
         except Exception:  # noqa: BLE001
             pass
         try:
@@ -335,12 +374,19 @@ class Handler:
             pass
         return resp
 
-    def _observe_stats(self, req: Request, elapsed: float) -> None:
+    def _observe_stats(
+        self, req: Request, elapsed: float, route: str | None = None
+    ) -> None:
         if self.stats is not None:
             # per-endpoint latency histogram (reference: handler.go:140-167)
             self.stats.histogram(
                 f"http.{req.method}.{req.path.split('?')[0]}", elapsed * 1000.0
             )
+        if route is not None:
+            # Native bucketed HTTP histogram keyed by route TEMPLATE
+            # ("/index/{index}/query"), not the raw path — per-index
+            # paths would be an unbounded label cardinality.
+            self.latency.observe_http(req.method, route, elapsed * 1000.0)
 
     def _observe_slow_query(self, req: Request, elapsed: float) -> None:
         # slow-query log gated by cluster.long-query-time
@@ -785,12 +831,23 @@ class Handler:
         else:
             dl = rz.Deadline.from_header(req.header(rz.DEADLINE_HEADER))
         token = root.activate()
+        t0 = time.monotonic()
         try:
             with rz.deadline_scope(dl):
                 resp = self._handle_post_query(req, index, root)
         finally:
             root.deactivate(token)
             record = self.tracer.finish_root(root)
+            # Native per-class latency histogram + SLO accounting —
+            # measured here (not from the trace record, which a full
+            # ring may drop) so every query observes exactly once.
+            try:
+                self.latency.observe_query(
+                    str(root.tags.get("cost_class") or "unclassified"),
+                    (time.monotonic() - t0) * 1e3,
+                )
+            except Exception:  # noqa: BLE001 — metrics never drop a response
+                pass
         if record is not None:
             if in_trace:
                 # Remote leg: ship this node's spans back to the
@@ -886,14 +943,17 @@ class Handler:
         # never starve another coordinator's fan-out behind its own
         # client queue), then admit or shed 429 BEFORE the executor,
         # coalescer, or device see the query.
+        # Classified unconditionally (not only under admission): the
+        # class keys the native query-latency histogram and the SLO
+        # burn rate, which exist with or without admission gates.
+        cls = (
+            adm.CLASS_INTERNAL
+            if qreq["remote"]
+            else plan_mod.cost_class(q.calls)
+        )
+        root.annotate(cost_class=cls)
         ticket = None
         if self.admission is not None:
-            cls = (
-                adm.CLASS_INTERNAL
-                if qreq["remote"]
-                else plan_mod.cost_class(q.calls)
-            )
-            root.annotate(cost_class=cls)
             try:
                 with self.tracer.span("admission", cost_class=cls) as sp:
                     ticket = self.admission.acquire(cls)
@@ -1778,6 +1838,12 @@ class Handler:
                 snap.setdefault("gauges", {}).update(self.subscribe.gauges())
             except Exception:  # noqa: BLE001 — stats must not fail the scrape
                 pass
+        # Scrape-time launch-telemetry gauges (per-site GB/s, % of the
+        # probed stream floor) — injected like the program-cache ones.
+        try:
+            snap.setdefault("gauges", {}).update(perf_mod.registry().gauges())
+        except Exception:  # noqa: BLE001 — stats must not fail the scrape
+            pass
         body = prom.render(
             snap,
             extra_gauges={
@@ -1785,6 +1851,13 @@ class Handler:
                 "threads": threading.active_count(),
             },
         )
+        # Native histogram families (query latency per class, HTTP
+        # latency per route) + SLO gauges render their own exposition
+        # block — bucketed cumulative counters, not summaries.
+        try:
+            body += self.latency.render()
+        except Exception:  # noqa: BLE001 — stats must not fail the scrape
+            pass
         return Response(body=body.encode(), content_type=prom.CONTENT_TYPE)
 
     @staticmethod
@@ -1812,8 +1885,87 @@ class Handler:
             gauges["exec.programCache.bound"] = sum(bounds.values())
             for family, n in bounds.items():
                 gauges[f"exec.programCache.bound[cache:{family}]"] = n
+            # Cumulative compile-bearing first-call wall ms per family:
+            # how much of this process's life went to XLA compilation.
+            for family, ms in plan_mod.program_cache_compile_ms().items():
+                gauges[f"exec.programCache.compileMs[cache:{family}]"] = ms
         except Exception:  # noqa: BLE001 — stats must not fail the scrape
             pass
+
+    def handle_get_perf(self, req: Request) -> Response:
+        """The launch-telemetry roofline table (obs/perf.py): per-site
+        launches, logical bytes streamed, achieved GB/s, % of the
+        probed stream floor, p50/p99 launch ms, batch occupancy — plus
+        the slowest recent launches with their trace ids (feed one to
+        ``/debug/traces`` for the full span breakdown) and cumulative
+        per-family compile ms."""
+        snap = perf_mod.registry().snapshot()
+        try:
+            snap["compile_ms"] = plan_mod.program_cache_compile_ms()
+        except Exception:  # noqa: BLE001 — introspection must not fail
+            snap["compile_ms"] = {}
+        return Response.json(snap)
+
+    def handle_get_stacks(self, req: Request) -> Response:
+        """All thread stacks via ``sys._current_frames`` — the
+        wedge-diagnosis companion to the PR-15 launch watchdog: when a
+        device call hangs, this shows WHERE every thread is stuck
+        without attaching a debugger.  (Alias of the pprof "goroutine"
+        dump under a first-class route.)"""
+        frames = sys._current_frames()
+        out = io.StringIO()
+        out.write(f"{len(frames)} threads\n\n")
+        for t in threading.enumerate():
+            out.write(f"thread {t.name} id={t.ident} (daemon={t.daemon})\n")
+            fr = frames.get(t.ident)
+            if fr is not None:
+                out.write("".join(traceback.format_stack(fr)))
+            out.write("\n")
+        return Response(body=out.getvalue().encode(), content_type="text/plain")
+
+    def handle_get_profile(self, req: Request) -> Response:
+        """On-demand device profile: wraps ``jax.profiler.trace`` for
+        ``?seconds=N`` (clamped to 60), tars the trace directory under
+        the data dir, and returns its path.  Single-flight — a second
+        concurrent request answers 409; a runtime without the profiler
+        answers 501 (the capture is optional, the endpoint is not)."""
+        try:
+            seconds = max(0.05, min(float(req.query.get("seconds", "3")), 60.0))
+        except ValueError:
+            return Response.error("invalid seconds", 400)
+        profiler = _jax_profiler()
+        if profiler is None:
+            return Response.error("jax profiler unavailable", 501)
+        if not self._profile_mu.acquire(blocking=False):
+            return Response.error("profile already in flight", 409)
+        try:
+            base = self.profile_dir or tempfile.mkdtemp(
+                prefix="pilosa-profile-"
+            )
+            trace_dir = os.path.join(
+                base, "profiles",
+                time.strftime("trace-%Y%m%d-%H%M%S"),
+            )
+            os.makedirs(trace_dir, exist_ok=True)
+            try:
+                with profiler.trace(trace_dir):
+                    time.sleep(seconds)
+            except Exception as e:  # noqa: BLE001 — backend without xprof
+                shutil.rmtree(trace_dir, ignore_errors=True)
+                return Response.error(f"jax profiler unavailable: {e}", 501)
+            tar_path = trace_dir + ".tar.gz"
+            with tarfile.open(tar_path, "w:gz") as tf:
+                tf.add(trace_dir, arcname=os.path.basename(trace_dir))
+            shutil.rmtree(trace_dir, ignore_errors=True)
+            return Response.json(
+                {
+                    "seconds": seconds,
+                    "trace": tar_path,
+                    "bytes": os.path.getsize(tar_path),
+                }
+            )
+        finally:
+            self._profile_mu.release()
 
     def handle_get_pprof(self, req: Request, rest: str | None = None) -> Response:
         """Profiling endpoints — the Python analog of the reference's
@@ -1897,6 +2049,17 @@ class Handler:
                 self.broadcaster.send_sync(msg)
             except Exception as e:  # noqa: BLE001 — broadcast is best-effort
                 self.logger(f"broadcast error: {e}")
+
+
+def _jax_profiler():
+    """Resolve ``jax.profiler`` (None when absent or without ``trace``)
+    — separated out so the /debug/profile 501 path is testable by
+    monkeypatching."""
+    try:
+        from jax import profiler
+    except Exception:  # noqa: BLE001 — stub/absent jax
+        return None
+    return profiler if hasattr(profiler, "trace") else None
 
 
 def _consistency_arg(req: Request, header: str, param: str) -> str:

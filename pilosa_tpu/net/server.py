@@ -102,6 +102,10 @@ class Server:
         subscribe_coalesce_ms: float = 5.0,
         subscribe_refresh_ms: float = 500.0,
         admission_subscribe_concurrency: int = 4,
+        latency_buckets_ms=None,
+        slo_ms: float = 0.0,
+        slo_objective: float = 0.999,
+        floor_probe: bool = True,
     ):
         self.data_dir = data_dir
         self.host = host
@@ -274,6 +278,15 @@ class Server:
         self.subscribe_coalesce_ms = subscribe_coalesce_ms
         self.subscribe_refresh_ms = subscribe_refresh_ms
         self.subscribe = None
+        # Performance observability ([obs] latency-buckets-ms / slo-* /
+        # floor-probe, obs/perf.py + device/floorprobe.py): native
+        # fixed-bucket latency histograms + SLO burn gauges live on the
+        # Handler; the one-shot stream-floor probe runs at open() and
+        # anchors the /debug/perf roofline denominators.
+        self.latency_buckets_ms = latency_buckets_ms
+        self.slo_ms = slo_ms
+        self.slo_objective = slo_objective
+        self.floor_probe = floor_probe
         self.executor: Executor | None = None
         self.handler: Handler | None = None
         self._http = None
@@ -348,6 +361,22 @@ class Server:
             stats=self.stats,
             tracer=self.tracer,
         )
+        # One-shot stream-floor probe ([obs] floor-probe): measures
+        # per-device achievable streaming GB/s (cached process-wide AND
+        # under the data dir, so restarts and in-process multi-server
+        # tests pay it once) and anchors every %-of-floor figure the
+        # /debug/perf roofline table reports.
+        if self.floor_probe:
+            from pilosa_tpu.device import floorprobe
+            from pilosa_tpu.obs import perf as perf_mod
+
+            fp = floorprobe.probe(
+                artifact_dir=self.data_dir,
+                stats=self.stats,
+                logger=self.logger,
+            )
+            if fp is not None:
+                perf_mod.registry().set_floor(fp["mean_gbps"])
         # Cold-start elimination (see exec/warmup.py): persistent XLA
         # compile cache so restarts deserialize programs from disk, and
         # a background pre-warm of the standard query shapes so even a
@@ -444,7 +473,13 @@ class Server:
             rebalance=self.rebalance,
             tier=self.tier,
             replication=self.replication,
+            latency_buckets_ms=self.latency_buckets_ms,
+            slo_ms=self.slo_ms,
+            slo_objective=self.slo_objective,
         )
+        # Profiler captures (GET /debug/profile) tar under the data dir
+        # so the artifact survives the request and ships with backups.
+        self.handler.profile_dir = self.data_dir
         # Migration arrivals (?stage=true restores) register their HBM
         # mirrors through the background staging lane.
         self.handler.prefetcher = device_mod.prefetcher()

@@ -7,8 +7,10 @@ in the Prometheus text format (version 0.0.4), served by ``GET /metrics``:
 * counts      → ``pilosa_<name>_total`` counters
 * gauges      → ``pilosa_<name>`` gauges
 * histograms  → ``pilosa_<name>`` summaries (quantile series + ``_sum``
-  and ``_count``), quantiles straight from the snapshot's interpolated
-  percentiles
+  and ``_count``); quantiles are the snapshot's interpolated
+  percentiles over the bounded reservoir (a WINDOWED view), while
+  ``_sum``/``_count`` come from the lifetime monotonic totals so
+  ``rate()`` keeps working past 4096 observations
 * hierarchical tags (``index:i``, ``frame:f``, ``view:standard``,
   ``slice:0``) → labels; a bare tag becomes ``tag="..."``.
 
@@ -113,7 +115,18 @@ def render(snapshot: dict, extra_gauges: dict | None = None) -> str:
             if pkey in h:
                 qlabels = dict(labels, quantile=q)
                 lines.append(f"{fam}{_fmt_labels(qlabels)} {_fmt_value(h[pkey])}")
-        if "n" in h:
+        # _sum/_count must be lifetime monotonic for rate() to work;
+        # the snapshot carries them separately from the windowed
+        # reservoir ("count"/"sum" vs "n"/"mean").  Fall back to the
+        # reservoir view only for pre-upgrade snapshots.
+        if "count" in h:
+            lines.append(
+                f"{fam}_sum{_fmt_labels(labels)} {_fmt_value(h.get('sum', 0.0))}"
+            )
+            lines.append(
+                f"{fam}_count{_fmt_labels(labels)} {_fmt_value(h['count'])}"
+            )
+        elif "n" in h:
             mean = h.get("mean", 0.0)
             lines.append(
                 f"{fam}_sum{_fmt_labels(labels)} {_fmt_value(mean * h['n'])}"

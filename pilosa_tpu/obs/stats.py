@@ -77,7 +77,8 @@ class _ExpvarStore:
     rather than a dict so the lock is a named attribute the
     concurrency analyzer (pilosa_tpu/analyze) can track."""
 
-    __slots__ = ("lock", "counts", "gauges", "sets", "histograms")
+    __slots__ = ("lock", "counts", "gauges", "sets", "histograms",
+                 "hist_totals")
 
     def __init__(self):
         self.lock = threading.Lock()
@@ -85,6 +86,11 @@ class _ExpvarStore:
         self.gauges: dict = {}
         self.sets: dict = {}
         self.histograms = defaultdict(list)
+        # Lifetime monotonic [count, sum] per histogram key.  The
+        # reservoir above is bounded at 4096 samples, so anything
+        # derived from it slides; Prometheus ``rate()`` over ``_count``
+        # and ``_sum`` needs monotonic lifetime totals.
+        self.hist_totals = defaultdict(lambda: [0, 0.0])
 
 
 class ExpvarStatsClient:
@@ -124,10 +130,14 @@ class ExpvarStatsClient:
 
     def histogram(self, name: str, value: float) -> None:
         with self._store.lock:
-            h = self._store.histograms[self._key(name)]
+            key = self._key(name)
+            h = self._store.histograms[key]
             h.append(value)
-            if len(h) > 4096:  # bound memory
+            if len(h) > 4096:  # bound memory (percentiles are windowed)
                 del h[: len(h) - 4096]
+            tot = self._store.hist_totals[key]
+            tot[0] += 1
+            tot[1] += value
 
     def set(self, name: str, value: str) -> None:
         with self._store.lock:
@@ -152,7 +162,10 @@ class ExpvarStatsClient:
                 if not values:
                     continue
                 s = sorted(values)
+                tot = self._store.hist_totals.get(k)
                 hists[k] = {
+                    # Windowed view (last <=4096 samples): min/max/mean
+                    # and the percentiles.
                     "n": len(s),
                     "min": s[0],
                     "max": s[-1],
@@ -161,6 +174,10 @@ class ExpvarStatsClient:
                     "p90": _percentile(s, 0.9),
                     "p99": _percentile(s, 0.99),
                     "p999": _percentile(s, 0.999),
+                    # Lifetime monotonic totals (what _count/_sum in the
+                    # Prometheus exposition must come from).
+                    "count": tot[0] if tot else len(s),
+                    "sum": tot[1] if tot else sum(s),
                 }
             out["histograms"] = hists
             return out
@@ -193,7 +210,15 @@ class StatsDClient:
             msg += f"|#{','.join(all_tags)}"
         data = msg.encode()
         if len(data) > self.MAX_PAYLOAD:
-            data = base.encode()[: self.MAX_PAYLOAD]
+            data = base.encode()
+            if len(data) > self.MAX_PAYLOAD:
+                # Truncate on a codepoint boundary: a blind byte slice
+                # can cut a multi-byte UTF-8 sequence mid-rune, and a
+                # malformed datagram is dropped wholesale by dogstatsd.
+                cut = self.MAX_PAYLOAD
+                while cut > 0 and (data[cut] & 0xC0) == 0x80:
+                    cut -= 1
+                data = data[:cut]
         try:
             self._sock.sendto(data, self._addr)
         except OSError:

@@ -205,8 +205,11 @@ class TestStatsSatellites:
 # prometheus exposition
 # ---------------------------------------------------------------------------
 
+# Label VALUES may legally contain braces (the http latency family
+# labels routes by template, e.g. path="/index/{index}/query"), so the
+# label block matches greedily to the last "}".
 _SAMPLE_RE = re.compile(
-    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? [-+0-9.einfa]+$"
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{.*\})? [-+0-9.einfa]+$"
 )
 
 
@@ -215,7 +218,9 @@ def _assert_valid_exposition(text: str) -> None:
     for line in text.rstrip("\n").split("\n"):
         if line.startswith("# TYPE "):
             parts = line.split(" ")
-            assert parts[3] in ("counter", "gauge", "summary"), line
+            assert parts[3] in (
+                "counter", "gauge", "summary", "histogram"
+            ), line
         else:
             assert _SAMPLE_RE.match(line), f"bad exposition line: {line!r}"
 

@@ -310,6 +310,60 @@ def main() -> int:
                 file=sys.stderr,
             )
             return 1
+    # Launch telemetry (obs/perf.py): the artifact's perf block must
+    # carry per-site roofline figures — the bench drives the coalescer
+    # hard, so at minimum the coalesce site recorded launches with a
+    # positive achieved GB/s, and every reported site is self-
+    # consistent (launches >= 1, gbps > 0 whenever bytes moved).
+    perf = out.get("perf")
+    if not isinstance(perf, dict) or not isinstance(perf.get("sites"), dict):
+        print(f"FAIL: artifact missing perf block: {out}", file=sys.stderr)
+        return 1
+    sites = perf["sites"]
+    if not sites:
+        print("FAIL: perf block recorded no launch sites", file=sys.stderr)
+        return 1
+    for name, site in sites.items():
+        if site.get("launches", 0) < 1:
+            print(f"FAIL: perf site {name!r} implausible: {site}", file=sys.stderr)
+            return 1
+    if "coalesce" not in sites or sites["coalesce"].get("gbps", 0) <= 0:
+        print(
+            f"FAIL: perf block missing coalesce-site bandwidth: {sites}",
+            file=sys.stderr,
+        )
+        return 1
+    if not isinstance(perf.get("compile_ms"), dict):
+        print(f"FAIL: perf block missing compile_ms: {perf}", file=sys.stderr)
+        return 1
+    # The native histogram families must render as valid Prometheus
+    # exposition (in-process — the smoke already booted servers above;
+    # this checks the renderer directly so a grammar regression fails
+    # here, not in a user's scraper).
+    sys.path.insert(0, REPO)
+    from pilosa_tpu.obs import perf as perf_mod
+
+    lh = perf_mod.LatencyHistograms(slo_ms=50.0)
+    lh.observe_query("point", 12.0)
+    lh.observe_http("GET", "/index/{index}/query", 3.0)
+    text = lh.render()
+    types = [ln for ln in text.splitlines() if ln.startswith("# TYPE")]
+    fams = [ln.split()[2] for ln in types]
+    if len(fams) != len(set(fams)):
+        print(f"FAIL: duplicate # TYPE lines in histogram render: {fams}",
+              file=sys.stderr)
+        return 1
+    for fam in ("pilosa_query_latency_ms", "pilosa_http_latency_ms"):
+        if fam not in fams or f"{fam}_bucket{{" not in text:
+            print(f"FAIL: histogram family {fam} missing: {fams}",
+                  file=sys.stderr)
+            return 1
+        if f"{fam}_count" not in text or f"{fam}_sum" not in text:
+            print(f"FAIL: {fam} missing _count/_sum", file=sys.stderr)
+            return 1
+        if 'le="+Inf"' not in text:
+            print("FAIL: histogram missing +Inf bucket", file=sys.stderr)
+            return 1
     print(
         f"OK: metric={out['metric']} value={out['value']} {out['unit']};"
         f" coalesce launches={total['launches']}"
@@ -331,7 +385,9 @@ def main() -> int:
         f" {dg['healthy']['gcols_s']} Gcols/s, watchdog recovery"
         f" {dg['watchdog']['trip_recovery_ms']} ms;"
         f" standing {st['subscriptions']} subs, lag p99 {lag['p99']} ms,"
-        f" query-path p99 ratio {ratio}x"
+        f" query-path p99 ratio {ratio}x;"
+        f" perf sites {sorted(sites)} (coalesce"
+        f" {sites['coalesce']['gbps']} GB/s)"
     )
     return 0
 

@@ -1,0 +1,127 @@
+"""Metrics-documentation lint: every metric the code emits must be in
+the administration.md metrics reference table.
+
+Extraction is AST-based, not regex: every call of the form
+``<expr>.count(...)`` / ``.gauge`` / ``.histogram`` / ``.timing`` /
+``.set`` / ``.count_with_custom_tags`` anywhere under ``pilosa_tpu/``
+whose first argument is a string literal (or f-string) is a metric
+emission.  F-string placeholders normalize to ``*`` (so
+``f"exec.launch.gbps[site:{name}]"`` lints as
+``exec.launch.gbps[site:*]``), and tag suffixes (``name[tag:...]``)
+are stripped to the base name — the docs table documents base names
+with representative tag forms.
+
+The documentation side is every backtick-quoted token in
+``docs/administration.md``; a metric passes when its base name matches
+the base of some documented token (``*`` in either side is a
+wildcard).  Exits non-zero listing every undocumented metric —
+BLOCKING in CI (.github/workflows/check.yml) via ``make metrics-lint``.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import pathlib
+import re
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+PKG = ROOT / "pilosa_tpu"
+DOC = ROOT / "docs" / "administration.md"
+
+STATS_METHODS = {
+    "count",
+    "gauge",
+    "histogram",
+    "timing",
+    "set",
+    "count_with_custom_tags",
+}
+
+
+def _literal_name(node: ast.expr) -> str | None:
+    """First-argument string value, with f-string holes as ``*``."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append(v.value)
+            else:
+                parts.append("*")
+        return "".join(parts)
+    return None
+
+
+def emitted_metrics() -> dict[str, list[str]]:
+    """``{metric base name: [file:line, ...]}`` for every stats call."""
+    out: dict[str, list[str]] = {}
+    for path in sorted(PKG.rglob("*.py")):
+        try:
+            tree = ast.parse(path.read_text(), filename=str(path))
+        except SyntaxError as e:  # pragma: no cover — CI lint catches
+            print(f"metrics-lint: cannot parse {path}: {e}")
+            sys.exit(2)
+        for node in ast.walk(tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in STATS_METHODS
+                and node.args
+            ):
+                continue
+            name = _literal_name(node.args[0])
+            if name is None:
+                continue
+            # Not a metric: Event.set() has no args, but guard against
+            # any stray .set("...") on non-stats objects by requiring a
+            # metric-shaped name (dotted/camelCase word, optional tag
+            # suffix — matches every real emission in the tree).
+            base = name.split("[")[0]
+            if not re.fullmatch(r"[A-Za-z][\w.*]*", base):
+                continue
+            rel = path.relative_to(ROOT)
+            out.setdefault(base, []).append(f"{rel}:{node.lineno}")
+    return out
+
+
+def documented_tokens() -> set[str]:
+    """Base names of every backtick-quoted token in administration.md."""
+    text = DOC.read_text()
+    return {
+        tok.split("[")[0].split("{")[0]
+        for tok in re.findall(r"`([^`\n]+)`", text)
+    }
+
+
+def main() -> int:
+    emitted = emitted_metrics()
+    documented = documented_tokens()
+    missing = {}
+    for base, sites in emitted.items():
+        ok = any(
+            fnmatch.fnmatch(base, doc) or fnmatch.fnmatch(doc, base)
+            for doc in documented
+        )
+        if not ok:
+            missing[base] = sites
+    if missing:
+        print(
+            f"metrics-lint: {len(missing)} metric(s) emitted by the code "
+            "but absent from docs/administration.md (metrics reference "
+            "table):"
+        )
+        for base in sorted(missing):
+            print(f"  {base}  ({missing[base][0]})")
+        return 1
+    print(
+        f"metrics-lint: ok — {len(emitted)} emitted metric name(s), all "
+        "documented in docs/administration.md"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
