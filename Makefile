@@ -5,7 +5,7 @@
 
 PYTHON ?= python
 
-.PHONY: default test lint analyze typecheck metrics-lint check bench bench-smoke chaos-smoke device-chaos-smoke load-smoke resize-smoke multichip-smoke tier-smoke replication-smoke subscribe-smoke churn-soak install build docker clean generate
+.PHONY: default test lint analyze typecheck metrics-lint check bench bench-smoke chaos-smoke device-chaos-smoke load-smoke resize-smoke multichip-smoke tier-smoke replication-smoke subscribe-smoke ingest-smoke ingest-bench churn-soak install build docker clean generate
 
 default: build test
 
@@ -147,6 +147,24 @@ replication-smoke:
 # (.github/workflows/check.yml), like resize-smoke.
 subscribe-smoke:
 	$(PYTHON) tools/subscribe_smoke.py
+
+# Durable-ingest smoke (tools/ingest_smoke.py): a child process takes
+# a multi-threaded acked write storm (each ack reported only after the
+# WAL group commit fsynced) and is kill -9'd mid-storm; reopening the
+# data dir must replay the WAL tail with ZERO lost acked bits vs the
+# parent's host oracle.  CI runs it under PILOSA_LOCK_CHECK=1.
+# BLOCKING in CI (.github/workflows/check.yml), like subscribe-smoke.
+ingest-smoke:
+	$(PYTHON) tools/ingest_smoke.py
+
+# Ingest bench tier standalone (tools/ingest_bench.py): durable acked
+# write throughput with group commit on/off vs the WAL-off baseline,
+# read p99 under a 50/50 read/write storm vs read-only, and mirror
+# re-stage bytes with delta-scatter on/off.  One JSON line on stdout;
+# also runs inside make bench (bench.py "ingest" tier) and is asserted
+# by bench-smoke.
+ingest-bench:
+	$(PYTHON) tools/ingest_bench.py
 
 # Gossip churn soak (tools/churn_soak.py): 20-50 virtual members under
 # seeded datagram loss + member flapping; asserts membership converges

@@ -522,6 +522,46 @@ def main() -> None:
         except Exception as e:
             log(f"standing tier failed: {e}")
 
+    # Ingest tier (ISSUE 18): what durability costs and what delta-
+    # scatter saves — acked write throughput with group commit on/off
+    # vs the WAL-off baseline (fsyncs vs acks), read p99 under a 50/50
+    # read/write storm vs read-only, and mirror re-stage bytes with
+    # scatter on/off (tools/ingest_bench.py subprocess, CPU).
+    ingest_tier = None
+    if os.environ.get("BENCH_SKIP_INGEST_TIER") != "1":
+        import subprocess
+
+        igt = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "tools",
+            "ingest_bench.py",
+        )
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+        try:
+            out = subprocess.run(
+                [sys.executable, igt], env=env, capture_output=True,
+                timeout=900, text=True,
+            )
+            if out.returncode == 0 and out.stdout.strip():
+                for line in out.stderr.strip().splitlines():
+                    if line.startswith("[ingest]"):
+                        log(line)
+                ingest_tier = json.loads(out.stdout.strip().splitlines()[-1])
+                gw = ingest_tier["write"]["group_on"]
+                log(
+                    f"ingest tier: {gw['acks_per_s']} durable acks/s "
+                    f"({gw['fsyncs']} fsyncs / {gw['acks']} acks), "
+                    f"50/50 read p99 {ingest_tier['read']['p99_ratio']}x "
+                    "the control storm, re-stage bytes "
+                    f"{ingest_tier['restage']['bytes_ratio']}x saved by "
+                    "scatter"
+                )
+            else:
+                log(f"ingest tier failed: rc={out.returncode} "
+                    f"stderr={out.stderr.strip()[-300:]!r}")
+        except Exception as e:
+            log(f"ingest tier failed: {e}")
+
     # Mesh-scaling tier (ISSUE 12 / ROADMAP 2): the mesh-sharded data
     # plane end to end — devices-vs-Gcols/s curve at 1/2/4/8 devices,
     # the 10B-column Intersect+Count headline over the full mesh (ICI-
@@ -915,6 +955,8 @@ def main() -> None:
         out["degraded"] = degraded_tier
     if standing_tier is not None:
         out["standing"] = standing_tier
+    if ingest_tier is not None:
+        out["ingest"] = ingest_tier
     out["program_cache"] = {
         "entries": plan.program_cache_stats(),
         "bounds": plan.program_cache_bounds(),

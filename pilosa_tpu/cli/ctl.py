@@ -163,6 +163,11 @@ def build_server(cfg: config_mod.Config):
         subscribe_delta_cap=cfg.subscribe.delta_cap,
         subscribe_coalesce_ms=cfg.subscribe.coalesce_ms,
         subscribe_refresh_ms=cfg.subscribe.refresh_interval_ms,
+        ingest_wal=cfg.ingest.wal,
+        ingest_group_commit_ms=cfg.ingest.group_commit_ms,
+        ingest_group_commit_max=cfg.ingest.group_commit_max,
+        ingest_scatter=cfg.ingest.scatter,
+        ingest_wal_segment_bytes=cfg.ingest.wal_segment_bytes,
     )
 
 

@@ -47,6 +47,8 @@ import numpy as np
 
 from pilosa_tpu import device as device_mod
 from pilosa_tpu.core import cache as cache_mod
+from pilosa_tpu.ingest import scatter as ingest_scatter
+from pilosa_tpu.ingest import wal as ingest_wal
 from pilosa_tpu.core.bitmap import RowBitmap
 from pilosa_tpu.core.cache import Pair
 from pilosa_tpu.obs.stats import NopStatsClient
@@ -167,6 +169,11 @@ def unregister_write_listener(fn) -> None:
 def _notify_write(
     frag, set_rows, set_cols, clear_rows, clear_cols, exact=False
 ) -> None:
+    if frag._wal_replaying:
+        # WAL recovery re-applies writes the listeners (replication,
+        # rebalance delta log, subscriptions) already saw acked before
+        # the crash — fanning them out again would double-count.
+        return
     for fn in list(_write_listeners) + list(frag._frag_write_listeners):
         try:
             fn(frag, set_rows, set_cols, clear_rows, clear_cols, exact)
@@ -363,6 +370,11 @@ class Fragment:
         # the whole plane (SURVEY.md §7 "mutation rate vs immutable device
         # buffers").  (slot, word, mask, op) with op 1=OR / 0=ANDNOT.
         self._device_pending: list[tuple[int, int, int, int]] = []
+        # Slots with queued deltas — lets device_row() serve a row the
+        # pending writes DON'T touch straight from the resident mirror
+        # (byte-exact: every plane change since the last sync is in the
+        # queue).  Maintained strictly alongside _device_pending.
+        self._pending_slots: set[int] = set()
         self._file = None
         # Group-commit op-log buffer: point writes append 13-byte op
         # records here and fsync-free flush happens at boundaries
@@ -374,6 +386,14 @@ class Fragment:
         # the last flush boundary, never committed state.  Reads never
         # consult the file while open, so read-your-writes holds.
         self._op_buf = bytearray()
+        # Durable-ingest hooks (pilosa_tpu/ingest): a WAL writer is
+        # attached at open when an IngestManager owns this path; while
+        # attached, every changed op ALSO appends to the WAL and acks
+        # can wait on its group-commit fsync.  _wal_replaying marks
+        # crash-recovery replay (suppresses listener fanout, WAL
+        # re-logging, and mid-replay auto-snapshots).
+        self._wal = None
+        self._wal_replaying = False
         self._row_cache: dict[int, np.ndarray] = {}
         self.cache = cache_mod.new_cache(cache_type, cache_size)
         # Block checksum cache: blocks() re-hashes only blocks written
@@ -418,6 +438,11 @@ class Fragment:
                 raise
             self._version += 1
             self._opened = True
+            # Durable ingest: replay any WAL tail newer than the
+            # snapshot+op-log state just loaded, then attach a writer
+            # (no-op when no IngestManager owns this path).  Inside
+            # _mu: lock order is frag._mu -> wal locks.
+            ingest_wal.attach_fragment(self)
 
     def _open_storage(self) -> None:
         size = os.fstat(self._file.fileno()).st_size
@@ -522,6 +547,11 @@ class Fragment:
 
     def close(self) -> None:
         with self._mu:
+            if self._wal is not None:
+                # Final group commit + file close; pending waiters
+                # resolve durable (or WalClosed if the commit fails).
+                writer, self._wal = self._wal, None
+                writer._manager.detach(writer)
             if self._file is not None:
                 self._flush_ops_locked()
                 self.flush_cache()
@@ -714,7 +744,10 @@ class Fragment:
                 (needed - self._plane.shape[0], bp.WORDS_PER_SLICE), np.uint32
             )
             self._plane = np.vstack([self._plane, extra])
-            # the device mirror no longer matches the plane's shape
+            # the device mirror no longer matches the plane's shape —
+            # a structural change the delta-scatter cannot express
+            if self._device is not None:
+                ingest_scatter.note_fallback()
             self._invalidate_device()
 
     def _maybe_promote(self, row_id: int) -> None:
@@ -733,6 +766,10 @@ class Fragment:
             self._sync_sparse_pool_locked()
         slot = self._alloc_dense_slot(row_id)
         self._plane[slot] = bp.np_columns_to_row(offs)
+        # Tier promotion rewrites a whole plane row — structural, not a
+        # per-bit delta the scatter path can carry.
+        if self._device is not None:
+            ingest_scatter.note_fallback()
         self._invalidate_device()
 
     def _load_direct(self, mm) -> int:
@@ -1214,6 +1251,7 @@ class Fragment:
         self._device = None
         self._device_version = -1
         self._device_pending.clear()
+        self._pending_slots.clear()
         device_mod.pool().remove(self._pool_key)
 
     def _pool_info(self) -> dict:
@@ -1238,6 +1276,7 @@ class Fragment:
             self._device = None
             self._device_version = -1
             self._device_pending.clear()
+            self._pending_slots.clear()
             return True
         finally:
             self._mu.release()
@@ -1289,10 +1328,20 @@ class Fragment:
             pool = device_mod.pool()
             if self._device is not None and self._device_version != self._version:
                 if self._device_pending:
-                    self._device = _apply_pending(
-                        self._device, self._device_pending
-                    )
+                    # Incremental mirror maintenance: ONE fused scatter
+                    # launch applies the queued deltas (ingest/scatter:
+                    # pow2-bucketed update axis, no donation).  The pin
+                    # lease keeps the pool from evicting the mirror
+                    # between gather and scatter; publication is the
+                    # plain attribute swap below, so a concurrent
+                    # reader holding the OLD array sees a consistent
+                    # (old) plane — version-fenced atomicity.
+                    with pool.pinned(self._pool_key):
+                        self._device = ingest_scatter.apply(
+                            self._device, self._device_pending
+                        )
                     self._device_pending.clear()
+                    self._pending_slots.clear()
                     self._device_version = self._version
                 else:
                     self._device = None
@@ -1310,7 +1359,9 @@ class Fragment:
                 except BaseException:
                     pool.remove(self._pool_key)
                     raise
+                pool.count_restage(int(self._plane.nbytes))
                 self._device_pending.clear()
+                self._pending_slots.clear()
                 self._device_version = self._version
             else:
                 pool.touch(self._pool_key)
@@ -1335,6 +1386,21 @@ class Fragment:
         with self._mu:
             slot = self._slot_of.get(row_id)
             if slot is not None:
+                dev = self._device
+                if (
+                    dev is not None
+                    and self._device_version != self._version
+                    and slot not in self._pending_slots
+                ):
+                    # Row-level freshness: the mirror is stale only
+                    # where queued deltas touch, and this row isn't
+                    # among them (a change the queue can't express
+                    # drops the mirror entirely), so the resident
+                    # plane's row is byte-exact as-is.  Serving it
+                    # directly keeps an ingest storm on OTHER rows from
+                    # forcing a whole-plane sync onto every read.
+                    device_mod.pool().touch(self._pool_key)
+                    return dev[slot]
                 return self.device_plane()[slot]
             offs = self._sparse.get(row_id)
             if offs is None:
@@ -1433,15 +1499,86 @@ class Fragment:
         return True
 
     def _queue_device_update(self, slot: int, offset: int, op: int) -> None:
-        """Record a point write for the device mirror; overflow degrades
-        to a full re-upload on next read."""
+        """Record a point write for the device mirror; overflow (or
+        scatter disabled by config) degrades to a full re-upload on
+        next read."""
         if self._device is None:
             return
+        if not ingest_scatter.ENABLED:
+            # Historical behavior: every point write invalidates the
+            # mirror (and the next read re-stages the whole plane) —
+            # kept as the config-off arm and the bench contrast.
+            ingest_scatter.note_fallback()
+            self._invalidate_device()
+            return
         if len(self._device_pending) >= self._MAX_DEVICE_PENDING:
+            ingest_scatter.note_fallback()
             self._invalidate_device()
             return
         word, shift = divmod(offset, bp.WORD_BITS)
         self._device_pending.append((slot, word, 1 << shift, op))
+        self._pending_slots.add(slot)
+
+    def apply_pending_scatter(self) -> bool:
+        """Fold queued point-write deltas into the device mirror NOW
+        (one fused scatter launch) instead of at the next read.  The
+        ingest committer calls this on its group-commit tick, so a read
+        storm usually finds the mirror already clean and pays nothing.
+        No-op unless a mirror is resident with queued deltas; returns
+        True when a launch was dispatched."""
+        with self._mu:
+            if (
+                self._device is None
+                or self._device_version == self._version
+                or not self._device_pending
+            ):
+                return False
+            pool = device_mod.pool()
+            with pool.pinned(self._pool_key):
+                self._device = ingest_scatter.apply(
+                    self._device, self._device_pending
+                )
+            self._device_pending.clear()
+            self._pending_slots.clear()
+            self._device_version = self._version
+            pool.touch(self._pool_key)
+            return True
+
+    def _queue_import_updates_locked(
+        self, set_slots, set_offs, clr_slots, clr_offs
+    ) -> None:
+        """Queue a bulk import's dense-plane bits as scatter deltas when
+        the import is small enough; otherwise fall back to full mirror
+        invalidation (one re-upload beats thousands of folded updates,
+        and sparse-tier bits never touch the mirror anyway)."""
+        n = (0 if set_slots is None else len(set_slots)) + (
+            0 if clr_slots is None else len(clr_slots)
+        )
+        if (
+            self._device is None
+            or not ingest_scatter.ENABLED
+            or n == 0
+            or n > ingest_scatter.IMPORT_SCATTER_MAX
+            or len(self._device_pending) + n > self._MAX_DEVICE_PENDING
+        ):
+            if self._device is not None:
+                ingest_scatter.note_fallback()
+            self._invalidate_device()
+            return
+        for slots, offs_a, op in (
+            (set_slots, set_offs, 1),
+            (clr_slots, clr_offs, 0),
+        ):
+            if slots is None:
+                continue
+            words, shifts = np.divmod(
+                np.asarray(offs_a, dtype=np.int64), bp.WORD_BITS
+            )
+            for slot, word, shift in zip(slots, words, shifts):
+                self._device_pending.append(
+                    (int(slot), int(word), 1 << int(shift), op)
+                )
+                self._pending_slots.add(int(slot))
 
     def _after_write(self, row_id: int, delta: int) -> None:
         self._version += 1
@@ -1453,7 +1590,9 @@ class Fragment:
         n = self._count_of[row_id] = self._count_of.get(row_id, 0) + delta
         self.cache.add(row_id, n)
         self._op_n += 1
-        if self._op_n >= self.max_op_n:
+        if self._op_n >= self.max_op_n and not self._wal_replaying:
+            # Mid-replay snapshots would truncate the WAL segment being
+            # replayed; recovery checkpoints once, after the replay.
             self.snapshot()
 
     # Flush the op buffer once it holds this many bytes (~5k ops) even
@@ -1465,6 +1604,16 @@ class Fragment:
             self._op_buf += roaring.encode_op(typ, pos)
             if len(self._op_buf) >= self._OP_FLUSH_BYTES:
                 self._flush_ops_locked()
+        if self._wal is not None and not self._wal_replaying:
+            # Log-before-ack: the same changed-op record goes to the
+            # WAL; the ack path waits on its group-commit fsync
+            # (executor wait_durable).  During recovery replay the op
+            # is already IN the WAL.  A shutdown race (writer closed
+            # under us) degrades to the historical op-buf durability.
+            try:
+                self._wal.log(typ, pos)
+            except ingest_wal.WalClosed:
+                pass
 
     def _flush_ops_locked(self) -> None:
         if self._op_buf and self._file is not None:
@@ -1531,10 +1680,12 @@ class Fragment:
             )
             slots_all = slot_table[np.searchsorted(uniq, rows)]
             dense_mask = slots_all >= 0
+            imp_set_slots = imp_set_offs = None
+            imp_clr_slots = imp_clr_offs = None
             if dense_mask.any():
-                bp.np_set_bulk(
-                    self._plane, slots_all[dense_mask], offs[dense_mask]
-                )
+                imp_set_slots = slots_all[dense_mask]
+                imp_set_offs = offs[dense_mask]
+                bp.np_set_bulk(self._plane, imp_set_slots, imp_set_offs)
             if not dense_mask.all():
                 s_rows = rows[~dense_mask]
                 s_offs = offs[~dense_mask].astype(np.uint32)
@@ -1586,7 +1737,9 @@ class Fragment:
                 )
                 dm = c_slots >= 0
                 if dm.any():
-                    bp.np_clear_bulk(self._plane, c_slots[dm], c_offs[dm])
+                    imp_clr_slots = c_slots[dm]
+                    imp_clr_offs = c_offs[dm]
+                    bp.np_clear_bulk(self._plane, imp_clr_slots, imp_clr_offs)
                 if (~dm).any():
                     s_rows = c_rows[~dm]
                     s_offs = c_offs[~dm].astype(np.uint32)
@@ -1598,7 +1751,9 @@ class Fragment:
 
             self._version += 1
             _bump_write_epoch()
-            self._invalidate_device()
+            self._queue_import_updates_locked(
+                imp_set_slots, imp_set_offs, imp_clr_slots, imp_clr_offs
+            )
             self._sparse_dev.clear()
             self._sync_sparse_pool_locked()
             self._row_cache.clear()
@@ -1638,13 +1793,27 @@ class Fragment:
             tmp = self.path + ".snapshotting"
             with open(tmp, "wb") as fh:
                 fh.write(data)
+                fh.flush()
+                os.fsync(fh.fileno())
             if self._file is not None:
                 fcntl.flock(self._file.fileno(), fcntl.LOCK_UN)
                 self._file.close()
             os.replace(tmp, self.path)
+            # The rename is durable only once the DIRECTORY entry is
+            # synced — without this, a crash after the replace can
+            # resurrect the pre-snapshot file (with its now-truncated
+            # WAL gone), silently losing the snapshot.
+            ingest_wal._fsync_dir(self.path)
             self._file = open(self.path, "a+b")
             fcntl.flock(self._file.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
             self._op_n = 0
+            if self._wal is not None:
+                # Every op the WAL covers is captured by the (now
+                # durable) snapshot: restart the segment at the new
+                # base version.  len(data) is the fresh file's op
+                # region offset, identifying WHICH snapshot this
+                # segment was truncated against.
+                self._wal.truncate_segment(len(data))
             # reference: fragment.go:1026-1030
             self.stats.histogram("snapshot", time.perf_counter() - t0)
 
@@ -2280,15 +2449,25 @@ class Fragment:
                 self._row_cache.clear()
                 self._op_n = 0
                 self._op_buf.clear()  # replaced wholesale below
-                # persist
+                # persist (same durability discipline as snapshot():
+                # file fsync before the atomic rename, directory fsync
+                # after — a crash must never resurrect the pre-restore
+                # file once the restore was acked)
                 with open(self.path + ".snapshotting", "wb") as fh:
                     fh.write(payload)
+                    fh.flush()
+                    os.fsync(fh.fileno())
                 if self._file is not None:
                     fcntl.flock(self._file.fileno(), fcntl.LOCK_UN)
                     self._file.close()
                 os.replace(self.path + ".snapshotting", self.path)
+                ingest_wal._fsync_dir(self.path)
                 self._file = open(self.path, "a+b")
                 fcntl.flock(self._file.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
+                if self._wal is not None:
+                    # Restored content replaces everything the segment
+                    # described: restart it against the new snapshot.
+                    self._wal.truncate_segment(len(payload))
             cache_payload = payloads.get("cache")
             if cache_payload is not None:
                 ids = self._decode_cache_ids(cache_payload)

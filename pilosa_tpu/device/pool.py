@@ -106,6 +106,12 @@ class PlanePool:
         self._stage_errors = 0
         self._stage_bytes = 0
         self._stage_last_error: str | None = None
+        # Full mirror (re)uploads through Fragment.device_plane — the
+        # cost the ingest delta-scatter exists to avoid.  Bytes, not
+        # counts: a write storm that invalidates per-bit shows up as
+        # plane_nbytes x writes here, vs one upload with scatter on.
+        self._restage_uploads = 0
+        self._restage_bytes = 0
         # 0 = auto (env -> detect -> unbounded); > 0 = explicit bytes.
         self._budget = int(budget_bytes or 0)
         self._detected: int | None = None
@@ -470,6 +476,20 @@ class PlanePool:
         if nbytes:
             self.stats.count("device.stage.bytes", nbytes)
 
+    def count_restage(self, nbytes: int) -> None:
+        """One full plane upload through ``Fragment.device_plane`` (the
+        ``device.pool.restageBytes`` counter the ingest bench contrasts
+        against scatter launches)."""
+        with self._mu:
+            self._restage_uploads += 1
+            self._restage_bytes += int(nbytes)
+        if nbytes:
+            self.stats.count("device.pool.restageBytes", int(nbytes))
+
+    def restage_bytes(self) -> int:
+        with self._mu:
+            return self._restage_bytes
+
     # ------------------------------------------------------------------
     # introspection
     # ------------------------------------------------------------------
@@ -546,6 +566,8 @@ class PlanePool:
                     "overBudget": self._over_budget,
                     "prefetchHit": self._prefetch_hits,
                     "prefetchMiss": self._prefetch_misses,
+                    "restageUploads": self._restage_uploads,
+                    "restageBytes": self._restage_bytes,
                 },
                 # Cold-staging progress for rolling restarts: a
                 # restarted node serves while this drains toward

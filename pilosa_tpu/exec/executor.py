@@ -322,6 +322,12 @@ class Executor:
         # mirrors re-materialize concurrently while planning proceeds.
         # None = disabled (bare library use stays fully deterministic).
         self.prefetcher = prefetcher
+        # Durable-ingest manager (pilosa_tpu/ingest): when wired (Server
+        # does, gated on [ingest] wal), point-write acks block on the
+        # WAL group commit — the write returns only after its op record
+        # is fsynced (or captured by a completed snapshot).  None =
+        # the historical op-buf durability (bare library use).
+        self.ingest = None
         # Cross-query coalescing scheduler (exec/coalesce.py): when
         # wired (Server does, gated on [exec] coalesce), concurrent
         # queries sharing a compile key ride ONE fused launch.  The
@@ -2834,20 +2840,36 @@ class Executor:
             except ValueError:
                 raise ExecutorError(f"invalid date: {ts}") from None
 
-        return self._write_views(
+        ret = self._write_views(
             index, c, opt, view, f,
             lambda vw, r, cl: f.set_bit(vw, r, cl, timestamp),
             row_id, col_id,
         )
+        self._wait_durable(index)
+        return ret
 
     def _execute_clear_bit(self, index: str, c: Call, opt: ExecOptions) -> bool:
         view = c.args.get("view", "") or ""
         f, row_id, col_id = self._resolve_write(index, c, "ClearBit")
-        return self._write_views(
+        ret = self._write_views(
             index, c, opt, view, f,
             lambda vw, r, cl: f.clear_bit(vw, r, cl),
             row_id, col_id,
         )
+        self._wait_durable(index)
+        return ret
+
+    def _wait_durable(self, index: str) -> None:
+        """Log-before-ack: park until every WAL append THIS thread made
+        while applying the write is group-commit fsynced.  Runs OUTSIDE
+        every fragment lock — a slow fsync stalls only this writer's
+        ack, never a concurrent reader — and covers both the
+        coordinator-local leg and remote legs (each remote node's own
+        executor waits before responding)."""
+        if self.ingest is None:
+            return
+        with self.tracer.span("ingest", index=index):
+            self.ingest.wait_durable()
 
     def _write_views(
         self, index, c, opt, view, frame, write_fn, row_id, col_id

@@ -811,9 +811,37 @@ def _build_batched(expr: tuple, reduce: str):
     return jax.jit(jax.vmap(_make_fn(expr, reduce)))
 
 
+def _build_scatter():
+    """Delta-scatter: apply n (slot, word, or-mask, andnot-mask) updates
+    to a resident device plane as ONE fused gather/modify/scatter.  The
+    update axis leads so the program-cache bucket gauges see the
+    pow2-bucketed update count (callers pad to :func:`pilosa_tpu.ops.
+    bitplane.pow2_bucket` by REPEATING the last real entry — duplicate
+    indices then write identical values, which XLA scatter handles
+    deterministically).  No buffer donation: a concurrent reader may
+    still hold the old plane, which is exactly how the fragment's
+    version fence gives readers old-or-new atomicity."""
+
+    def fn(slots, words, or_m, andnot_m, plane):
+        cur = plane[slots, words]
+        return plane.at[slots, words].set((cur & ~andnot_m) | or_m)
+
+    return jax.jit(fn)
+
+
 _compiled_batched = _ProgramCache(_build_batched, "plan.batched")
 _compiled_total_count = _ProgramCache(_build_total_count, "plan.totalCount")
 _compiled_interp = _ProgramCache(_build_interp, "interp")
+_compiled_scatter = _ProgramCache(_build_scatter, "plan.scatter", maxsize=1)
+
+
+def scatter_apply(plane, slots, words, or_m, andnot_m):
+    """Dispatch one fused delta-scatter launch (update axis bucketed by
+    the caller); returns the NEW plane array, old left intact."""
+    # The jit cache also keys on the plane's (pow2-classed) row count;
+    # track its highwater so program_cache_bounds stays an invariant.
+    _note_bucket("plan.scatter.rows", int(plane.shape[0]))
+    return _compiled_scatter()(slots, words, or_m, andnot_m, plane)
 
 
 # ---------------------------------------------------------------------------
@@ -877,6 +905,9 @@ def program_cache_stats() -> dict[str, int]:
         "interp": sum(
             _jit_cache_size(p.fn) for p in _compiled_interp.programs()
         ),
+        "plan.scatter": sum(
+            _jit_cache_size(p.fn) for p in _compiled_scatter.programs()
+        ),
         "bitplane.scorePlanes": (
             _jit_cache_size(bp._score_planes_self_src)
             + _jit_cache_size(bp._score_planes_host_src)
@@ -886,6 +917,13 @@ def program_cache_stats() -> dict[str, int]:
     }
     out["total"] = sum(out.values())
     return out
+
+
+def _scatter_floor() -> int:
+    # Lazy: ingest.scatter imports this module inside apply().
+    from pilosa_tpu.ingest import scatter as ingest_scatter
+
+    return ingest_scatter.UPDATE_BUCKET_FLOOR
 
 
 def program_cache_bounds() -> dict[str, int]:
@@ -927,6 +965,21 @@ def program_cache_bounds() -> dict[str, int]:
             )
             * bp.bucket_classes(max(_INTERP_HIGHWATER.get("outs", 1), 1))
         ),
+        # one wrapper x update-count bucket classes (floor
+        # ingest.scatter.UPDATE_BUCKET_FLOOR) x plane-row shape classes
+        # (planes pad rows to pow2, floor ROW_BLOCK; the word axis is
+        # uniform, so it contributes no classes)
+        "plan.scatter": (
+            _compiled_scatter.cache_info().currsize
+            * bp.bucket_classes(
+                max(_BUCKET_HIGHWATER.get("plan.scatter", _scatter_floor()),
+                    _scatter_floor()),
+                _scatter_floor(),
+            )
+            * bp.bucket_classes(
+                max(_BUCKET_HIGHWATER.get("plan.scatter.rows", rb), rb), rb
+            )
+        ),
         # (self-src + host-src) x fragment-group classes x plane-row
         # classes x candidate-slot classes
         "bitplane.scorePlanes": (
@@ -956,6 +1009,7 @@ def clear_program_caches() -> None:
     _compiled_batched.cache_clear()
     _compiled_total_count.cache_clear()
     _compiled_interp.cache_clear()
+    _compiled_scatter.cache_clear()
     _BUCKET_HIGHWATER.clear()
     _INTERP_HIGHWATER.clear()
     _COMPILE_MS.clear()

@@ -1,0 +1,30 @@
+"""Durable ingest — the write-optimized half of the engine (ISSUE 18).
+
+Three coupled pieces:
+
+- :mod:`pilosa_tpu.ingest.wal` — per-fragment write-ahead log of
+  sha256-framed op records with a group-commit committer thread
+  (ARIES-style log-before-data; System R-era commit batching).  Acks
+  return only after the record is durable.
+- :mod:`pilosa_tpu.ingest.recovery` — at fragment open, replay WAL
+  records newer than the snapshot's op-version (checksum-verified,
+  torn-tail tolerated) and stamp replicate/versions so quorum
+  accounting stays consistent after a ``kill -9``.
+- :mod:`pilosa_tpu.ingest.scatter` — incremental HBM-mirror
+  maintenance: queued point-write deltas apply as ONE tiny fused
+  jitted scatter launch (pow2-bucketed update count) instead of
+  invalidating and re-staging the whole plane.
+
+None of these modules import :mod:`pilosa_tpu.core.fragment` at module
+scope — the fragment module imports this package for its write hooks,
+so the dependency edge must stay one-way at import time.
+"""
+
+from pilosa_tpu.ingest import scatter  # noqa: F401 — re-export
+from pilosa_tpu.ingest.wal import (  # noqa: F401 — re-export
+    IngestManager,
+    WalClosed,
+    WalWriter,
+    attach_fragment,
+    load_segment,
+)

@@ -1,0 +1,155 @@
+"""Device delta-scatter: incremental HBM-mirror maintenance.
+
+The seed design invalidated the device mirror on every point write,
+forcing a multi-MB plane re-stage on the next read — fatal under the
+sustained write streams replication and standing queries now invite.
+With scatter enabled, a fragment instead queues its point-write deltas
+(slot, word, set/clear mask) and :func:`apply` folds them into unique
+(slot, word, or-mask, andnot-mask) updates applied to the resident
+plane as ONE tiny fused jitted launch (:func:`pilosa_tpu.exec.plan.
+scatter_apply`).  The update count is pow2-bucketed — padding repeats
+the LAST real entry so duplicate scatter indices write identical
+values (deterministic) — keeping the ``plan.scatter`` program cache
+bounded by the bucket grid.  The launch rides the PlanePool pin lease
+(caller pins the mirror key) and the collective launch discipline.
+
+``_invalidate_device()`` remains the fallback for structural changes:
+row growth past the padded plane shape, ``import_bulk`` above
+:data:`IMPORT_SCATTER_MAX` queued updates, or scatter disabled by
+config.  Module-level counters feed ``exec.scatter.*`` metrics and the
+``/debug/ingest`` document.
+
+This module must not import :mod:`pilosa_tpu.core.fragment` at module
+scope (the fragment module imports this package).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from pilosa_tpu.ops import bitplane as bp
+
+# Flipped by Server from ``[ingest] scatter``; module-level so fragments
+# see the setting without per-fragment plumbing.  Off restores the
+# historical invalidate-on-write behavior (and gives the ingest bench
+# its re-stage contrast arm).
+ENABLED = True
+
+# import_bulk queues per-bit scatter updates only below this count;
+# past it, a bulk import re-stages the whole plane (one upload beats
+# tens of thousands of folded updates).
+IMPORT_SCATTER_MAX = 4096
+
+# Floor of the pow2 update-count bucket grid.  Point-write batches are
+# almost always tiny (a group-commit tick's worth of deltas), and each
+# DISTINCT bucket pays a one-time XLA compile (~tens of ms) while the
+# committer holds the fragment lock — a read-tail cliff.  Padding every
+# small batch up to one shared bucket trades a few dozen no-op scatter
+# lanes (microseconds) for hitting a warm program on every apply.
+UPDATE_BUCKET_FLOOR = 32
+
+_mu = threading.Lock()
+_launches = 0
+_updates_applied = 0
+_fallback_invalidations = 0
+
+
+def fold(pending) -> tuple:
+    """Fold a [(slot, word, mask, op)] queue into unique per-word
+    (slots, words, or_masks, andnot_masks) arrays, later ops winning
+    per bit — the same cancellation rule the host-side pending fold
+    has always used (set clears the bit from the andnot mask and vice
+    versa)."""
+    acc: dict[tuple[int, int], list[int]] = {}
+    for slot, word, mask, op in pending:
+        cell = acc.setdefault((slot, word), [0, 0])
+        if op:
+            cell[0] |= mask
+            cell[1] &= ~mask & 0xFFFFFFFF
+        else:
+            cell[1] |= mask
+            cell[0] &= ~mask & 0xFFFFFFFF
+    n = len(acc)
+    slots = np.empty(n, dtype=np.int32)
+    words = np.empty(n, dtype=np.int32)
+    or_m = np.empty(n, dtype=np.uint32)
+    andnot_m = np.empty(n, dtype=np.uint32)
+    for i, ((slot, word), (s, c)) in enumerate(acc.items()):
+        slots[i] = slot
+        words[i] = word
+        or_m[i] = s
+        andnot_m[i] = c
+    return slots, words, or_m, andnot_m
+
+
+def _pad_to_bucket(slots, words, or_m, andnot_m):
+    """Pad the update axis to its pow2 bucket by REPEATING the last
+    real entry: duplicate indices then scatter identical values, which
+    is deterministic regardless of XLA's duplicate-index ordering."""
+    n = len(slots)
+    b = bp.pow2_bucket(n, UPDATE_BUCKET_FLOOR)
+    if b == n:
+        return slots, words, or_m, andnot_m
+    pad = b - n
+    return (
+        np.concatenate([slots, np.repeat(slots[-1:], pad)]),
+        np.concatenate([words, np.repeat(words[-1:], pad)]),
+        np.concatenate([or_m, np.repeat(or_m[-1:], pad)]),
+        np.concatenate([andnot_m, np.repeat(andnot_m[-1:], pad)]),
+    )
+
+
+def apply(dev, pending):
+    """Apply a pending delta queue to device plane ``dev`` in one fused
+    scatter launch; returns the NEW plane array (old left intact for
+    concurrent readers).  Caller holds the fragment lock and the
+    PlanePool pin lease for the mirror key, and ``pending`` is
+    non-empty."""
+    global _launches, _updates_applied
+    from pilosa_tpu.exec import plan
+
+    slots, words, or_m, andnot_m = _pad_to_bucket(*fold(pending))
+    with plan.collective_launch():
+        out = plan.scatter_apply(dev, slots, words, or_m, andnot_m)
+    with _mu:
+        _launches += 1
+        _updates_applied += len(pending)
+    return out
+
+
+def note_fallback(n: int = 1) -> None:
+    """Record a structural-change fallback to full mirror invalidation
+    (feeds ``exec.scatter.fallbackInvalidations``)."""
+    global _fallback_invalidations
+    with _mu:
+        _fallback_invalidations += n
+
+
+def counters() -> dict:
+    with _mu:
+        return {
+            "launches": _launches,
+            "updatesApplied": _updates_applied,
+            "fallbackInvalidations": _fallback_invalidations,
+        }
+
+
+def publish_stats(stats) -> None:
+    """Push the module counters as gauges (called from the server's
+    stats loop alongside the other exec gauges)."""
+    c = counters()
+    stats.gauge("exec.scatter.launches", float(c["launches"]))
+    stats.gauge("exec.scatter.updatesApplied", float(c["updatesApplied"]))
+    stats.gauge(
+        "exec.scatter.fallbackInvalidations",
+        float(c["fallbackInvalidations"]),
+    )
+
+
+def reset_counters() -> None:
+    """Test isolation."""
+    global _launches, _updates_applied, _fallback_invalidations
+    with _mu:
+        _launches = _updates_applied = _fallback_invalidations = 0

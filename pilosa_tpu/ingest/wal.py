@@ -1,0 +1,707 @@
+"""Per-fragment write-ahead log with group commit.
+
+Durability discipline (ARIES-style log-before-data): every changed bit
+is appended to a per-fragment WAL *before* the write is acknowledged,
+and the ack blocks only on the WAL fsync — never on the fragment's
+snapshot cycle.  Concurrent writers are batched into one fsync by a
+dedicated committer thread per holder (System R-era group commit): a
+writer parks on a shared :class:`concurrent.futures.Future`, the
+committer lingers for the ``[ingest] group-commit-ms`` window (or until
+``group-commit-max`` ops are pending), seals the buffered ops into one
+checksummed frame, fsyncs once, and resolves the future for every
+waiter at once.
+
+Segment layout (``<fragment-path>.wal``)::
+
+    header   "<4sIQQ"  magic=b"PWAL"  version=1  base_op_version  snap_size
+    frame*   "<IIQ"    payload_len  n_ops  end_op_version
+             payload   n_ops x 13-byte roaring op records
+             digest    sha256(frame_header + payload), 32 bytes
+
+``base_op_version`` is the fragment's logical op-version at the last
+truncating snapshot; a frame's ``end_op_version`` is the version after
+its last op, so replay can skip frames already covered by the snapshot.
+``snap_size`` records the data file's op-region offset at truncation —
+if the snapshot changed while the WAL was detached, the stale segment
+is discarded rather than replayed against the wrong base.  The sha256
+framing mirrors the PR-13 tar self-verification: a torn tail (partial
+frame from a crash mid-append) fails its digest and replay stops at the
+first bad frame, exactly the set of ops that were never acked.
+
+Lock order (enforced by pilosa_tpu/analyze): ``frag._mu`` →
+``WalWriter._io_mu`` → ``WalWriter._mu``.  The hot path
+(:meth:`WalWriter.log`, called under ``frag._mu``) takes only ``_mu``
+and never blocks on I/O; the committer takes ``_io_mu`` for the fsync
+and ``_mu`` only for the buffer swap, so an in-flight fsync never
+stalls a writer's append.
+
+This module must not import :mod:`pilosa_tpu.core.fragment` at module
+scope (the fragment module imports this package).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import struct
+import threading
+import time
+from concurrent.futures import Future
+
+from pilosa_tpu.obs.stats import NopStatsClient
+from pilosa_tpu.ops import roaring
+
+MAGIC = b"PWAL"
+FORMAT_VERSION = 1
+
+_HEADER = struct.Struct("<4sIQQ")  # magic, version, base_op_version, snap_size
+_FRAME = struct.Struct("<IIQ")  # payload_len, n_ops, end_op_version
+HEADER_SIZE = _HEADER.size
+FRAME_HEADER_SIZE = _FRAME.size
+DIGEST_SIZE = 32
+
+# A frame payload is a run of fixed-size roaring op records; cap it so a
+# corrupt length field can't allocate unbounded memory during replay.
+MAX_FRAME_OPS = 1 << 20
+MAX_FRAME_PAYLOAD = MAX_FRAME_OPS * roaring.OP_SIZE
+
+
+class WalClosed(RuntimeError):
+    """The WAL (or its manager) was closed while a write waited on it."""
+
+
+def wal_path(fragment_path: str) -> str:
+    return fragment_path + ".wal"
+
+
+def encode_header(base_op_version: int, snap_size: int) -> bytes:
+    return _HEADER.pack(MAGIC, FORMAT_VERSION, base_op_version, snap_size)
+
+
+def encode_frame(payload: bytes, n_ops: int, end_op_version: int) -> bytes:
+    hdr = _FRAME.pack(len(payload), n_ops, end_op_version)
+    digest = hashlib.sha256(hdr + payload).digest()
+    return hdr + payload + digest
+
+
+class Segment:
+    """A decoded WAL segment: the verified prefix of one ``.wal`` file."""
+
+    __slots__ = ("base_op_version", "snap_size", "frames", "torn",
+                 "good_bytes", "problem")
+
+    def __init__(self, base_op_version: int = 0, snap_size: int = 0):
+        self.base_op_version = base_op_version
+        self.snap_size = snap_size
+        # [(end_op_version, n_ops, payload bytes)] in append order.
+        self.frames: list[tuple[int, int, bytes]] = []
+        self.torn = False
+        self.good_bytes = HEADER_SIZE
+        self.problem: str | None = None
+
+    @property
+    def n_ops(self) -> int:
+        return sum(n for _, n, _ in self.frames)
+
+    @property
+    def end_op_version(self) -> int:
+        if self.frames:
+            return self.frames[-1][0]
+        return self.base_op_version
+
+
+def load_segment(path: str) -> Segment | None:
+    """Decode the WAL at ``path``; ``None`` if absent or header-corrupt.
+
+    Tolerates a torn tail: decoding stops at the first frame whose
+    length, digest, or op records fail verification (``seg.torn`` set,
+    ``seg.good_bytes`` marks the durable prefix).  A header that does
+    not verify means nothing in the file can be trusted — the caller
+    should discard the segment entirely.
+    """
+    try:
+        with open(path, "rb") as fh:
+            data = fh.read()
+    except FileNotFoundError:
+        return None
+    if len(data) < HEADER_SIZE:
+        return None
+    magic, version, base, snap_size = _HEADER.unpack_from(data, 0)
+    if magic != MAGIC or version != FORMAT_VERSION:
+        return None
+    seg = Segment(base, snap_size)
+    pos = HEADER_SIZE
+    expect_version = base
+    while pos < len(data):
+        if pos + FRAME_HEADER_SIZE > len(data):
+            seg.torn = True
+            seg.problem = "torn frame header"
+            break
+        payload_len, n_ops, end_version = _FRAME.unpack_from(data, pos)
+        if (payload_len > MAX_FRAME_PAYLOAD
+                or payload_len != n_ops * roaring.OP_SIZE
+                or n_ops == 0
+                or end_version != expect_version + n_ops):
+            seg.torn = True
+            seg.problem = "bad frame header"
+            break
+        frame_end = pos + FRAME_HEADER_SIZE + payload_len + DIGEST_SIZE
+        if frame_end > len(data):
+            seg.torn = True
+            seg.problem = "torn frame"
+            break
+        payload = data[pos + FRAME_HEADER_SIZE:frame_end - DIGEST_SIZE]
+        digest = data[frame_end - DIGEST_SIZE:frame_end]
+        want = hashlib.sha256(
+            data[pos:pos + FRAME_HEADER_SIZE] + payload
+        ).digest()
+        if digest != want:
+            seg.torn = True
+            seg.problem = "frame checksum mismatch"
+            break
+        # The payload is raw roaring op records; verify each record's
+        # own FNV checksum too so a bit-flip inside a frame that
+        # somehow passes sha256 (or a hand-edited file) still rejects.
+        ok = True
+        for off in range(0, payload_len, roaring.OP_SIZE):
+            _, _, problem = roaring._read_op(payload, off)
+            if problem is not None:
+                seg.torn = True
+                seg.problem = f"op record: {problem}"
+                ok = False
+                break
+        if not ok:
+            break
+        seg.frames.append((end_version, n_ops, payload))
+        expect_version = end_version
+        pos = frame_end
+        seg.good_bytes = pos
+    return seg
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync the directory containing ``path`` so renames/creates in it
+    survive a crash (POSIX makes the entry durable only after the
+    *directory* is synced, not the file)."""
+    d = os.path.dirname(path) or "."
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+class WalWriter:
+    """One fragment's WAL segment: lock-free-of-I/O append + group commit.
+
+    ``log()`` runs under ``frag._mu`` on the write hot path and only
+    buffers; the manager's committer thread calls ``commit()`` which
+    does the actual frame write + fsync.  ``truncate_segment()`` is
+    called by the fragment's snapshot path (under ``frag._mu``, after
+    the snapshot and its directory entry are themselves fsynced) and
+    resets the segment to empty with a new base version.
+    """
+
+    def __init__(self, frag, path: str, base_op_version: int,
+                 snap_size: int, manager: "IngestManager",
+                 *, fresh: bool):
+        self.frag = frag
+        self.path = path
+        self._manager = manager
+        self.stats = manager.stats
+        # Lock order: frag._mu -> _io_mu -> _mu.  _mu guards the
+        # buffered (not yet durable) state; _io_mu serializes file
+        # writes/fsyncs/truncations so commit never holds _mu across
+        # I/O.
+        self._io_mu = threading.Lock()
+        self._mu = threading.Lock()
+        self._buf = bytearray()
+        self._buf_ops = 0
+        self._op_version = base_op_version
+        self._base = base_op_version
+        self._snap_size = snap_size
+        self._pending: Future | None = None
+        self._closed = False
+        self._wal_bytes = HEADER_SIZE
+        self._last_fsync_ms = 0.0
+        self._last_group = 0
+        self._appends = 0
+        self._fsyncs = 0
+        # Cumulative frame bytes fsynced over the writer's lifetime —
+        # unlike _wal_bytes this survives segment truncation, so the
+        # bench can rate the log write bandwidth.
+        self._bytes_written = 0
+        if fresh:
+            self._rewrite_locked_io(base_op_version, snap_size)
+        else:
+            self._file = open(path, "ab")
+            self._wal_bytes = self._file.tell()
+
+    # -- hot path (under frag._mu) ------------------------------------
+
+    def log(self, typ: int, pos: int) -> Future:
+        """Buffer one op record; returns the Future that resolves when
+        the record is durable.  Never touches the file."""
+        with self._mu:
+            if self._closed:
+                raise WalClosed(f"wal closed: {self.path}")
+            self._buf += roaring.encode_op(typ, pos)
+            self._buf_ops += 1
+            self._op_version += 1
+            self._appends += 1
+            if self._pending is None:
+                self._pending = Future()
+            fut = self._pending
+        self.stats.count("ingest.wal.appends")
+        _note_pending(self, fut)
+        self._manager._poke(self)
+        return fut
+
+    @property
+    def op_version(self) -> int:
+        with self._mu:
+            return self._op_version
+
+    # -- committer side -----------------------------------------------
+
+    def commit(self) -> int:
+        """Seal the buffered ops into one frame and fsync it.  Returns
+        the number of ops made durable (0 if the buffer was empty)."""
+        with self._io_mu:
+            with self._mu:
+                if self._closed or not self._buf_ops:
+                    return 0
+                payload = bytes(self._buf)
+                n_ops = self._buf_ops
+                end_version = self._op_version
+                fut = self._pending
+                self._buf = bytearray()
+                self._buf_ops = 0
+                self._pending = None
+            frame = encode_frame(payload, n_ops, end_version)
+            t0 = time.perf_counter()
+            try:
+                self._file.write(frame)
+                self._file.flush()
+                os.fsync(self._file.fileno())
+            except OSError as e:
+                if fut is not None and not fut.done():
+                    fut.set_exception(e)
+                raise
+            self._wal_bytes += len(frame)
+            self._last_fsync_ms = (time.perf_counter() - t0) * 1e3
+            self._last_group = n_ops
+            self._fsyncs += 1
+            self._bytes_written += len(frame)
+        self.stats.count("ingest.wal.fsyncs")
+        self.stats.histogram("ingest.wal.groupSize", float(n_ops))
+        if fut is not None and not fut.done():
+            fut.set_result(None)
+        return n_ops
+
+    def truncate_segment(self, snap_size: int) -> None:
+        """Reset the segment after a truncating snapshot.
+
+        Caller holds ``frag._mu`` and has already fsynced the snapshot
+        file AND its directory entry — every op the WAL covers (durable
+        or still buffered) is now captured by the snapshot, so buffered
+        waiters resolve as durable-by-snapshot and the log restarts
+        empty at the new base version.
+        """
+        with self._io_mu:
+            with self._mu:
+                if self._closed:
+                    return
+                base = self._op_version
+                fut = self._pending
+                self._buf = bytearray()
+                self._buf_ops = 0
+                self._pending = None
+                self._base = base
+                self._snap_size = snap_size
+            self._rewrite_locked_io(base, snap_size)
+        self.stats.count("ingest.wal.truncations")
+        if fut is not None and not fut.done():
+            fut.set_result(None)
+
+    def _rewrite_locked_io(self, base: int, snap_size: int) -> None:
+        """(Re)create the segment file with just a header.  Caller holds
+        ``_io_mu`` (or is the constructor, pre-publication)."""
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as fh:
+            fh.write(encode_header(base, snap_size))
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, self.path)
+        _fsync_dir(self.path)
+        self._file = open(self.path, "ab")
+        self._wal_bytes = HEADER_SIZE
+
+    def close(self, *, final_commit: bool = True) -> None:
+        """Detach: optionally flush the tail, then close the file.
+        Pending waiters that can't be committed fail with WalClosed."""
+        if final_commit:
+            try:
+                self.commit()
+            except OSError:
+                pass
+        with self._io_mu:
+            with self._mu:
+                if self._closed:
+                    return
+                self._closed = True
+                fut = self._pending
+                self._pending = None
+                self._buf = bytearray()
+                self._buf_ops = 0
+            try:
+                self._file.close()
+            except OSError:
+                pass
+        if fut is not None and not fut.done():
+            fut.set_exception(WalClosed(f"wal closed: {self.path}"))
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            return {
+                "path": self.path,
+                "walBytes": int(self._wal_bytes),
+                "bufferedOps": int(self._buf_ops),
+                "opVersion": int(self._op_version),
+                "baseOpVersion": int(self._base),
+                "lastFsyncMs": round(self._last_fsync_ms, 3),
+                "lastGroupSize": int(self._last_group),
+                "appends": int(self._appends),
+                "fsyncs": int(self._fsyncs),
+                "walBytesWritten": int(self._bytes_written),
+            }
+
+
+# -- per-thread durable-wait bookkeeping ------------------------------
+
+_local = threading.local()
+
+
+def _note_pending(writer: WalWriter, fut: Future) -> None:
+    """Record this thread's latest un-awaited future per writer.
+    Futures for one writer resolve in seal order, so waiting on the
+    latest one covers every earlier append by the same thread."""
+    pending = getattr(_local, "pending", None)
+    if pending is None:
+        pending = _local.pending = {}
+    pending[id(writer)] = fut
+
+
+class IngestManager:
+    """Holder-scoped WAL orchestration: one committer thread batching
+    every attached fragment's appends into per-fragment group commits.
+
+    Registered in a module-level list so :func:`attach_fragment` (called
+    from ``Fragment.open``) can find the manager owning a fragment by
+    path prefix — keeps multiple in-process servers (tests) isolated.
+    """
+
+    def __init__(self, data_dir: str, *, wal: bool = True,
+                 group_commit_ms: float = 2.0, group_commit_max: int = 128,
+                 wal_segment_bytes: int = 4 << 20, stats=None, logger=None,
+                 versions=None):
+        self.data_dir = os.path.realpath(data_dir)
+        self.wal_enabled = bool(wal)
+        self.group_commit_ms = float(group_commit_ms)
+        self.group_commit_max = int(group_commit_max)
+        self.wal_segment_bytes = int(wal_segment_bytes)
+        self.stats = stats if stats is not None else NopStatsClient()
+        self.logger = logger or (lambda m: None)
+        self.versions = versions
+        self._mu = threading.Lock()
+        self._cv = threading.Condition(self._mu)
+        self._writers: dict[int, WalWriter] = {}
+        self._dirty: dict[int, WalWriter] = {}
+        self._dirty_since: float | None = None
+        self._dirty_ops = 0
+        self._closed = False
+        self._last_replay: dict | None = None
+        self._replays = 0
+        self._replayed_ops = 0
+        # appends/fsyncs from writers that already detached, so the
+        # holder-wide totals in snapshot() survive fragment close.
+        self._gone_appends = 0
+        self._gone_fsyncs = 0
+        self._thread: threading.Thread | None = None
+        if self.wal_enabled:
+            self._thread = threading.Thread(
+                target=self._run, name="ingest-committer", daemon=True
+            )
+            self._thread.start()
+
+    # -- registry -----------------------------------------------------
+
+    def owns(self, path: str) -> bool:
+        return os.path.realpath(path).startswith(self.data_dir + os.sep)
+
+    def attach(self, frag) -> None:
+        """Wire a fragment to this manager: replay any durable WAL tail
+        newer than its snapshot, then install a fresh/continuing writer
+        as ``frag._wal``.  Called from ``Fragment.open`` under
+        ``frag._mu`` (lock order frag._mu -> wal locks holds)."""
+        if not self.wal_enabled:
+            return
+        from pilosa_tpu.ingest import recovery
+
+        path = wal_path(frag.path)
+        seg = load_segment(path)
+        snap_size, data_ops = _data_state(frag)
+        fresh = True
+        base = 0
+        if seg is not None:
+            wal_ops = b"".join(p for _, _, p in seg.frames)
+            if seg.snap_size != snap_size:
+                # Snapshot advanced while the WAL was detached (or the
+                # data file was replaced out from under us): the
+                # segment's base no longer matches, replay would
+                # double- or mis-apply.  Discard and restart.
+                self.logger(
+                    f"[ingest] discarding stale wal segment {path} "
+                    f"(snap_size {seg.snap_size} != {snap_size})"
+                )
+            elif not wal_ops.startswith(data_ops):
+                # The data file's op-log is NOT a prefix of the WAL's
+                # op sequence: the fragment took writes while the WAL
+                # was detached (e.g. the WAL was toggled off for a
+                # while).  The two histories can't be ordered, so the
+                # stale segment is forfeited — logged loudly because
+                # any op unique to it is lost.
+                self.logger(
+                    f"[ingest] discarding diverged wal segment {path} "
+                    f"(data op-log is not a prefix of the logged ops; "
+                    f"{len(seg.frames)} frames forfeited)"
+                )
+            else:
+                report = recovery.replay(frag, seg, self)
+                self._note_replay(frag, report)
+                base = seg.end_op_version
+                if report["replayed"] or report["unchanged"]:
+                    # Post-recovery checkpoint (ARIES-style restart
+                    # checkpoint): fold the replayed tail into a fresh
+                    # snapshot so the data op-log and the WAL restart
+                    # aligned at the new base version.
+                    frag.snapshot()
+                    snap_size, _ = _data_state(frag)
+                else:
+                    fresh = False
+                    if seg.torn:
+                        # Drop the un-verifiable tail so new frames
+                        # append after the last good one, not after
+                        # garbage.
+                        _truncate_file(path, seg.good_bytes)
+        if fresh and frag._op_n:
+            # A fresh segment starts at base with an implicit "zero
+            # preceding ops" contract; fold any existing op-log tail
+            # into the snapshot so a future recovery's skip count can't
+            # desync from the frame versions.
+            frag.snapshot()
+            snap_size, _ = _data_state(frag)
+        writer = WalWriter(frag, path, base, snap_size, self, fresh=fresh)
+        frag._wal = writer
+        with self._mu:
+            if self._closed:
+                raise WalClosed("ingest manager closed")
+            self._writers[id(writer)] = writer
+
+    def detach(self, writer: WalWriter) -> None:
+        """Called from ``Fragment.close`` (under frag._mu)."""
+        with self._mu:
+            self._writers.pop(id(writer), None)
+            self._dirty.pop(id(writer), None)
+        writer.close(final_commit=True)
+        with self._mu:
+            self._gone_appends += writer._appends
+            self._gone_fsyncs += writer._fsyncs
+
+    def _note_replay(self, frag, report: dict) -> None:
+        with self._mu:
+            self._replays += 1
+            self._replayed_ops += int(report.get("replayed", 0))
+            self._last_replay = report
+        self.logger(
+            f"[ingest] replayed {report['replayed']} wal ops for "
+            f"{frag.index}/{frag.frame}/{frag.view}/{frag.slice}"
+            + (" (torn tail)" if report.get("torn") else "")
+        )
+
+    # -- group commit -------------------------------------------------
+
+    def _poke(self, writer: WalWriter) -> None:
+        with self._mu:
+            if self._closed:
+                return
+            self._dirty[id(writer)] = writer
+            self._dirty_ops += 1
+            if self._dirty_since is None:
+                self._dirty_since = time.monotonic()
+            self._cv.notify()
+
+    def _run(self) -> None:
+        window = self.group_commit_ms / 1e3
+        while True:
+            with self._mu:
+                while not self._dirty and not self._closed:
+                    self._cv.wait()
+                if self._closed and not self._dirty:
+                    return
+                # Linger: let concurrent writers pile into this frame
+                # until the window elapses or the batch is full.
+                while not self._closed:
+                    elapsed = time.monotonic() - (self._dirty_since or 0.0)
+                    if (elapsed >= window
+                            or self._dirty_ops >= self.group_commit_max):
+                        break
+                    self._cv.wait(timeout=window - elapsed)
+                batch = list(self._dirty.values())
+                self._dirty.clear()
+                self._dirty_since = None
+                self._dirty_ops = 0
+            rollover = []
+            for w in batch:
+                try:
+                    w.commit()
+                except OSError as e:
+                    self.logger(f"[ingest] wal commit error: {e}")
+                    continue
+                if w._wal_bytes > self.wal_segment_bytes:
+                    rollover.append(w)
+            for w in rollover:
+                # Snapshot truncates the segment (frag.snapshot ->
+                # truncate_segment).  No manager locks held: snapshot
+                # takes frag._mu which is above the wal locks.
+                try:
+                    w.frag.snapshot()
+                except Exception as e:
+                    self.logger(f"[ingest] rollover snapshot error: {e}")
+            for w in batch:
+                # Background mirror maintenance: fold the writes this
+                # commit covered into the device mirror off the read
+                # path, so a read storm usually finds it clean instead
+                # of paying the scatter launch inline.  Same lock
+                # position as the rollover snapshot (frag._mu, no
+                # manager locks held); best-effort — a failure just
+                # leaves the apply to the next read.
+                try:
+                    apply_fn = getattr(w.frag, "apply_pending_scatter", None)
+                    if apply_fn is not None:
+                        apply_fn()
+                except Exception as e:
+                    self.logger(f"[ingest] background scatter error: {e}")
+
+    def wait_durable(self, timeout: float = 30.0) -> None:
+        """Block until every append made by THIS thread is durable.
+        No-op when the WAL is disabled or the thread wrote nothing."""
+        pending = getattr(_local, "pending", None)
+        if not pending:
+            return
+        futs = list(pending.values())
+        pending.clear()
+        for fut in futs:
+            fut.result(timeout=timeout)
+
+    # -- lifecycle / debug --------------------------------------------
+
+    def close(self) -> None:
+        with self._mu:
+            if self._closed:
+                return
+            self._closed = True
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+        with self._mu:
+            writers = list(self._writers.values())
+            self._writers.clear()
+            self._dirty.clear()
+        for w in writers:
+            w.close(final_commit=True)
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            writers = list(self._writers.values())
+            doc = {
+                "walEnabled": self.wal_enabled,
+                "groupCommitMs": self.group_commit_ms,
+                "groupCommitMax": self.group_commit_max,
+                "walSegmentBytes": self.wal_segment_bytes,
+                "fragments": len(writers),
+                "replays": self._replays,
+                "replayedOps": self._replayed_ops,
+                "lastReplay": self._last_replay,
+            }
+            gone_appends = self._gone_appends
+            gone_fsyncs = self._gone_fsyncs
+        doc["writers"] = [w.snapshot() for w in writers]
+        doc["totalAppends"] = gone_appends + sum(
+            w["appends"] for w in doc["writers"]
+        )
+        doc["totalFsyncs"] = gone_fsyncs + sum(
+            w["fsyncs"] for w in doc["writers"]
+        )
+        return doc
+
+
+def _truncate_file(path: str, size: int) -> None:
+    with open(path, "r+b") as fh:
+        fh.truncate(size)
+        fh.flush()
+        os.fsync(fh.fileno())
+
+
+def _data_state(frag) -> tuple[int, bytes]:
+    """The data file's op-region offset (the byte size of the snapshot
+    portion — identifies WHICH snapshot a WAL segment was truncated
+    against) plus the parsed op-log bytes, truncated to the records the
+    fragment actually recovered (``frag._op_n`` — a torn op tail is
+    excluded so the WAL prefix comparison isn't spooked by it)."""
+    try:
+        with open(frag.path, "rb") as fh:
+            data = fh.read()
+    except FileNotFoundError:
+        return 0, b""
+    if not data:
+        return 0, b""
+    try:
+        off = roaring.ops_region_offset(data)
+    except roaring.CorruptError:
+        return 0, b""
+    return off, bytes(data[off:off + frag._op_n * roaring.OP_SIZE])
+
+
+# -- module registry --------------------------------------------------
+
+_reg_mu = threading.Lock()
+_managers: list[IngestManager] = []
+
+
+def register_manager(m: IngestManager) -> None:
+    with _reg_mu:
+        _managers.append(m)
+
+
+def unregister_manager(m: IngestManager) -> None:
+    with _reg_mu:
+        try:
+            _managers.remove(m)
+        except ValueError:
+            pass
+
+
+def attach_fragment(frag) -> None:
+    """Called from ``Fragment.open``: find the manager owning this
+    fragment's path (if any) and attach.  Silently a no-op for
+    fragments outside any managed data dir (unit tests, tools)."""
+    with _reg_mu:
+        managers = list(_managers)
+    for m in managers:
+        if m.owns(frag.path):
+            m.attach(frag)
+            return

@@ -236,6 +236,10 @@ class Handler:
         # Server: fragments restored with ?stage=true (migration
         # arrivals) register their HBM mirrors through it.
         self.prefetcher = None
+        # Durable ingest (pilosa_tpu/ingest): WAL group-commit manager,
+        # wired by the Server when [ingest] wal is on.  Serves
+        # GET /debug/ingest; None = WAL disabled (stub JSON).
+        self.ingest = None
         # Native fixed-bucket latency histograms + SLO burn rate
         # (obs/perf.py): query latency per admission class, HTTP
         # latency per route template — rendered as Prometheus
@@ -311,6 +315,7 @@ class Handler:
             ("GET", r"/debug/subscriptions", self.handle_get_subscriptions),
             ("GET", r"/debug/replication", self.handle_get_replication),
             ("GET", r"/debug/tier", self.handle_get_tier),
+            ("GET", r"/debug/ingest", self.handle_get_ingest),
             ("GET", r"/debug/rebalance", self.handle_get_rebalance),
             ("GET", r"/debug/vars", self.handle_get_vars),
             ("GET", r"/debug/health", self.handle_get_health),
@@ -1492,6 +1497,23 @@ class Handler:
                 {"fragments": {}, "note": "tier not configured"}
             )
         return Response.json(self.tier.snapshot())
+
+    def handle_get_ingest(self, req: Request) -> Response:
+        """Durable-ingest observability: WAL group-commit state (per-
+        fragment segment sizes, buffered ops, last fsync latency and
+        batch size), replay history, and the device delta-scatter
+        counters (launches / updates applied / fallback invalidations)."""
+        from pilosa_tpu.ingest import scatter as ingest_scatter
+
+        doc = {
+            "scatter": dict(ingest_scatter.counters()),
+            "scatterEnabled": bool(ingest_scatter.ENABLED),
+        }
+        if self.ingest is None:
+            doc["wal"] = {"walEnabled": False, "note": "ingest WAL not configured"}
+        else:
+            doc["wal"] = self.ingest.snapshot()
+        return Response.json(doc)
 
     # ------------------------------------------------------------------
     # quorum replication: versions / hints / replay

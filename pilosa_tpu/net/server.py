@@ -101,6 +101,11 @@ class Server:
         subscribe_delta_cap: int = 50_000,
         subscribe_coalesce_ms: float = 5.0,
         subscribe_refresh_ms: float = 500.0,
+        ingest_wal: bool = True,
+        ingest_group_commit_ms: float = 2.0,
+        ingest_group_commit_max: int = 128,
+        ingest_scatter: bool = True,
+        ingest_wal_segment_bytes: int = 4 << 20,
         admission_subscribe_concurrency: int = 4,
         latency_buckets_ms=None,
         slo_ms: float = 0.0,
@@ -278,6 +283,16 @@ class Server:
         self.subscribe_coalesce_ms = subscribe_coalesce_ms
         self.subscribe_refresh_ms = subscribe_refresh_ms
         self.subscribe = None
+        # Durable ingest ([ingest] config, pilosa_tpu/ingest): the WAL
+        # manager is built at open() BEFORE holder.open() — fragments
+        # replay their WAL tails as they open and attach writers via
+        # the module registry.  None when the WAL is disabled.
+        self.ingest_wal = ingest_wal
+        self.ingest_group_commit_ms = ingest_group_commit_ms
+        self.ingest_group_commit_max = ingest_group_commit_max
+        self.ingest_scatter = ingest_scatter
+        self.ingest_wal_segment_bytes = ingest_wal_segment_bytes
+        self.ingest = None
         # Performance observability ([obs] latency-buckets-ms / slo-* /
         # floor-probe, obs/perf.py + device/floorprobe.py): native
         # fixed-bucket latency histograms + SLO burn gauges live on the
@@ -400,6 +415,27 @@ class Server:
                     f"{self.compilation_cache_dir!r}; queries recompile "
                     "from scratch on every process start"
                 )
+        # Durable ingest: flip the module-level scatter switch and
+        # register the WAL manager BEFORE holder.open() — fragments
+        # replay their WAL tails as they open and attach writers
+        # through the module registry (path-prefix ownership keeps
+        # multiple in-process servers isolated).
+        from pilosa_tpu.ingest import scatter as scatter_mod
+        from pilosa_tpu.ingest import wal as wal_mod
+
+        scatter_mod.ENABLED = bool(self.ingest_scatter)
+        if self.ingest_wal:
+            self.ingest = wal_mod.IngestManager(
+                self.data_dir,
+                wal=True,
+                group_commit_ms=self.ingest_group_commit_ms,
+                group_commit_max=self.ingest_group_commit_max,
+                wal_segment_bytes=self.ingest_wal_segment_bytes,
+                stats=self.holder.stats if self.stats is not None else None,
+                logger=self.logger,
+                versions=self.replication.versions,
+            )
+            wal_mod.register_manager(self.ingest)
         self.holder.open()
 
         # Tiered storage: open the cold-store client (sharing the
@@ -584,7 +620,11 @@ class Server:
             device_health=self.device_health,
             **kwargs,
         )
+        # Log-before-ack: point-write acks through this executor wait
+        # on the WAL group commit (no-op when the WAL is disabled).
+        self.executor.ingest = self.ingest
         self.handler.executor = self.executor
+        self.handler.ingest = self.ingest
 
         # Standing queries ([subscribe], pilosa_tpu/subscribe): the
         # manager registers its own fragment write/close listeners and
@@ -688,6 +728,16 @@ class Server:
             self.coalescer.close()
         self.device_health.close()
         self.holder.close()
+        # After holder.close(): fragments detached their WAL writers
+        # (final commit each) during close; now stop the committer and
+        # drop the registry entry so a later in-process server on the
+        # same data dir attaches fresh.
+        if self.ingest is not None:
+            from pilosa_tpu.ingest import wal as wal_mod
+
+            wal_mod.unregister_manager(self.ingest)
+            self.ingest.close()
+            self.ingest = None
         # Release stats transports (the StatsD UDP socket) last: the
         # close path above may still observe.
         if self.stats is not None:
@@ -772,6 +822,12 @@ class Server:
         self.stats.gauge("threads", threading.active_count())
         counts = gc.get_count()
         self.stats.gauge("gc.gen0_pending", counts[0])
+        try:
+            from pilosa_tpu.ingest import scatter as scatter_mod
+
+            scatter_mod.publish_stats(self.stats)
+        except Exception:  # noqa: BLE001 — stats are best-effort
+            pass
         try:
             import jax
 

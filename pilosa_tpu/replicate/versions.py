@@ -45,6 +45,19 @@ class VersionStore:
             self._versions[key] = v
             return v
 
+    def bump_many(self, index: str, slice_i: int, n: int) -> int:
+        """Advance by ``n`` locally-applied writes under ONE lock
+        acquisition — WAL recovery replays thousands of ops and stamps
+        them in a single call so quorum accounting catches up without
+        a per-op lock storm.  Returns the resulting version."""
+        if n <= 0:
+            return self.get(index, slice_i)
+        key = (index, int(slice_i))
+        with self._mu:
+            v = self._versions.get(key, 0) + int(n)
+            self._versions[key] = v
+            return v
+
     def observe(self, index: str, slice_i: int, version: int) -> int:
         """Max-merge a coordinator-stamped (or repair-pushed) version;
         returns the resulting local version.  Never moves backwards."""

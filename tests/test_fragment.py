@@ -452,7 +452,24 @@ class TestIncrementalDeviceMirror:
 
         assert bp.np_row_to_columns(np.asarray(frag.device_row(0))).tolist() == []
 
-    def test_bulk_import_invalidates_mirror(self, frag):
+    def test_small_bulk_import_scatters_mirror(self, frag):
+        # A small import rides the delta-scatter path: the mirror stays
+        # resident with the import's bits queued as pending deltas.
+        frag.set_bit(0, 1)
+        frag.device_plane()
+        frag.import_bulk([0, 0], [2, 3])
+        assert frag._device is not None
+        assert frag._device_pending
+        import numpy as np
+
+        from pilosa_tpu.ops import bitplane as bp
+
+        assert bp.np_row_to_columns(np.asarray(frag.device_row(0))).tolist() == [1, 2, 3]
+
+    def test_large_bulk_import_invalidates_mirror(self, frag, monkeypatch):
+        from pilosa_tpu.ingest import scatter as ingest_scatter
+
+        monkeypatch.setattr(ingest_scatter, "IMPORT_SCATTER_MAX", 1)
         frag.set_bit(0, 1)
         frag.device_plane()
         frag.import_bulk([0, 0], [2, 3])

@@ -50,6 +50,12 @@ def main() -> int:
         BENCH_MESH_SLICES="8",
         BENCH_MESH_COLUMNS=str(16 * (1 << 20)),
         BENCH_MESH_GRID_BITS="256",
+        # Ingest tier at smoke scale: a shorter acked-write storm and
+        # re-stage loop (still >= 100 rounds so the scatter-vs-
+        # invalidate byte ratio assertion below stays meaningful).
+        BENCH_INGEST_WRITES="80",
+        BENCH_INGEST_READS="150",
+        BENCH_INGEST_RESTAGE_ROUNDS="120",
     )
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py")],
@@ -298,6 +304,63 @@ def main() -> int:
             file=sys.stderr,
         )
         return 1
+    ig = out.get("ingest")
+    if not isinstance(ig, dict):
+        print(f"FAIL: artifact missing ingest tier: {out}", file=sys.stderr)
+        return 1
+    gw = (ig.get("write") or {}).get("group_on")
+    if not isinstance(gw, dict) or gw.get("acks", 0) < 1:
+        print(f"FAIL: ingest tier group_on arm implausible: {ig}",
+              file=sys.stderr)
+        return 1
+    # Group commit must actually batch: well under one fsync per acked
+    # write (the whole point of the window), and the durable-write p99
+    # bounded by the commit window — a per-ack-fsync regression shows
+    # up as fsyncs ~= acks long before it shows up in latency.
+    if gw.get("fsyncs", 0) < 1 or gw["fsyncs"] * 4 > gw["acks"]:
+        print(
+            f"FAIL: group commit not batching (fsyncs={gw.get('fsyncs')}"
+            f" for {gw.get('acks')} acks): {gw}",
+            file=sys.stderr,
+        )
+        return 1
+    if not (0 < gw.get("write_p99_ms", 0) <= 50.0):
+        print(f"FAIL: durable write p99 unbounded: {gw}", file=sys.stderr)
+        return 1
+    if (ig["write"].get("wal_off") or {}).get("fsyncs", -1) != 0:
+        print(f"FAIL: wal_off arm fsynced: {ig['write']}", file=sys.stderr)
+        return 1
+    rd = ig.get("read")
+    ig_ratio = (rd or {}).get("p99_ratio")
+    if not isinstance(rd, dict) or not isinstance(ig_ratio, (int, float)):
+        print(f"FAIL: ingest tier missing read arm: {ig}", file=sys.stderr)
+        return 1
+    # The WAL fsync wait must stay off the read path: read p99 under
+    # the 50/50 storm within 1.5x of the control leg (the identical
+    # writer storm against a disjoint frame, so in-process thread-
+    # scheduling noise cancels and the ratio isolates what durable
+    # ingest adds to the read tail).
+    if not (0 < ig_ratio <= 1.5):
+        print(
+            f"FAIL: read p99 under 50/50 ingest storm is {ig_ratio}x"
+            f" the control-storm baseline: {rd}",
+            file=sys.stderr,
+        )
+        return 1
+    rs = ig.get("restage")
+    if (
+        not isinstance(rs, dict)
+        or (rs.get("scatter_off") or {}).get("restage_bytes", 0) <= 0
+        or rs.get("bytes_ratio", 0) < 100
+    ):
+        print(
+            f"FAIL: delta-scatter re-stage saving under 100x: {rs}",
+            file=sys.stderr,
+        )
+        return 1
+    if (rs.get("scatter") or {}).get("launches", 0) < 1:
+        print(f"FAIL: scatter arm never launched: {rs}", file=sys.stderr)
+        return 1
     pc = out.get("program_cache")
     if not isinstance(pc, dict) or "entries" not in pc or "bounds" not in pc:
         print(f"FAIL: artifact missing program_cache: {out}", file=sys.stderr)
@@ -386,6 +449,9 @@ def main() -> int:
         f" {dg['watchdog']['trip_recovery_ms']} ms;"
         f" standing {st['subscriptions']} subs, lag p99 {lag['p99']} ms,"
         f" query-path p99 ratio {ratio}x;"
+        f" ingest {gw['acks_per_s']} acks/s ({gw['fsyncs']} fsyncs /"
+        f" {gw['acks']} acks), 50/50 read p99 {ig_ratio}x, re-stage"
+        f" saving {rs['bytes_ratio']}x;"
         f" perf sites {sorted(sites)} (coalesce"
         f" {sites['coalesce']['gbps']} GB/s)"
     )
