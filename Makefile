@@ -5,7 +5,7 @@
 
 PYTHON ?= python
 
-.PHONY: default test lint analyze typecheck metrics-lint check bench bench-smoke chaos-smoke device-chaos-smoke load-smoke resize-smoke multichip-smoke tier-smoke replication-smoke subscribe-smoke ingest-smoke ingest-bench churn-soak install build docker clean generate
+.PHONY: default test lint analyze typecheck metrics-lint check bench bench-smoke chaos-smoke device-chaos-smoke load-smoke resize-smoke multichip-smoke tier-smoke replication-smoke subscribe-smoke ingest-smoke ingest-bench sparse-smoke sparse-bench churn-soak install build docker clean generate
 
 default: build test
 
@@ -156,6 +156,25 @@ subscribe-smoke:
 # BLOCKING in CI (.github/workflows/check.yml), like subscribe-smoke.
 ingest-smoke:
 	$(PYTHON) tools/ingest_smoke.py
+
+# Compressed-plane smoke (tools/sparse_smoke.py): tiny 1%-density
+# clustered corpus on the CPU backend; write-time container selection
+# must pick RLE/sparse formats (no dense rows), every answer over the
+# compressed planes is byte-checked against a numpy oracle with the
+# anchored position-domain count route engaged, and the paged-in rows'
+# resident HBM must sit >= 10x below logical dense geometry.  CI runs
+# it under PILOSA_LOCK_CHECK=1.  BLOCKING in CI
+# (.github/workflows/check.yml), like subscribe-smoke.
+sparse-smoke:
+	$(PYTHON) tools/sparse_smoke.py
+
+# Sparse bench tier standalone (tools/sparse_bench.py): effective
+# Gcols/s + bytes read + format mix + resident ratio over 50%/5%/1%/
+# 0.1% density corpora with a byte-identity storm vs the forced-dense
+# arm.  One JSON line on stdout; also runs inside make bench (bench.py
+# "sparse" tier) and is asserted by bench-smoke.
+sparse-bench:
+	$(PYTHON) tools/sparse_bench.py
 
 # Ingest bench tier standalone (tools/ingest_bench.py): durable acked
 # write throughput with group commit on/off vs the WAL-off baseline,

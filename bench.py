@@ -562,6 +562,45 @@ def main() -> None:
         except Exception as e:
             log(f"ingest tier failed: {e}")
 
+    # Sparse tier (ISSUE 19): compressed device planes — effective
+    # Gcols/s, device bytes read vs logical geometry, container-format
+    # mix, and compressed-vs-logical resident HBM over 50%/5%/1%/0.1%
+    # density corpora, with a byte-identity PQL storm against the
+    # forced-dense arm (tools/sparse_bench.py subprocess, CPU).
+    sparse_tier = None
+    if os.environ.get("BENCH_SKIP_SPARSE_TIER") != "1":
+        import subprocess
+
+        spt = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "tools",
+            "sparse_bench.py",
+        )
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+        try:
+            out = subprocess.run(
+                [sys.executable, spt], env=env, capture_output=True,
+                timeout=900, text=True,
+            )
+            if out.returncode == 0 and out.stdout.strip():
+                for line in out.stderr.strip().splitlines():
+                    if line.startswith("[sparse]"):
+                        log(line)
+                sparse_tier = json.loads(out.stdout.strip().splitlines()[-1])
+                d1 = sparse_tier["densities"]["1"]
+                log(
+                    "sparse tier: 1% density "
+                    f"{d1['effective_gcols_s']} Gcols/s effective "
+                    f"({d1['speedup']}x dense arm), resident HBM "
+                    f"{d1.get('resident_ratio', 0)}x below logical, "
+                    f"mix {d1['format_mix']}"
+                )
+            else:
+                log(f"sparse tier failed: rc={out.returncode} "
+                    f"stderr={out.stderr.strip()[-300:]!r}")
+        except Exception as e:
+            log(f"sparse tier failed: {e}")
+
     # Mesh-scaling tier (ISSUE 12 / ROADMAP 2): the mesh-sharded data
     # plane end to end — devices-vs-Gcols/s curve at 1/2/4/8 devices,
     # the 10B-column Intersect+Count headline over the full mesh (ICI-
@@ -916,6 +955,11 @@ def main() -> None:
             # both read the same byte count, so the ratio is just
             # time-over-time.
             out["raw_kernel_vs_stream_floor"] = round(stream_s / dev_s, 3)
+            # The ISSUE-19 headline figure: the raw and+popcount
+            # kernel's bandwidth as a percentage of the stream floor
+            # (BENCH_r05 recorded 64.8% with the pre-restructure
+            # kernel; the chunked-limb/Pallas path targets 85%).
+            out["raw_kernel_floor_pct"] = round(100.0 * stream_s / dev_s, 1)
             out["stream_floor_gb_s"] = round(
                 bytes_per_query / stream_s / 1e9, 1
             )
@@ -957,6 +1001,8 @@ def main() -> None:
         out["standing"] = standing_tier
     if ingest_tier is not None:
         out["ingest"] = ingest_tier
+    if sparse_tier is not None:
+        out["sparse"] = sparse_tier
     out["program_cache"] = {
         "entries": plan.program_cache_stats(),
         "bounds": plan.program_cache_bounds(),

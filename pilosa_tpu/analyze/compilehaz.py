@@ -14,7 +14,12 @@ Rules:
   AND dispatches a compile entry point, without ever calling a bucket
   helper.  Function granularity keeps it honest: cross-function flows
   are out of scope (and covered by the program-cache bound gauges at
-  runtime).
+  runtime).  Container-length bucketing follows the same rule: a
+  compressed-plane payload (sparse positions / RLE runs) carries a
+  data-dependent length, so any site feeding one to the anchored
+  kernels (``compiled_anchored_count`` / ``anchored_count_exec``) must
+  pad it through ``bp.payload_bucket`` — pow2 container-length shape
+  classes keep the jit keys pure geometry.
 * ``jit-key-fstring`` — an f-string / ``str()`` / ``repr()`` inside an
   argument to a compile entry point: stringified dynamic values make
   unbounded compile keys.
@@ -32,12 +37,18 @@ import ast
 
 from pilosa_tpu.analyze.report import Finding
 
-_DEFAULT_ENTRY_POINTS = {"compiled_batched", "compiled_total_count"}
+_DEFAULT_ENTRY_POINTS = {
+    "compiled_batched",
+    "compiled_total_count",
+    "compiled_anchored_count",
+    "anchored_count_exec",
+}
 _DEFAULT_BUCKET_FNS = {
     "pow2_bucket",
     "slice_bucket",
     "pad_rows",
     "bucket_classes",
+    "payload_bucket",
 }
 _BUILDERS = {"concatenate", "stack", "pad", "zeros", "ones", "full", "empty"}
 _SYNC_ATTRS = {"item", "block_until_ready", "device_get"}

@@ -124,6 +124,9 @@ def build_server(cfg: config_mod.Config):
         quarantine_threshold=cfg.device.quarantine_threshold,
         quarantine_open_ms=cfg.device.quarantine_open_ms,
         quarantine_probe_successes=cfg.device.quarantine_probe_successes,
+        plane_format=cfg.device.plane_format,
+        plane_sparse_max_bytes=cfg.device.plane_sparse_max_bytes,
+        plane_rle_max_bytes=cfg.device.plane_rle_max_bytes,
         coalesce=cfg.exec.coalesce,
         coalesce_max_batch=cfg.exec.coalesce_max_batch,
         coalesce_max_wait_us=cfg.exec.coalesce_max_wait_us,

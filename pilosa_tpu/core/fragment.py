@@ -347,7 +347,18 @@ class Fragment:
         self._slot_of: dict[int, int] = {}
         self._sparse: dict[int, np.ndarray] = {}
         # Sparse rows paged to the home device for query leaves (LRU).
-        self._sparse_dev: "OrderedDict[int, object]" = OrderedDict()
+        # Each entry holds the row's COMPRESSED container payload —
+        # (fmt, device_payload, encoded_nbytes) per ops/bitplane
+        # encode_row — so HBM residency scales with cardinality, not
+        # with the 128 KiB dense geometry; _sparse_dev_nbytes tracks
+        # the resident total for pool accounting.
+        self._sparse_dev: "OrderedDict[int, tuple]" = OrderedDict()
+        self._sparse_dev_nbytes = 0
+        # Host-side encoded payloads (write-time format selection),
+        # invalidated per row by _after_write like _row_cache; bytes
+        # are the compressed size, so the cache is cheap even for the
+        # row-unbounded sparse tier.
+        self._payload_cache: dict[int, tuple] = {}
         # TopN candidate-row gathers cached per (version, candidate set):
         # Sorted tier-key arrays for vectorized dense/sparse candidate
         # splits (see _tier_key_arrays_locked), cached per version.
@@ -564,6 +575,8 @@ class Fragment:
             # bytes now, not whenever GC reaches self._device.
             self._invalidate_device()
             self._sparse_dev.clear()
+            self._sparse_dev_nbytes = 0
+            self._payload_cache.clear()
             device_mod.pool().remove(self._sparse_pool_key)
             self._opened = False
             # A fragment leaving service (shutdown OR frame/index/view
@@ -762,6 +775,7 @@ class Fragment:
         ):
             return
         del self._sparse[row_id]
+        self._payload_cache.pop(row_id, None)
         if self._sparse_dev.pop(row_id, None) is not None:
             self._sync_sparse_pool_locked()
         slot = self._alloc_dense_slot(row_id)
@@ -1034,6 +1048,7 @@ class Fragment:
         self._plane = plane
         self._sparse = sparse
         self._sparse_dev.clear()
+        self._payload_cache.clear()
         self._sync_sparse_pool_locked()
         self._max_row_id = max_row
         self._count_of = counts
@@ -1088,6 +1103,7 @@ class Fragment:
                 np.concatenate(segs) if segs else np.empty(0, np.uint32)
             )
         self._sparse_dev.clear()
+        self._payload_cache.clear()
         self._sync_sparse_pool_locked()
 
         self._max_row_id = max(per_row) if per_row else 0
@@ -1289,21 +1305,35 @@ class Fragment:
             return False
         try:
             self._sparse_dev.clear()
+            self._sparse_dev_nbytes = 0
             return True
         finally:
             self._mu.release()
 
     def _sync_sparse_pool_locked(self) -> None:
-        """Re-account the paged-sparse-row cache after a mutation path
-        shrank it (write invalidation, promotion, bulk load).  Callers
-        hold ``_mu``."""
+        """Re-account the paged-sparse-row cache after it changed
+        (page-in, write invalidation, promotion, bulk load).  Resident
+        bytes are the COMPRESSED payload sizes; the pool entry's info
+        carries the logical dense equivalent (rows x 128 KiB) and the
+        container-format mix so /debug/hbm can report compressed vs
+        logical.  Callers hold ``_mu``."""
+        ents = self._sparse_dev.values()
+        self._sparse_dev_nbytes = sum(e[2] for e in ents)
         n = len(self._sparse_dev)
         if n == 0:
             device_mod.pool().remove(self._sparse_pool_key)
         else:
+            mix: dict[str, int] = {}
+            for fmt, _dev, _nb in ents:
+                name = bp.FMT_NAMES.get(fmt, str(fmt))
+                mix[name] = mix.get(name, 0) + 1
+            info = dict(self._pool_info())
+            info["logical_bytes"] = n * ROW_NBYTES
+            info["formats"] = mix
             device_mod.pool().resize(
                 self._sparse_pool_key,
-                {bp.home_device(self.slice): n * ROW_NBYTES},
+                {bp.home_device(self.slice): self._sparse_dev_nbytes},
+                info=info,
             )
 
     @property
@@ -1402,30 +1432,101 @@ class Fragment:
                     device_mod.pool().touch(self._pool_key)
                     return dev[slot]
                 return self.device_plane()[slot]
+            ent = self._sparse_dev_entry_locked(row_id)
+            if ent is None:
+                return None
+            fmt, dev, _nb = ent
+            # Transient dense expansion for the stacking caller; the
+            # resident cache keeps only the compressed payload, so HBM
+            # never holds a decompressed staging copy.
+            return bp.expand_payload(fmt, dev)
+
+    def _sparse_dev_entry_locked(self, row_id: int):
+        """The paged compressed-container entry ``(fmt, device_payload,
+        encoded_nbytes)`` for a sparse-tier row, paging it in (pool
+        admission first, at COMPRESSED bytes) on miss.  Callers hold
+        ``_mu``; returns None when the row is absent."""
+        import jax
+
+        offs = self._sparse.get(row_id)
+        if offs is None:
+            return None
+        ent = self._sparse_dev.get(row_id)
+        if ent is not None:
+            self._sparse_dev.move_to_end(row_id)
+            device_mod.pool().touch(self._sparse_pool_key)
+            return ent
+        fmt, payload, nbytes = self._host_payload_locked(row_id, offs)
+        home = bp.home_device(self.slice)
+        device_mod.pool().admit(
+            self._sparse_pool_key,
+            {home: self._sparse_dev_nbytes + nbytes},
+            self._evict_sparse_rows,
+            category="sparse",
+            info=self._pool_info(),
+        )
+        dev = jax.device_put(payload, home)
+        ent = self._sparse_dev[row_id] = (fmt, dev, nbytes)
+        while len(self._sparse_dev) > SPARSE_DEVICE_CACHE:
+            self._sparse_dev.popitem(last=False)
+        self._sync_sparse_pool_locked()
+        return ent
+
+    def _host_payload_locked(self, row_id: int, offs) -> tuple:
+        """Write-time-selected container encoding of one sparse-tier
+        row — ``(fmt, payload, encoded_nbytes)``, memoized until the
+        row mutates (_after_write pops it, which is also how a write
+        triggers format RE-selection: the next encode sees the new
+        density)."""
+        ent = self._payload_cache.get(row_id)
+        if ent is None:
+            ent = self._payload_cache[row_id] = bp.encode_row(offs)
+        return ent
+
+    def host_payload(self, row_id: int):
+        """Host-side container view of any present row: ``(fmt,
+        payload, encoded_nbytes, cardinality)``.  Dense-tier rows are
+        FMT_DENSE views of the authoritative plane (callers copy into
+        batches, never mutate); sparse-tier rows return the memoized
+        compressed encoding.  None when the row is absent — the
+        executor's anchored count assembles its format-dispatched leaf
+        batches from this."""
+        with self._mu:
+            slot = self._slot_of.get(row_id)
+            if slot is not None:
+                return (
+                    bp.FMT_DENSE,
+                    self._plane[slot],
+                    ROW_NBYTES,
+                    self._count_of.get(row_id, 0),
+                )
             offs = self._sparse.get(row_id)
             if offs is None:
                 return None
-            dev = self._sparse_dev.get(row_id)
-            if dev is not None:
-                self._sparse_dev.move_to_end(row_id)
-                device_mod.pool().touch(self._sparse_pool_key)
-                return dev
-            home = bp.home_device(self.slice)
-            device_mod.pool().admit(
-                self._sparse_pool_key,
-                {
-                    home: min(len(self._sparse_dev) + 1, SPARSE_DEVICE_CACHE)
-                    * ROW_NBYTES
-                },
-                self._evict_sparse_rows,
-                category="sparse",
-                info=self._pool_info(),
-            )
-            dev = jax.device_put(bp.np_columns_to_row(offs), home)
-            self._sparse_dev[row_id] = dev
-            while len(self._sparse_dev) > SPARSE_DEVICE_CACHE:
-                self._sparse_dev.popitem(last=False)
-            return dev
+            fmt, payload, nbytes = self._host_payload_locked(row_id, offs)
+            return (fmt, payload, nbytes, len(offs))
+
+    def row_positions(self, row_id: int):
+        """Sorted uint32 in-slice positions of one present row (the
+        anchored count's anchor vector), or None.  O(cardinality) for
+        sparse-tier rows; dense-tier rows pay one 128 KiB plane-row
+        scan."""
+        with self._mu:
+            slot = self._slot_of.get(row_id)
+            if slot is not None:
+                return bp.np_row_to_columns(self._plane[slot]).astype(
+                    np.uint32
+                )
+            offs = self._sparse.get(row_id)
+            if offs is None:
+                return None
+            return np.asarray(offs, dtype=np.uint32)
+
+    def row_count(self, row_id: int) -> int:
+        """Cached popcount of one row (0 when absent) — the anchored
+        count's anchor-selection key, no plane scan."""
+        with self._mu:
+            return self._count_of.get(row_id, 0)
 
     # ------------------------------------------------------------------
     # writes (reference: fragment.go:379-473)
@@ -1584,6 +1685,10 @@ class Fragment:
         self._version += 1
         _bump_write_epoch()
         self._row_cache.pop(row_id, None)
+        # Dropping the encoded payload IS the format re-selection hook:
+        # the next read re-encodes at the row's new density (a sparse
+        # row crossing a threshold lands in a different container).
+        self._payload_cache.pop(row_id, None)
         if self._sparse_dev.pop(row_id, None) is not None:
             self._sync_sparse_pool_locked()
         self._dirty_blocks.add(row_id // HASH_BLOCK_SIZE)
@@ -1755,6 +1860,7 @@ class Fragment:
                 imp_set_slots, imp_set_offs, imp_clr_slots, imp_clr_offs
             )
             self._sparse_dev.clear()
+            self._payload_cache.clear()
             self._sync_sparse_pool_locked()
             self._row_cache.clear()
             self._dirty_blocks.update(int(r) // HASH_BLOCK_SIZE for r in uniq)

@@ -234,10 +234,14 @@ class PlanePool:
             if key in self._entries:
                 self._entries.move_to_end(key)
 
-    def resize(self, key: tuple, bytes_by_device: dict) -> None:
+    def resize(
+        self, key: tuple, bytes_by_device: dict, info: dict | None = None
+    ) -> None:
         """Update an entry's bytes in place (e.g. the sparse-row cache
         shrinking) without changing its LRU position or running
-        admission eviction."""
+        admission eviction.  ``info`` (when given) replaces the entry's
+        snapshot annotations — the compressed-container cache keeps its
+        logical-bytes/format-mix surface current this way."""
         gauges = []
         with self._mu:
             ent = self._entries.get(key)
@@ -247,6 +251,8 @@ class PlanePool:
             ent.bytes_by_device = {
                 d: int(n) for d, n in bytes_by_device.items() if n
             }
+            if info is not None:
+                ent.info = dict(info)
             self._credit(ent)
             gauges = self._gauges_locked(ent.bytes_by_device)
         self._publish(gauges)
@@ -519,10 +525,19 @@ class PlanePool:
         with self._mu:
             per_dev: dict = {}
             fragments: list[dict] = []
+            resident_total = 0
+            logical_total = 0
             for ent in self._entries.values():  # LRU -> MRU order
+                # Compressed-container entries annotate the dense bytes
+                # they REPLACE (info["logical_bytes"]); everything else
+                # is stored at its logical geometry.
+                logical = int(ent.info.get("logical_bytes", ent.nbytes))
+                resident_total += ent.nbytes
+                logical_total += logical
                 row = {
                     "kind": ent.category,
                     "bytes": ent.nbytes,
+                    "logical_bytes": logical,
                     "pinned": ent.pins > 0,
                 }
                 if len(ent.bytes_by_device) > 1:
@@ -556,6 +571,15 @@ class PlanePool:
             return {
                 "budget_bytes": budget,
                 "cache_bytes": self._cat_bytes.get("cache", 0),
+                # Compressed-plane headline: resident HBM vs what the
+                # same entries would cost at dense geometry.
+                "resident_bytes": resident_total,
+                "logical_bytes": logical_total,
+                "compression_ratio": round(
+                    logical_total / resident_total, 3
+                )
+                if resident_total
+                else 1.0,
                 "devices": sorted(
                     per_dev.values(), key=lambda d: d["device"]
                 ),

@@ -64,6 +64,13 @@ from pilosa_tpu.testing import faults
 from pilosa_tpu.ops import bitplane as bp
 from pilosa_tpu.pql.parser import Call, Query
 
+# Absent-row stand-in for anchored count leaf batches: an all-sentinel
+# sparse payload at the bucket floor (membership False on every real
+# position).  Read-only module constant.
+_EMPTY_SPARSE_PAYLOAD = np.full(
+    bp.PAYLOAD_BUCKET_FLOOR, bp.FMT_SENTINEL, dtype=np.uint32
+)
+
 # reference: executor.go:33-40
 DEFAULT_FRAME = "general"
 MIN_THRESHOLD = 1
@@ -1605,6 +1612,184 @@ class Executor:
             out[s] = plan.eval_expr_np(expr, rows, bp.WORDS_PER_SLICE)
         return out
 
+    # ------------------------------------------------------------------
+    # anchored position-domain count (compressed-plane fast path)
+    # ------------------------------------------------------------------
+
+    # Anchor-cardinality routing ceiling, in positions.  Past one dense
+    # row's worth of words (32768 positions = 3.1% of a slice) the
+    # position-domain gathers cost more than streaming the dense words,
+    # so denser anchors keep the batched word-domain path.
+    ANCHORED_MAX_POSITIONS = bp.WORDS_PER_SLICE
+
+    @staticmethod
+    def _expr_fold_only(expr: tuple) -> bool:
+        """True when the decomposed tree is pure set algebra (leaves +
+        Intersect/Union/Difference/Xor) — membership masks compose
+        pointwise only for those, never for the BSI interiors."""
+        if expr[0] == "leaf":
+            return True
+        if expr[0] not in plan.FOLD_CALLS:
+            return False
+        return all(Executor._expr_fold_only(ch) for ch in expr[1:])
+
+    @staticmethod
+    def _anchor_candidates(expr: tuple) -> set:
+        """Leaf indices guaranteed to be SUPERSETS of the expression
+        result: the result of an Intersect is a subset of every child's
+        result, a Difference of its FIRST child's — so any leaf
+        reachable from the root through only those edges bounds the
+        result, and counting inside its position set is exact."""
+        if expr[0] == "leaf":
+            return {expr[1]}
+        if expr[0] == "Intersect":
+            out: set = set()
+            for ch in expr[1:]:
+                out |= Executor._anchor_candidates(ch)
+            return out
+        if expr[0] == "Difference" and len(expr) > 1:
+            return Executor._anchor_candidates(expr[1])
+        return set()
+
+    def _try_anchored_count(self, index: str, c: Call, slices: list[int]):
+        """Compressed-plane Count: when the tree is fold-only over
+        Bitmap leaves and some AND-dominating leaf is sparse, evaluate
+        the expression POINTWISE over that anchor leaf's positions
+        against each leaf's container payload (plan.anchored_count_exec)
+        — device bytes proportional to cardinality, not to leaves x
+        128 KiB.  Returns the exact total, or None to decline (the
+        caller falls through to the batched word-domain path; any
+        failure here also declines, so the guarded path retains its
+        retry/host-fallback semantics)."""
+        if bp.PLANE_FORMAT == "dense":
+            return None
+        try:
+            expr, leaves = plan.decompose(self._rewrite_bsi(index, c))
+        except Exception:  # noqa: BLE001 — let the main path raise it
+            return None
+        if not leaves or any(leaf.name != "Bitmap" for leaf in leaves):
+            return None
+        if not self._expr_fold_only(expr):
+            return None
+        cands = self._anchor_candidates(expr)
+        if not cands:
+            return None
+        try:
+            # Per-slice leaf resolution + anchor pick, grouped by the
+            # per-leaf container-format signature (formats may differ
+            # per slice; each signature is its own compiled wrapper).
+            groups: dict[tuple, list] = {}
+            any_compressed = False
+            for s in slices:
+                resolved = [
+                    self._resolve_bitmap_leaf(index, leaf, s)
+                    for leaf in leaves
+                ]
+                best = None
+                for i in sorted(cands):
+                    frag, rid = resolved[i]
+                    card = frag.row_count(rid) if frag is not None else 0
+                    if best is None or card < best[0]:
+                        best = (card, i)
+                card, ai = best
+                if card == 0:
+                    continue  # empty anchor bounds the slice count at 0
+                if card > self.ANCHORED_MAX_POSITIONS:
+                    return None  # too dense: whole query keeps one path
+                afrag, arid = resolved[ai]
+                anchor = afrag.row_positions(arid)
+                if anchor is None or len(anchor) == 0:
+                    continue
+                fmts: list[int] = []
+                payloads: list = []
+                eff = 4 * len(anchor)
+                for frag, rid in resolved:
+                    hp = (
+                        frag.host_payload(rid) if frag is not None else None
+                    )
+                    if hp is None:
+                        # Absent row: all-sentinel sparse payload, so
+                        # membership answers False on every real lane.
+                        fmts.append(bp.FMT_SPARSE)
+                        payloads.append(_EMPTY_SPARSE_PAYLOAD)
+                        eff += _EMPTY_SPARSE_PAYLOAD.nbytes
+                    else:
+                        fmt, payload, nbytes, _ = hp
+                        fmts.append(fmt)
+                        payloads.append(payload)
+                        eff += nbytes
+                        if fmt != bp.FMT_DENSE:
+                            any_compressed = True
+                groups.setdefault(tuple(fmts), []).append(
+                    (anchor, payloads, eff)
+                )
+            if not any_compressed:
+                # Every leaf is a full dense plane: the position-domain
+                # gathers save no bytes, and the batched word-domain
+                # path keeps its cache/coalesce behavior.  (Dense-tier
+                # corpora — the default budget — always land here.)
+                return None
+            total = 0
+            for fmts, items in groups.items():
+                total += self._anchored_launch(expr, fmts, items)
+            return int(total)
+        except Exception:  # noqa: BLE001 — decline, main path decides
+            return None
+
+    def _anchored_launch(
+        self, expr: tuple, fmts: tuple, items: list
+    ) -> int:
+        """One vmapped anchored launch for a group of slices sharing a
+        container-format signature.  Every axis is pow2-bucketed (slice
+        axis to plan.slice_bucket, anchor/payload axes to
+        bp.payload_bucket) with sentinel padding, so the jit key stays
+        pure geometry."""
+        n = len(items)
+        n_leaves = len(fmts)
+        sb = plan.slice_bucket(n)
+        pb = max(bp.payload_bucket(len(a)) for a, _, _ in items)
+        anchor_np = np.full((sb, pb), bp.FMT_SENTINEL, dtype=np.uint32)
+        for si, (anchor, _, _) in enumerate(items):
+            anchor_np[si, : len(anchor)] = anchor
+        payload_np = []
+        for li in range(n_leaves):
+            cols = [it[1][li] for it in items]
+            if fmts[li] == bp.FMT_DENSE:
+                arr = np.zeros((sb, bp.WORDS_PER_SLICE), dtype=np.uint32)
+            elif fmts[li] == bp.FMT_SPARSE:
+                lb = max(p.shape[0] for p in cols)
+                arr = np.full((sb, lb), bp.FMT_SENTINEL, dtype=np.uint32)
+            else:
+                lb = max(p.shape[0] for p in cols)
+                arr = np.full(
+                    (sb, lb, 2), bp.FMT_SENTINEL, dtype=np.uint32
+                )
+            for si, p in enumerate(cols):
+                arr[si, : p.shape[0]] = p
+            payload_np.append(arr)
+        logical = n * n_leaves * bp.WORDS_PER_SLICE * 4
+        eff = sum(it[2] for it in items)
+        t0 = time.monotonic()
+        out = plan.anchored_count_exec(
+            expr, fmts, jnp.asarray(anchor_np),
+            [jnp.asarray(a) for a in payload_np],
+        )
+        t_disp = time.monotonic()
+        res = jax.device_get(out)
+        t1 = time.monotonic()
+        if perf_mod.enabled():
+            perf_mod.record_launch(
+                "anchored",
+                reduce="count",
+                rows=n * n_leaves,
+                n_bytes=logical,
+                eff_bytes=eff,
+                dispatch_ms=(t_disp - t0) * 1e3,
+                total_ms=(t1 - t0) * 1e3,
+                trace_id=perf_mod.current_trace_id(),
+            )
+        return int(sum(int(x) for x in res[:n]))
+
     def _count_slices_total(self, index: str, c: Call, slices: list[int]) -> int:
         """Count(tree) over local slices with the cross-slice reduce ON
         DEVICE.
@@ -1624,6 +1809,16 @@ class Executor:
             # Quarantined accelerator: host popcount over the
             # authoritative planes, no device batch assembled.
             return self.hosteval.count_total(index, c, slices)
+        if mode == health_mod.MODE_OK:
+            # Compressed-plane fast path: a fold-only tree with a
+            # sparse AND-dominating anchor counts in the position
+            # domain, reading bytes proportional to cardinality.
+            # Declines (None) fall through to the batched word-domain
+            # path unchanged.  Healthy devices only: a granted probe
+            # must resolve through the guarded launch below.
+            anchored = self._try_anchored_count(index, c, slices)
+            if anchored is not None:
+                return anchored
         ent = self._cached_batch(index, c, slices)
         if ent["batch"] is None:
             if mode == health_mod.MODE_PROBE:

@@ -833,6 +833,10 @@ _compiled_batched = _ProgramCache(_build_batched, "plan.batched")
 _compiled_total_count = _ProgramCache(_build_total_count, "plan.totalCount")
 _compiled_interp = _ProgramCache(_build_interp, "interp")
 _compiled_scatter = _ProgramCache(_build_scatter, "plan.scatter", maxsize=1)
+# Defined before _build_anchored below (builders bind lazily at call).
+_compiled_anchored = _ProgramCache(
+    lambda expr, fmts: _build_anchored(expr, fmts), "plan.anchored"
+)
 
 
 def scatter_apply(plane, slots, words, or_m, andnot_m):
@@ -842,6 +846,92 @@ def scatter_apply(plane, slots, words, or_m, andnot_m):
     # track its highwater so program_cache_bounds stays an invariant.
     _note_bucket("plan.scatter.rows", int(plane.shape[0]))
     return _compiled_scatter()(slots, words, or_m, andnot_m, plane)
+
+
+# ---------------------------------------------------------------------------
+# anchored position-domain count (compressed-plane fast path)
+# ---------------------------------------------------------------------------
+
+def _build_anchored(expr: tuple, fmts: tuple):
+    """Position-domain Count: instead of streaming dense
+    (leaves x 32768)-word rows, evaluate the fold expression POINTWISE
+    over the anchor leaf's sentinel-padded position vector, reading
+    each leaf through its container format directly (ops/bitplane
+    membership_* — dense gather / sparse searchsorted / RLE run
+    search).  Sound whenever the result is a subset of the anchor
+    (executor._anchor_candidates), so the count is just the number of
+    anchor positions whose membership mask survives.
+
+    ``fmts`` is the per-leaf container-format tuple — a compile static
+    (it selects which membership kernel each leaf traces), which is why
+    it is part of the wrapper key.  Inputs are vmapped over a leading
+    slice axis: anchor uint32[S, P], payload i uint32[S, Li] or
+    uint32[S, Ri, 2]; all axes pow2-bucketed by the caller so the jit
+    key stays pure geometry."""
+    from pilosa_tpu.ops import bitplane as bp
+
+    def one(anchor, *payloads):
+        def leaf_mask(i):
+            fmt = fmts[i]
+            if fmt == bp.FMT_DENSE:
+                return bp.membership_dense(payloads[i], anchor)
+            if fmt == bp.FMT_SPARSE:
+                return bp.membership_sparse(payloads[i], anchor)
+            return bp.membership_rle(payloads[i], anchor)
+
+        def rec(e):
+            if e[0] == "leaf":
+                return leaf_mask(e[1])
+            kids = [rec(ch) for ch in e[1:]]
+            if not kids:  # empty Union
+                return jnp.zeros(anchor.shape, dtype=bool)
+            acc = kids[0]
+            for nxt in kids[1:]:
+                if e[0] == "Intersect":
+                    acc = acc & nxt
+                elif e[0] == "Union":
+                    acc = acc | nxt
+                elif e[0] == "Difference":
+                    acc = acc & ~nxt
+                else:  # Xor
+                    acc = acc ^ nxt
+            return acc
+
+        mask = rec(expr)
+        valid = anchor != jnp.uint32(bp.FMT_SENTINEL)
+        return jnp.sum((mask & valid).astype(jnp.int32))
+
+    return jax.jit(jax.vmap(one))
+
+
+def compiled_anchored_count(expr: tuple, fmts: tuple) -> "_Program":
+    """One jitted wrapper per (tree shape, per-leaf container-format
+    tuple); compiled entries inside a wrapper key on (slice bucket,
+    anchor-position bucket, per-leaf payload buckets)."""
+    return _compiled_anchored(expr, fmts)
+
+
+# Largest payload-entry bucket ever dispatched through an anchored
+# launch (anchor vector or any leaf payload) — with the slice axis in
+# _BUCKET_HIGHWATER["plan.anchored"], this derives the family's hard
+# cardinality bound.  Plain dict writes: racing maxima are both valid.
+_ANCHORED_HIGHWATER: dict[str, int] = {}
+
+
+def anchored_count_exec(expr: tuple, fmts: tuple, anchor, payloads):
+    """Dispatch one anchored count launch (slice axis leading,
+    everything pow2-bucketed by the caller), recording the payload
+    high-waters program_cache_bounds derives from.  Returns int32[S]
+    per-slice counts."""
+    hw = max(
+        max((int(p.shape[1]) for p in payloads), default=1),
+        int(anchor.shape[1]),
+    )
+    if hw > _ANCHORED_HIGHWATER.get("payload", 0):
+        _ANCHORED_HIGHWATER["payload"] = hw
+    if len(fmts) > _ANCHORED_HIGHWATER.get("leaves", 0):
+        _ANCHORED_HIGHWATER["leaves"] = len(fmts)
+    return _compiled_anchored(expr, fmts)(anchor, *payloads)
 
 
 # ---------------------------------------------------------------------------
@@ -907,6 +997,13 @@ def program_cache_stats() -> dict[str, int]:
         ),
         "plan.scatter": sum(
             _jit_cache_size(p.fn) for p in _compiled_scatter.programs()
+        ),
+        "plan.anchored": sum(
+            _jit_cache_size(p.fn) for p in _compiled_anchored.programs()
+        ),
+        "bitplane.expand": (
+            _jit_cache_size(bp._expand_sparse_xla)
+            + _jit_cache_size(bp._expand_rle_xla)
         ),
         "bitplane.scorePlanes": (
             _jit_cache_size(bp._score_planes_self_src)
@@ -991,6 +1088,38 @@ def program_cache_bounds() -> dict[str, int]:
         "bitplane.topCounts": bp.bucket_classes(
             max(hw.get("top_rows", rb), rb), rb
         ),
+        # (tree shape x container-format tuple) wrappers x slice-bucket
+        # classes x payload-length bucket classes raised to the leaf
+        # count — the container-length bucketing rule: every anchor /
+        # payload axis pads to payload_bucket (floor
+        # PAYLOAD_BUCKET_FLOOR), so per-leaf length variation compiles
+        # at most one entry per bucket class, and format variation
+        # lands in DISTINCT wrappers (counted by currsize), never in
+        # unbounded jit keys.
+        "plan.anchored": (
+            _compiled_anchored.cache_info().currsize
+            * slice_classes("plan.anchored")
+            * bp.bucket_classes(
+                max(
+                    _ANCHORED_HIGHWATER.get(
+                        "payload", bp.PAYLOAD_BUCKET_FLOOR
+                    ),
+                    bp.PAYLOAD_BUCKET_FLOOR,
+                ),
+                bp.PAYLOAD_BUCKET_FLOOR,
+            )
+            # +1: the anchor-position axis keys alongside the per-leaf
+            # payload axes.
+            ** (max(_ANCHORED_HIGHWATER.get("leaves", 1), 1) + 1)
+        ),
+        # (sparse + rle) expansion wrappers x payload bucket classes
+        "bitplane.expand": 2 * bp.bucket_classes(
+            max(
+                hw.get("expand_payload", bp.PAYLOAD_BUCKET_FLOOR),
+                bp.PAYLOAD_BUCKET_FLOOR,
+            ),
+            bp.PAYLOAD_BUCKET_FLOOR,
+        ),
     }
 
 
@@ -1010,8 +1139,10 @@ def clear_program_caches() -> None:
     _compiled_total_count.cache_clear()
     _compiled_interp.cache_clear()
     _compiled_scatter.cache_clear()
+    _compiled_anchored.cache_clear()
     _BUCKET_HIGHWATER.clear()
     _INTERP_HIGHWATER.clear()
+    _ANCHORED_HIGHWATER.clear()
     _COMPILE_MS.clear()
     bp._SHAPE_HIGHWATER.clear()
     for fn in (
@@ -1019,6 +1150,8 @@ def clear_program_caches() -> None:
         bp._score_planes_host_src,
         bp._fused_count_xla,
         bp._top_counts_xla,
+        bp._expand_sparse_xla,
+        bp._expand_rle_xla,
     ):
         try:
             fn.clear_cache()

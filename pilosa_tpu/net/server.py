@@ -63,6 +63,9 @@ class Server:
         quarantine_threshold: int = 3,
         quarantine_open_ms: float = 10_000.0,
         quarantine_probe_successes: int = 1,
+        plane_format: str = "auto",
+        plane_sparse_max_bytes: int = 65536,
+        plane_rle_max_bytes: int = 65536,
         coalesce: bool = True,
         coalesce_max_batch: int = 64,
         coalesce_max_wait_us: int = 0,
@@ -153,6 +156,12 @@ class Server:
         self.device_stage = device_stage
         self.stage_throttle_ms = stage_throttle_ms
         self.staging_job = None
+        # Compressed device planes ([device] plane-format / plane-*-max-
+        # bytes, ops/bitplane.encode_row): per-row container format
+        # selection on the device.  Process-global, applied at open().
+        self.plane_format = plane_format
+        self.plane_sparse_max_bytes = plane_sparse_max_bytes
+        self.plane_rle_max_bytes = plane_rle_max_bytes
         # Device-fault tolerance ([device] launch-watchdog-ms /
         # quarantine-*, device/health.py): per-device + collective-path
         # quarantine state machine with half-open probes, and the
@@ -424,6 +433,15 @@ class Server:
         from pilosa_tpu.ingest import wal as wal_mod
 
         scatter_mod.ENABLED = bool(self.ingest_scatter)
+        # Compressed device planes: flip the module-level format policy
+        # before any fragment encodes a payload.
+        from pilosa_tpu.ops import bitplane as bp_mod
+
+        bp_mod.configure_plane_format(
+            mode=self.plane_format,
+            sparse_max_bytes=self.plane_sparse_max_bytes,
+            rle_max_bytes=self.plane_rle_max_bytes,
+        )
         if self.ingest_wal:
             self.ingest = wal_mod.IngestManager(
                 self.data_dir,

@@ -84,6 +84,7 @@ class LaunchRecord(dict):
         queries: int = 1,
         rows: int = 0,
         n_bytes: int = 0,
+        eff_bytes: int = 0,
         dispatch_ms: float = 0.0,
         total_ms: float = 0.0,
         trace_id: str = "",
@@ -94,6 +95,10 @@ class LaunchRecord(dict):
             queries=int(queries),
             rows=int(rows),
             bytes=int(n_bytes),
+            # Effective bytes actually read by the launch — smaller
+            # than the logical geometry for compressed-container
+            # launches; defaults to logical for dense launches.
+            eff_bytes=int(eff_bytes) or int(n_bytes),
             dispatch_ms=round(float(dispatch_ms), 3),
             total_ms=round(float(total_ms), 3),
             trace_id=trace_id,
@@ -105,7 +110,7 @@ class _Site:
     called while holding it."""
 
     __slots__ = (
-        "lock", "launches", "queries", "rows", "n_bytes",
+        "lock", "launches", "queries", "rows", "n_bytes", "eff_bytes",
         "dispatch_ms", "total_ms", "window", "reduces",
     )
 
@@ -115,6 +120,7 @@ class _Site:
         self.queries = 0
         self.rows = 0
         self.n_bytes = 0
+        self.eff_bytes = 0
         self.dispatch_ms = 0.0
         self.total_ms = 0.0
         self.window: deque = deque(maxlen=WINDOW)
@@ -177,12 +183,16 @@ class PerfRegistry:
         queries: int = 1,
         rows: int = 0,
         n_bytes: int = 0,
+        eff_bytes: int = 0,
         dispatch_ms: float = 0.0,
         total_ms: float = 0.0,
         trace_id: str = "",
     ) -> None:
         if not self._enabled:
             return
+        # Dense launches read exactly their logical geometry; only the
+        # compressed-container sites pass a smaller eff_bytes.
+        eff = eff_bytes or n_bytes
         st = self._sites.get(site)
         if st is None:
             with self._mu:
@@ -192,6 +202,7 @@ class PerfRegistry:
             st.queries += queries
             st.rows += rows
             st.n_bytes += n_bytes
+            st.eff_bytes += eff
             st.dispatch_ms += dispatch_ms
             st.total_ms += total_ms
             st.window.append(total_ms)
@@ -204,7 +215,7 @@ class PerfRegistry:
         with self._mu:
             self._recent.append(
                 (site, reduce, queries, rows, n_bytes,
-                 dispatch_ms, total_ms, trace_id)
+                 dispatch_ms, total_ms, trace_id, eff)
             )
 
     # -- derived views -------------------------------------------------
@@ -224,25 +235,33 @@ class PerfRegistry:
                 queries = st.queries
                 rows = st.rows
                 n_bytes = st.n_bytes
+                eff_bytes = st.eff_bytes
                 dispatch_ms = st.dispatch_ms
                 total_ms = st.total_ms
                 window = sorted(st.window)
                 reduces = dict(st.reduces)
             device_s = total_ms / 1e3
             gbps = (n_bytes / 1e9 / device_s) if device_s > 0 else 0.0
+            eff_gbps = (eff_bytes / 1e9 / device_s) if device_s > 0 else 0.0
             row = {
                 "launches": launches,
                 "queries": queries,
                 "rows": rows,
                 "bytes": n_bytes,
+                "eff_bytes": eff_bytes,
                 "occupancy": round(queries / launches, 2) if launches else 0.0,
                 "dispatch_ms": round(dispatch_ms, 3),
                 "device_ms": round(total_ms, 3),
                 "gbps": round(gbps, 3),
+                "eff_gbps": round(eff_gbps, 3),
                 "reduces": reduces,
             }
             if floor > 0:
-                row["floor_pct"] = round(100.0 * gbps / floor, 1)
+                # %-of-floor from EFFECTIVE bytes: a compressed launch
+                # reading 1% of its logical geometry must not claim the
+                # logical GB/s against the stream floor.  Dense sites
+                # (eff == logical) are unchanged.
+                row["floor_pct"] = round(100.0 * eff_gbps / floor, 1)
             if window:
                 row["p50_ms"] = round(_percentile(window, 0.5), 3)
                 row["p99_ms"] = round(_percentile(window, 0.99), 3)
@@ -251,7 +270,7 @@ class PerfRegistry:
             LaunchRecord(
                 t[0], reduce=t[1], queries=t[2], rows=t[3],
                 n_bytes=t[4], dispatch_ms=t[5], total_ms=t[6],
-                trace_id=t[7],
+                trace_id=t[7], eff_bytes=t[8],
             )
             for t in sorted(recent, key=lambda t: t[6], reverse=True)[:SLOWEST]
         ]
@@ -272,10 +291,12 @@ class PerfRegistry:
             out["device.streamFloorGbps"] = snap["floor_gbps"]
         for site, row in snap["sites"].items():
             out[f"exec.launch.gbps[site:{site}]"] = row["gbps"]
+            out[f"exec.launch.effGbps[site:{site}]"] = row["eff_gbps"]
             if "floor_pct" in row:
                 out[f"exec.launch.floorPct[site:{site}]"] = row["floor_pct"]
             out[f"exec.launch.launches[site:{site}]"] = row["launches"]
             out[f"exec.launch.bytes[site:{site}]"] = row["bytes"]
+            out[f"exec.launch.effBytes[site:{site}]"] = row["eff_bytes"]
         return out
 
 

@@ -280,21 +280,300 @@ def home_device(slice_i: int):
     return devs[slice_i % len(devs)]
 
 
-# There is NO handwritten-Pallas variant of these kernels: two rounds
-# of measurement on real v5e hardware killed it.  The r02 tile-naive
-# kernels measured 4x slower than XLA's fused popcount+reduce; the r03
-# restructured kernels (tile-aligned (8,128) lane partials) measured
-# 0.068x plain XLA (7.5 ms vs 0.51 ms per 1B-column fused
-# Intersect+Count, fetch-folded slope methodology, tools/cache_probe.py).
-# XLA already emits a single fused bitwise+popcount+reduce pass at
-# ~490 GB/s ≈ 60% of v5e HBM peak; a hand kernel has no headroom worth
-# its maintenance, so the experiment ended per the promote-or-delete
-# bar (BASELINE.md "Pallas keep-or-kill").
+# ---------------------------------------------------------------------------
+# Per-plane-row container formats (the on-device roaring analog,
+# ROADMAP item 2).  A sparse-tier row is encoded at write time into
+# the cheapest of three layouts — mirroring the reference's
+# dense-bitmap / sorted-array / run containers, selected by density
+# (reference: roaring.go container conversion thresholds):
+#
+#   FMT_DENSE   uint32[WORDS_PER_SLICE] words           128 KiB always
+#   FMT_SPARSE  sorted uint32 positions                 4 B / position
+#   FMT_RLE     sorted (start, end) uint32 runs         8 B / run
+#
+# Sparse and RLE payloads are sentinel-padded (FMT_SENTINEL, which is
+# > any slice position) up to their pow2 payload bucket so compiled
+# programs key on a bounded bucket grid, never on raw cardinality.
+# The fused kernels consume the payloads DIRECTLY (membership_* below
+# gather against the compressed layout); dense expansion exists only
+# as a transient for paths that must stack whole rows.
+# ---------------------------------------------------------------------------
+
+FMT_DENSE = 0
+FMT_SPARSE = 1
+FMT_RLE = 2
+FMT_NAMES = {FMT_DENSE: "dense", FMT_SPARSE: "sparse", FMT_RLE: "rle"}
+
+# Padding sentinel: all-ones is > any real position (< SLICE_WIDTH =
+# 2^20) and sorts after every real payload entry.
+FMT_SENTINEL = 0xFFFFFFFF
+
+# Floor of the payload pow2 bucket grid (64 positions = 256 B, 64 runs
+# = 512 B): tiny rows share one bucket instead of spraying compiles.
+PAYLOAD_BUCKET_FLOOR = 64
+
+# ``[device] plane-format``: "auto" selects per row by encoded bytes,
+# "dense" disables compression (the contrast arm and the escape hatch).
+# Set by Server.open from config; module-level like scatter.ENABLED so
+# fragments see it without per-fragment plumbing.
+PLANE_FORMAT = "auto"
+
+# Per-row encoded-size caps ([device] plane-sparse-max-bytes /
+# plane-rle-max-bytes): a format is eligible only while its BUCKETED
+# payload fits the cap — the roaring "array container only below 4096
+# entries" rule, expressed in bytes.  Default half a dense row, so any
+# compressed row is at least a 2x save.
+SPARSE_MAX_BYTES = 65536
+RLE_MAX_BYTES = 65536
+
+
+def configure_plane_format(
+    mode: str | None = None,
+    sparse_max_bytes: int | None = None,
+    rle_max_bytes: int | None = None,
+) -> None:
+    """Apply ``[device] plane-format`` / threshold config process-wide
+    (Server.open; tests and the sparse bench flip it for contrast
+    arms).  Selection is write-time only: already-encoded device
+    payloads keep their format until invalidated."""
+    global PLANE_FORMAT, SPARSE_MAX_BYTES, RLE_MAX_BYTES
+    if mode is not None:
+        if mode not in ("auto", "dense"):
+            raise ValueError(f"unknown plane-format {mode!r}")
+        PLANE_FORMAT = mode
+    if sparse_max_bytes is not None:
+        SPARSE_MAX_BYTES = max(0, int(sparse_max_bytes))
+    if rle_max_bytes is not None:
+        RLE_MAX_BYTES = max(0, int(rle_max_bytes))
+
+
+def payload_bucket(n: int) -> int:
+    """Pow2 payload-length bucket (entries, not bytes) with the shared
+    floor — the container-length shape class compiled programs key on."""
+    return pow2_bucket(n, PAYLOAD_BUCKET_FLOOR)
+
+
+def np_positions_to_runs(offsets: np.ndarray) -> np.ndarray:
+    """Sorted positions -> (R, 2) uint32 half-open maximal runs."""
+    o = np.asarray(offsets, dtype=np.uint32)
+    if len(o) == 0:
+        return np.zeros((0, 2), dtype=np.uint32)
+    brk = np.nonzero(np.diff(o) != 1)[0]
+    starts = o[np.concatenate(([0], brk + 1))]
+    ends = o[np.concatenate((brk, [len(o) - 1]))].astype(np.uint64) + 1
+    return np.stack([starts, ends.astype(np.uint32)], axis=1)
+
+
+def encode_row(offsets: np.ndarray) -> tuple[int, np.ndarray, int]:
+    """Write-time format selection for one sparse-tier row: encode the
+    sorted in-slice positions into the cheapest eligible container and
+    return ``(fmt, payload, encoded_nbytes)``.  Deterministic: minimum
+    bucketed bytes wins, ties broken toward the lower format tag
+    (dense < sparse < rle)."""
+    offs = np.asarray(offsets, dtype=np.uint32)
+    card = len(offs)
+    dense_b = WORDS_PER_SLICE * 4
+    cands = [(dense_b, FMT_DENSE)]
+    if PLANE_FORMAT != "dense":
+        sparse_b = 4 * payload_bucket(card)
+        if sparse_b < dense_b and sparse_b <= SPARSE_MAX_BYTES:
+            cands.append((sparse_b, FMT_SPARSE))
+        runs = np_positions_to_runs(offs)
+        rle_b = 8 * payload_bucket(len(runs))
+        if rle_b < dense_b and rle_b <= RLE_MAX_BYTES:
+            cands.append((rle_b, FMT_RLE))
+    nbytes, fmt = min(cands)
+    if fmt == FMT_SPARSE:
+        payload = np.full(payload_bucket(card), FMT_SENTINEL, dtype=np.uint32)
+        payload[:card] = offs
+    elif fmt == FMT_RLE:
+        runs = np_positions_to_runs(offs)
+        payload = np.full(
+            (payload_bucket(len(runs)), 2), FMT_SENTINEL, dtype=np.uint32
+        )
+        payload[: len(runs)] = runs
+    else:
+        payload = np_columns_to_row(offs)
+    return fmt, payload, nbytes
+
+
+def decode_payload(fmt: int, payload: np.ndarray) -> np.ndarray:
+    """Host inverse of encode_row: any container payload -> dense row
+    words (the byte-identity oracle for the codec tests)."""
+    if fmt == FMT_DENSE:
+        return np.asarray(payload, dtype=np.uint32)
+    if fmt == FMT_SPARSE:
+        p = np.asarray(payload, dtype=np.uint32)
+        return np_columns_to_row(p[p != np.uint32(FMT_SENTINEL)])
+    if fmt == FMT_RLE:
+        p = np.asarray(payload, dtype=np.uint32).reshape(-1, 2)
+        real = p[p[:, 0] != np.uint32(FMT_SENTINEL)]
+        if len(real) == 0:
+            return empty_row()
+        pos = np.concatenate(
+            [np.arange(s, e, dtype=np.uint32) for s, e in real]
+        )
+        return np_columns_to_row(pos)
+    raise ValueError(f"unknown container format {fmt!r}")
+
+
+# --- format-aware membership kernels ---------------------------------------
+# Each takes one row's payload plus a sentinel-padded uint32 position
+# vector and answers "is position p set?" per lane, reading only the
+# compressed layout.  Sentinel lanes may answer garbage (the sparse
+# kernel answers True: sentinel == sentinel pad); callers mask invalid
+# lanes before reducing.  These are traced inside plan's anchored
+# programs (vmapped over the slice axis), never jitted standalone.
+
+
+def membership_dense(row, pos):
+    w = jnp.minimum(
+        pos >> jnp.uint32(5), jnp.uint32(WORDS_PER_SLICE - 1)
+    ).astype(jnp.int32)
+    return ((row[w] >> (pos & jnp.uint32(31))) & jnp.uint32(1)).astype(bool)
+
+
+def membership_sparse(payload, pos):
+    i = jnp.searchsorted(payload, pos)
+    i = jnp.minimum(i, payload.shape[0] - 1)
+    return payload[i] == pos
+
+
+def membership_rle(payload, pos):
+    starts = payload[:, 0]
+    i = jnp.searchsorted(starts, pos, side="right").astype(jnp.int32) - 1
+    ic = jnp.maximum(i, 0)
+    return (i >= 0) & (pos < payload[ic, 1])
+
+
+# --- transient dense expansion ---------------------------------------------
+# For paths that must stack whole rows (the mesh gather path batches
+# device_row results into dense leaf stacks), a resident compressed
+# payload expands on device in one jitted scatter; the expansion is
+# NEVER cached — the pool holds only the payload bytes.  Compiles key
+# on the payload bucket (bounded grid, see program_cache_bounds).
+
+
+@jax.jit
+def _expand_sparse_xla(payload):
+    idx = (payload >> jnp.uint32(5)).astype(jnp.int32)
+    masks = jnp.uint32(1) << (payload & jnp.uint32(31))
+    # Positions are unique, so per-word masks have disjoint bits and
+    # scatter-add equals scatter-or; sentinel lanes index past the row
+    # and drop.
+    return jnp.zeros(WORDS_PER_SLICE, dtype=jnp.uint32).at[idx].add(
+        masks, mode="drop"
+    )
+
+
+def _rle_lowmask(n):
+    """uint32 mask of the low ``n`` bits, n in [0, 32]."""
+    n32 = n.astype(jnp.uint32)
+    return jnp.where(
+        n32 >= jnp.uint32(32),
+        jnp.uint32(0xFFFFFFFF),
+        (jnp.uint32(1) << n32) - jnp.uint32(1),
+    )
+
+
+@jax.jit
+def _expand_rle_xla(payload):
+    s = payload[:, 0]
+    e = payload[:, 1]
+    w0 = (s >> jnp.uint32(5)).astype(jnp.int32)
+    wl = ((e - jnp.uint32(1)) >> jnp.uint32(5)).astype(jnp.int32)
+    b0 = s & jnp.uint32(31)
+    bl = (e - jnp.uint32(1)) & jnp.uint32(31)
+    same = w0 == wl
+    # Boundary-word masks; runs are disjoint and maximal so masks
+    # landing in a shared word have disjoint bits (add == or).
+    # Sentinel runs (start == end == FMT_SENTINEL) produce zero masks
+    # and out-of-range indices, which drop.
+    m0 = _rle_lowmask(
+        jnp.where(same, bl + jnp.uint32(1), jnp.uint32(32))
+    ) & ~_rle_lowmask(b0)
+    ml = jnp.where(same, jnp.uint32(0), _rle_lowmask(bl + jnp.uint32(1)))
+    row = jnp.zeros(WORDS_PER_SLICE, dtype=jnp.uint32)
+    row = row.at[w0].add(m0, mode="drop")
+    row = row.at[wl].add(ml, mode="drop")
+    # Interior full words via a +1/-1 difference array over word index.
+    has_interior = (wl > w0 + 1).astype(jnp.int32)
+    d = jnp.zeros(WORDS_PER_SLICE + 1, dtype=jnp.int32)
+    d = d.at[w0 + 1].add(has_interior, mode="drop")
+    d = d.at[wl].add(-has_interior, mode="drop")
+    cover = jnp.cumsum(d)[:WORDS_PER_SLICE] > 0
+    return row | jnp.where(cover, jnp.uint32(0xFFFFFFFF), jnp.uint32(0))
+
+
+def expand_payload(fmt: int, payload):
+    """Transient dense expansion of a device-resident compressed
+    payload (mesh gather path).  FMT_DENSE payloads pass through."""
+    if fmt == FMT_DENSE:
+        return payload
+    _note_shape(expand_payload=int(payload.shape[0]))
+    if fmt == FMT_SPARSE:
+        return _expand_sparse_xla(payload)
+    if fmt == FMT_RLE:
+        return _expand_rle_xla(payload)
+    raise ValueError(f"unknown container format {fmt!r}")
+
+
+# Pallas history (BASELINE.md "Pallas keep-or-kill"): the r02 tile-naive
+# kernels measured 4x slower than XLA's fused popcount+reduce and the
+# r03 restructured kernels (tile-aligned (8,128) lane partials) measured
+# 0.068x plain XLA, so the experiment was deleted.  BENCH_r05 then
+# showed the XLA path itself leaving bandwidth on the table (raw
+# and+popcount 390.5 GB/s = 64.8% of the measured 602.8 GB/s stream
+# floor), which re-chartered the attempt with two specific fixes the
+# killed kernels lacked (ROADMAP item 2): (a) the reduce is
+# restructured into per-chunk int32 limb partials so the accumulator
+# lives in registers instead of a materialized full-size popcount
+# array, and (b) the hand kernel keeps whole 128 KiB slice-rows per
+# VMEM block (grid-pipelined HBM->VMEM double buffering) rather than
+# (8,128) lane tiles.  The Pallas variant engages ONLY where the
+# backend supports it (TPU, or forced via DENSE_KERNEL) and any
+# lowering failure permanently falls back to XLA for the process —
+# CPU/GPU and older jaxlibs never see it.
+
+# "auto" = Pallas on TPU backends, XLA elsewhere; "xla" / "pallas"
+# force one path (bench contrast arms; PILOSA_DENSE_KERNEL env via
+# Server wiring is not needed — this is a perf toggle, not semantics).
+DENSE_KERNEL = "auto"
+_PALLAS_FAILED = False
+
+# Words per limb partial in the restructured count reduce: one roaring
+# container (2048 words = 2^16 bits) per int32 partial keeps every
+# accumulator exact and register-resident.
+_COUNT_CHUNK = WORDS_PER_CONTAINER
+
+# Slice-rows per Pallas VMEM block: 8 x 128 KiB x 2 operands = 2 MiB
+# resident per grid step, well under v5e's ~16 MiB VMEM with double
+# buffering.
+_PALLAS_TILE_ROWS = 8
+
+
+def _popcount_sum_chunked(words: jnp.ndarray) -> jnp.ndarray:
+    """Restructured popcount reduce: per-chunk int32 limb partials
+    (each <= 2^16 bits, register-accumulated) then one small partial
+    sum — no full-size popcount intermediate between the bitwise op
+    and the reduce.  Falls back to the flat reduce for shapes that
+    don't tile by _COUNT_CHUNK (tiny probe arrays)."""
+    flat = words.reshape(-1)
+    n = flat.shape[0]
+    if n <= _COUNT_CHUNK or n % _COUNT_CHUNK:
+        return _popcount_sum(flat)
+    limbs = jnp.sum(
+        jax.lax.population_count(flat.reshape(-1, _COUNT_CHUNK)).astype(
+            jnp.int32
+        ),
+        axis=1,
+    )
+    return jnp.sum(limbs)
 
 
 @jax.jit
 def _count_xla(words):
-    return _popcount_sum(words)
+    return _popcount_sum_chunked(words)
 
 
 def count(words):
@@ -305,17 +584,83 @@ def count(words):
 @functools.partial(jax.jit, static_argnames=("op",))
 def _fused_count_xla(a, b, op):
     if op == "and":
-        return _popcount_sum(a & b)
+        return _popcount_sum_chunked(a & b)
     if op == "or":
-        return _popcount_sum(a | b)
+        return _popcount_sum_chunked(a | b)
     if op == "xor":
-        return _popcount_sum(a ^ b)
+        return _popcount_sum_chunked(a ^ b)
     if op == "andnot":
-        return _popcount_sum(a & ~b)
+        return _popcount_sum_chunked(a & ~b)
     raise ValueError(f"unknown fused-count op {op!r}")
 
 
+def _pallas_count_kernel(op: str):
+    def kernel(a_ref, b_ref, o_ref):
+        a = a_ref[...]
+        b = b_ref[...]
+        if op == "and":
+            x = a & b
+        elif op == "or":
+            x = a | b
+        elif op == "xor":
+            x = a ^ b
+        else:
+            x = a & ~b
+        o_ref[0, 0] = jnp.sum(jax.lax.population_count(x).astype(jnp.int32))
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("op",))
+def _fused_count_pallas(a, b, op):
+    """Hand-written and+popcount reduce: whole slice-rows stream
+    HBM->VMEM per grid step (Pallas double-buffers the blocks), the
+    bitwise op + popcount + block reduce run on the resident block,
+    and one int32 partial per step lands in HBM.  Raises for shapes
+    that don't tile into whole slice-rows — the caller falls back."""
+    from jax.experimental import pallas as pl
+
+    n = a.size
+    if n % WORDS_PER_SLICE:
+        raise ValueError("pallas count needs whole slice-rows")
+    rows = n // WORDS_PER_SLICE
+    tile = min(_PALLAS_TILE_ROWS, rows)
+    if rows % tile:
+        raise ValueError("pallas count needs a row multiple of the tile")
+    a2 = a.reshape(rows, WORDS_PER_SLICE)
+    b2 = b.reshape(rows, WORDS_PER_SLICE)
+    grid = rows // tile
+    partials = pl.pallas_call(
+        _pallas_count_kernel(op),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((tile, WORDS_PER_SLICE), lambda i: (i, 0)),
+            pl.BlockSpec((tile, WORDS_PER_SLICE), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((grid, 1), jnp.int32),
+    )(a2, b2)
+    return jnp.sum(partials)
+
+
+def _use_pallas() -> bool:
+    if DENSE_KERNEL == "xla" or _PALLAS_FAILED:
+        return False
+    if DENSE_KERNEL == "pallas":
+        return True
+    return jax.default_backend() == "tpu"
+
+
 def _fused_count(a, b, op):
+    global _PALLAS_FAILED
+    if _use_pallas():
+        try:
+            return _fused_count_pallas(a, b, op)
+        except Exception:  # noqa: BLE001 — lowering/backend failure
+            # One-time demotion: the XLA path is byte-identical, so a
+            # backend that can't lower the hand kernel silently keeps
+            # the fallback for the rest of the process.
+            _PALLAS_FAILED = True
     return _fused_count_xla(a, b, op)
 
 

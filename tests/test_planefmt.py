@@ -1,0 +1,475 @@
+"""Compressed device planes (PR 19): container codecs, format-aware
+membership/expansion kernels, the anchored position-domain count route,
+and the program-cache bounds that keep its jit keys pure geometry.
+
+The acceptance bar is byte-identity everywhere: every container format
+must answer exactly like the dense path and a numpy set oracle across
+the full PQL storm, through rows that straddle the format thresholds
+and rows mutated across formats by set/clear writes.
+"""
+
+import numpy as np
+import pytest
+
+import pilosa_tpu.core.fragment as fr
+from pilosa_tpu.cluster.topology import new_cluster
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.exec import Executor, plan
+from pilosa_tpu.ops import bitplane as bp
+from pilosa_tpu.pql.parser import parse_string
+
+SW = bp.SLICE_WIDTH
+
+
+@pytest.fixture
+def holder(tmp_path):
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    yield h
+    h.close()
+
+
+@pytest.fixture
+def sparse_tier(monkeypatch):
+    """Force every fragment into the sparse tier (dense budget 0), the
+    placement where compressed device formats engage."""
+    orig = fr.Fragment.__init__
+
+    def zero_budget(self, *a, **kw):
+        kw.setdefault("dense_row_budget", 0)
+        orig(self, *a, **kw)
+
+    monkeypatch.setattr(fr.Fragment, "__init__", zero_budget)
+
+
+@pytest.fixture(autouse=True)
+def auto_format():
+    """Every test starts from the default policy and restores it."""
+    bp.configure_plane_format(
+        mode="auto", sparse_max_bytes=65536, rle_max_bytes=65536
+    )
+    yield
+    bp.configure_plane_format(
+        mode="auto", sparse_max_bytes=65536, rle_max_bytes=65536
+    )
+
+
+def _clustered(rng, card, runs=8):
+    run_len = max(1, card // runs)
+    cols = set()
+    for st in rng.choice(SW - run_len, size=runs, replace=False):
+        cols.update(range(int(st), int(st) + run_len))
+    return cols
+
+
+def _scattered(rng, card):
+    return {int(p) for p in rng.choice(SW, size=card, replace=False)}
+
+
+# ---------------------------------------------------------------------------
+# codec roundtrips
+# ---------------------------------------------------------------------------
+
+
+def test_encode_decode_roundtrip_randomized(rng):
+    """encode_row -> decode_payload is the identity for every format the
+    selector picks, across the density spectrum."""
+    cases = [
+        np.array([], dtype=np.uint32),
+        np.array([0], dtype=np.uint32),
+        np.array([SW - 1], dtype=np.uint32),
+        np.arange(SW, dtype=np.uint32),  # full slice: one run
+    ]
+    for card in (3, 77, 1000, 10_000, 60_000, 200_000):
+        cases.append(
+            np.array(sorted(_scattered(rng, card)), dtype=np.uint32)
+        )
+        cases.append(
+            np.array(sorted(_clustered(rng, card)), dtype=np.uint32)
+        )
+    seen_fmts = set()
+    for offs in cases:
+        fmt, payload, nbytes = bp.encode_row(offs)
+        seen_fmts.add(fmt)
+        # decode_payload is the host oracle: payload -> dense row words
+        back = bp.np_row_to_columns(bp.decode_payload(fmt, payload))
+        np.testing.assert_array_equal(back, offs.astype(np.uint64))
+        assert nbytes == payload.nbytes
+    assert seen_fmts == {bp.FMT_DENSE, bp.FMT_SPARSE, bp.FMT_RLE}
+
+
+def test_forced_dense_mode_disables_compression(rng):
+    bp.configure_plane_format(mode="dense")
+    offs = np.array(sorted(_clustered(rng, 500)), dtype=np.uint32)
+    fmt, payload, nbytes = bp.encode_row(offs)
+    assert fmt == bp.FMT_DENSE
+    assert nbytes == bp.WORDS_PER_SLICE * 4
+
+
+def test_threshold_straddle_rows(rng):
+    """Rows straddling the sparse-vs-dense byte threshold flip format
+    exactly at the configured ceiling."""
+    # 4 * pow2_bucket(card) must be < 128 KiB AND <= SPARSE_MAX_BYTES
+    # for the position format; a scattered row of 16384 positions costs
+    # exactly 64 KiB, one of 16385 rounds to 128 KiB and stays dense.
+    under = np.array(sorted(_scattered(rng, 16384)), dtype=np.uint32)
+    fmt_u, _, nb_u = bp.encode_row(under)
+    assert (fmt_u, nb_u) == (bp.FMT_SPARSE, 65536)
+    over = np.array(sorted(_scattered(rng, 16385)), dtype=np.uint32)
+    fmt_o, _, nb_o = bp.encode_row(over)
+    assert fmt_o == bp.FMT_DENSE
+    # Tightening the ceiling reclassifies the under row too.
+    bp.configure_plane_format(sparse_max_bytes=32768)
+    fmt_t, _, _ = bp.encode_row(under)
+    assert fmt_t == bp.FMT_DENSE
+
+
+def test_rle_ceiling_falls_back(rng):
+    """Past rle-max-bytes, a clustered row degrades to sparse/dense
+    instead of an oversized run payload."""
+    cols = np.array(sorted(_clustered(rng, 4000, runs=1000)), dtype=np.uint32)
+    fmt, _, _ = bp.encode_row(cols)
+    assert fmt == bp.FMT_RLE
+    bp.configure_plane_format(rle_max_bytes=1024)
+    fmt2, _, _ = bp.encode_row(cols)
+    assert fmt2 != bp.FMT_RLE
+
+
+# ---------------------------------------------------------------------------
+# membership + expansion vs numpy
+# ---------------------------------------------------------------------------
+
+
+def test_membership_kernels_vs_numpy(rng):
+    import jax.numpy as jnp
+
+    for maker, card in (
+        (_scattered, 900),
+        (_clustered, 3000),
+        (_scattered, 31),
+    ):
+        cols = maker(rng, card)
+        offs = np.array(sorted(cols), dtype=np.uint32)
+        probe = np.array(
+            sorted(
+                set(rng.choice(SW, size=512).tolist())
+                | set(list(cols)[:64])
+            ),
+            dtype=np.uint32,
+        )
+        want = np.array([int(p) in cols for p in probe])
+        dense = np.zeros(bp.WORDS_PER_SLICE, dtype=np.uint32)
+        for p in offs:
+            dense[p >> 5] |= np.uint32(1) << np.uint32(p & 31)
+        got_d = np.asarray(
+            bp.membership_dense(jnp.asarray(dense), jnp.asarray(probe))
+        )
+        np.testing.assert_array_equal(got_d, want)
+        for fmt, payload, _nb in (
+            bp.encode_row(offs),
+        ):
+            if fmt == bp.FMT_SPARSE:
+                got = np.asarray(
+                    bp.membership_sparse(
+                        jnp.asarray(payload), jnp.asarray(probe)
+                    )
+                )
+            elif fmt == bp.FMT_RLE:
+                got = np.asarray(
+                    bp.membership_rle(
+                        jnp.asarray(payload), jnp.asarray(probe)
+                    )
+                )
+            else:
+                continue
+            np.testing.assert_array_equal(got, want)
+
+
+def test_expand_payload_vs_numpy(rng):
+    cases = [
+        np.array([], dtype=np.uint32),
+        np.array([0, 31, 32, SW - 1], dtype=np.uint32),
+        np.arange(SW, dtype=np.uint32),  # full slice
+        np.array(sorted(_scattered(rng, 5000)), dtype=np.uint32),
+        np.array(sorted(_clustered(rng, 5000)), dtype=np.uint32),
+    ]
+    for offs in cases:
+        dense = np.zeros(bp.WORDS_PER_SLICE, dtype=np.uint32)
+        for p in offs:
+            dense[p >> 5] |= np.uint32(1) << np.uint32(p & 31)
+        fmt, payload, _nb = bp.encode_row(offs)
+        got = np.asarray(bp.expand_payload(fmt, payload))
+        np.testing.assert_array_equal(got, dense)
+
+
+# ---------------------------------------------------------------------------
+# anchored count through the executor vs the host oracle
+# ---------------------------------------------------------------------------
+
+
+def _corpus(holder, rng, n_rows=6, slices=2, card=2000):
+    idx = holder.create_index_if_not_exists("i")
+    f = idx.create_frame_if_not_exists("f")
+    f.set_options(range_enabled=True)
+    if f.bsi_field("v") is None:
+        f.create_field("v", 0, 500)
+    oracle = {}
+    rows_in, cols_in = [], []
+    for row in range(n_rows):
+        cols = set()
+        for s in range(slices):
+            part = (
+                _scattered(rng, card) if row % 3 == 1
+                else _clustered(rng, card)
+            )
+            cols.update(p + s * SW for p in part)
+        oracle[row] = cols
+        for c in sorted(cols):
+            rows_in.append(row)
+            cols_in.append(c)
+    f.import_bulk(rows_in, cols_in)
+    vcols = sorted(oracle[0])[:300]
+    f.import_value("v", vcols, [c % 500 for c in vcols])
+    return f, oracle
+
+
+def test_anchored_count_matches_oracle(sparse_tier, holder, rng):
+    _f, oracle = _corpus(holder, rng)
+    c = new_cluster(1)
+    ex = Executor(holder, host=c.nodes[0].host, cluster=c)
+    plan.clear_program_caches()
+    for a in range(6):
+        b = (a + 1) % 6
+        d = (a + 2) % 6
+        for pql, want in (
+            (
+                f"Count(Intersect(Bitmap(rowID={a}, frame=f),"
+                f" Bitmap(rowID={b}, frame=f)))",
+                len(oracle[a] & oracle[b]),
+            ),
+            (
+                f"Count(Difference(Bitmap(rowID={a}, frame=f),"
+                f" Bitmap(rowID={b}, frame=f)))",
+                len(oracle[a] - oracle[b]),
+            ),
+            (
+                f"Count(Intersect(Bitmap(rowID={a}, frame=f),"
+                f" Union(Bitmap(rowID={b}, frame=f),"
+                f" Bitmap(rowID={d}, frame=f))))",
+                len(oracle[a] & (oracle[b] | oracle[d])),
+            ),
+        ):
+            (got,) = ex.execute("i", parse_string(pql), None, None)
+            assert int(got) == want, pql
+    # the route actually engaged (not the word-domain fallback)
+    assert plan.program_cache_stats().get("plan.anchored", 0) > 0
+
+
+def test_absent_row_and_empty_anchor(sparse_tier, holder, rng):
+    _f, oracle = _corpus(holder, rng, n_rows=2, slices=1)
+    c = new_cluster(1)
+    ex = Executor(holder, host=c.nodes[0].host, cluster=c)
+    (got,) = ex.execute(
+        "i",
+        parse_string(
+            "Count(Intersect(Bitmap(rowID=0, frame=f),"
+            " Bitmap(rowID=77, frame=f)))"
+        ),
+        None,
+        None,
+    )
+    assert int(got) == 0
+    (got,) = ex.execute(
+        "i",
+        parse_string(
+            "Count(Intersect(Bitmap(rowID=77, frame=f),"
+            " Bitmap(rowID=0, frame=f)))"
+        ),
+        None,
+        None,
+    )
+    assert int(got) == 0
+
+
+# ---------------------------------------------------------------------------
+# full PQL storm: auto formats vs forced dense vs host oracle
+# ---------------------------------------------------------------------------
+
+
+def _storm(ex, n_rows):
+    out = []
+    for a in range(n_rows):
+        b = (a + 1) % n_rows
+        for pql in (
+            f"Count(Intersect(Bitmap(rowID={a}, frame=f),"
+            f" Bitmap(rowID={b}, frame=f)))",
+            f"Count(Union(Bitmap(rowID={a}, frame=f),"
+            f" Bitmap(rowID={b}, frame=f)))",
+            f"Count(Difference(Bitmap(rowID={a}, frame=f),"
+            f" Bitmap(rowID={b}, frame=f)))",
+        ):
+            (r,) = ex.execute("i", parse_string(pql), None, None)
+            out.append(int(r))
+    (bm,) = ex.execute("i", parse_string("Bitmap(rowID=0, frame=f)"), None, None)
+    out.append(tuple(bm.bits()))
+    (tn,) = ex.execute("i", parse_string("TopN(frame=f, n=4)"), None, None)
+    out.append(tuple((p.id, p.count) for p in tn))
+    (rg,) = ex.execute(
+        "i", parse_string("Range(frame=f, v > 250)"), None, None
+    )
+    out.append(tuple(rg.bits()))
+    (sm,) = ex.execute("i", parse_string("Sum(frame=f, field=v)"), None, None)
+    out.append((int(sm.value), int(sm.count)))
+    return out
+
+
+def test_pql_storm_auto_vs_dense_byte_identical(sparse_tier, holder, rng):
+    """The whole storm — Count over fold trees, Bitmap, TopN, Range,
+    Sum — over compressed planes must match the forced-dense arm bit
+    for bit (which the rest of the suite pins to the host oracle).
+    Runs on the virtual 8-device mesh (conftest), so the mesh-sharded
+    assembly path pages compressed rows through expand_payload."""
+    _f, oracle = _corpus(holder, rng)
+    c = new_cluster(1)
+    ex = Executor(holder, host=c.nodes[0].host, cluster=c)
+    plan.clear_program_caches()
+    auto_res = _storm(ex, 6)
+    bp.configure_plane_format(mode="dense")
+    plan.clear_program_caches()
+    dense_res = _storm(ex, 6)
+    assert auto_res == dense_res
+    # spot-check the oracle directly too
+    assert auto_res[0] == len(oracle[0] & oracle[1])
+    assert auto_res[-4] == tuple(sorted(oracle[0]))
+
+
+def test_storm_coalesced_byte_identical(sparse_tier, holder, rng):
+    """Counts routed through the coalescer over compressed planes match
+    the direct path."""
+    from pilosa_tpu.exec.coalesce import CoalesceScheduler
+
+    _f, oracle = _corpus(holder, rng, n_rows=4, slices=1)
+    c = new_cluster(1)
+    plain = Executor(holder, host=c.nodes[0].host, cluster=c)
+    want = _storm(plain, 4)
+    plain.close()
+    co = CoalesceScheduler(max_wait_us=0)
+    ex = Executor(holder, host=c.nodes[0].host, cluster=c, coalescer=co)
+    try:
+        assert _storm(ex, 4) == want
+    finally:
+        ex.close()
+        co.close()
+
+
+# ---------------------------------------------------------------------------
+# cross-format mutation: set/clear moves rows between formats
+# ---------------------------------------------------------------------------
+
+
+def test_row_mutates_across_formats(sparse_tier, holder, rng):
+    idx = holder.create_index_if_not_exists("i")
+    f = idx.create_frame_if_not_exists("f")
+    frag_oracle = set()
+
+    def check(expect_fmt=None):
+        frag = holder.fragment("i", "f", "standard", 0)
+        fmt, payload, _nb, card = frag.host_payload(7)
+        assert card == len(frag_oracle)
+        np.testing.assert_array_equal(
+            bp.np_row_to_columns(bp.decode_payload(fmt, payload)),
+            np.array(sorted(frag_oracle), dtype=np.uint64),
+        )
+        if expect_fmt is not None:
+            assert fmt == expect_fmt
+
+    # clustered run -> RLE
+    for col in range(1000, 3000):
+        f.set_bit("standard", 7, col)
+        frag_oracle.add(col)
+    check(bp.FMT_RLE)
+    # scatter bits everywhere -> too many runs, packed positions win
+    for col in rng.choice(SW, size=3000, replace=False):
+        f.set_bit("standard", 7, int(col))
+        frag_oracle.add(int(col))
+    check()
+    frag = holder.fragment("i", "f", "standard", 0)
+    fmt_now, *_ = frag.host_payload(7)
+    assert fmt_now in (bp.FMT_SPARSE, bp.FMT_RLE)
+    # bulk-scatter past the sparse/rle byte ceilings -> dense wins
+    more = [int(p) for p in rng.choice(SW, size=17_000, replace=False)]
+    f.import_bulk([7] * len(more), more)
+    frag_oracle.update(more)
+    check(bp.FMT_DENSE)
+    # clear back down to a handful -> compressed again
+    for col in sorted(frag_oracle)[10:]:
+        f.clear_bit("standard", 7, col)
+    frag_oracle = set(sorted(frag_oracle)[:10])
+    check(bp.FMT_SPARSE)
+
+
+def test_dense_tier_rows_stay_dense_format(holder, rng):
+    """Rows inside the dense budget serve FMT_DENSE payloads (the
+    PR-18 scatter path applies deltas into exactly these rows)."""
+    idx = holder.create_index_if_not_exists("i")
+    f = idx.create_frame_if_not_exists("f")
+    f.set_bit("standard", 1, 5)
+    frag = holder.fragment("i", "f", "standard", 0)
+    fmt, payload, nbytes, card = frag.host_payload(1)
+    assert fmt == bp.FMT_DENSE
+    assert nbytes == bp.WORDS_PER_SLICE * 4
+    assert card == 1
+
+
+# ---------------------------------------------------------------------------
+# program-cache bounds under format diversity
+# ---------------------------------------------------------------------------
+
+
+def test_format_diversity_respects_cache_bound(sparse_tier, holder, rng):
+    """Churning anchored queries across container formats, payload
+    buckets, and expression shapes must keep every program-cache family
+    inside its advertised ceiling."""
+    idx = holder.create_index_if_not_exists("i")
+    f = idx.create_frame_if_not_exists("f")
+    oracle = {}
+    rows_in, cols_in = [], []
+    # format diversity: rle / sparse / bigger payload buckets
+    for row, (maker, card) in enumerate(
+        [
+            (_clustered, 200),
+            (_scattered, 150),
+            (_clustered, 5000),
+            (_scattered, 4000),
+            (_clustered, 20_000),
+            (_scattered, 151),
+        ]
+    ):
+        cols = maker(rng, card)
+        oracle[row] = cols
+        for c in sorted(cols):
+            rows_in.append(row)
+            cols_in.append(c)
+    f.import_bulk(rows_in, cols_in)
+    c = new_cluster(1)
+    ex = Executor(holder, host=c.nodes[0].host, cluster=c)
+    plan.clear_program_caches()
+    for a in range(6):
+        for b in range(6):
+            if a == b:
+                continue
+            (got,) = ex.execute(
+                "i",
+                parse_string(
+                    f"Count(Intersect(Bitmap(rowID={a}, frame=f),"
+                    f" Bitmap(rowID={b}, frame=f)))"
+                ),
+                None,
+                None,
+            )
+            assert int(got) == len(oracle[a] & oracle[b])
+    stats = plan.program_cache_stats()
+    bounds = plan.program_cache_bounds()
+    assert stats.get("plan.anchored", 0) > 0
+    for fam in ("plan.anchored", "bitplane.expand"):
+        assert stats.get(fam, 0) <= bounds[fam], (fam, stats, bounds)

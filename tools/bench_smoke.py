@@ -56,6 +56,13 @@ def main() -> int:
         BENCH_INGEST_WRITES="80",
         BENCH_INGEST_READS="150",
         BENCH_INGEST_RESTAGE_ROUNDS="120",
+        # Sparse tier at smoke scale: one slice per density corpus,
+        # few timing reps — the assertions below are correctness/
+        # wiring (byte identity, format mix, resident ratio), never
+        # CPU timing.
+        BENCH_SPARSE_SLICES="1",
+        BENCH_SPARSE_ROWS="6",
+        BENCH_SPARSE_REPS="3",
     )
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py")],
@@ -361,6 +368,55 @@ def main() -> int:
     if (rs.get("scatter") or {}).get("launches", 0) < 1:
         print(f"FAIL: scatter arm never launched: {rs}", file=sys.stderr)
         return 1
+    # Sparse tier (ISSUE 19): compressed device planes.  Every density
+    # corpus must report byte-identical results between the auto and
+    # forced-dense arms; the low-density corpora must actually pick
+    # compressed container formats; and the 1% corpus's resident HBM
+    # must sit >= 10x below its logical dense geometry.
+    sp = out.get("sparse")
+    if not isinstance(sp, dict) or not isinstance(sp.get("densities"), dict):
+        print(f"FAIL: artifact missing sparse tier: {out}", file=sys.stderr)
+        return 1
+    spd = sp["densities"]
+    for tag in ("50", "5", "1", "0.1"):
+        ent = spd.get(tag)
+        if not isinstance(ent, dict):
+            print(f"FAIL: sparse tier missing density {tag}: {spd}",
+                  file=sys.stderr)
+            return 1
+        if ent.get("byte_identical") is not True:
+            print(
+                f"FAIL: sparse {tag}% storm diverged from the dense arm:"
+                f" {ent}",
+                file=sys.stderr,
+            )
+            return 1
+        if ent.get("storm_queries", 0) < 1:
+            print(f"FAIL: sparse {tag}% storm ran no queries: {ent}",
+                  file=sys.stderr)
+            return 1
+    d1 = spd["1"]
+    mix1 = d1.get("format_mix", {})
+    if mix1.get("rle", 0) < 1 or mix1.get("sparse", 0) < 1:
+        print(
+            f"FAIL: 1% corpus picked no compressed formats: {mix1}",
+            file=sys.stderr,
+        )
+        return 1
+    if d1.get("resident_ratio", 0) < 10:
+        print(
+            f"FAIL: 1% resident HBM under 10x below logical: {d1}",
+            file=sys.stderr,
+        )
+        return 1
+    if d1.get("bytes_read", 0) <= 0 or d1.get("logical_bytes", 0) <= d1.get(
+        "bytes_read", 0
+    ):
+        print(
+            f"FAIL: 1% effective bytes not below logical: {d1}",
+            file=sys.stderr,
+        )
+        return 1
     pc = out.get("program_cache")
     if not isinstance(pc, dict) or "entries" not in pc or "bounds" not in pc:
         print(f"FAIL: artifact missing program_cache: {out}", file=sys.stderr)
@@ -452,6 +508,8 @@ def main() -> int:
         f" ingest {gw['acks_per_s']} acks/s ({gw['fsyncs']} fsyncs /"
         f" {gw['acks']} acks), 50/50 read p99 {ig_ratio}x, re-stage"
         f" saving {rs['bytes_ratio']}x;"
+        f" sparse 1% mix {d1['format_mix']}, resident"
+        f" {d1.get('resident_ratio')}x below logical, byte-identical;"
         f" perf sites {sorted(sites)} (coalesce"
         f" {sites['coalesce']['gbps']} GB/s)"
     )
