@@ -5,7 +5,7 @@
 
 PYTHON ?= python
 
-.PHONY: default test lint analyze typecheck metrics-lint check bench bench-smoke chaos-smoke device-chaos-smoke load-smoke resize-smoke multichip-smoke tier-smoke replication-smoke subscribe-smoke ingest-smoke ingest-bench sparse-smoke sparse-bench churn-soak install build docker clean generate
+.PHONY: default test lint analyze typecheck metrics-lint check bench bench-smoke chaos-smoke device-chaos-smoke load-smoke resize-smoke multichip-smoke tier-smoke replication-smoke subscribe-smoke ingest-smoke ingest-bench sparse-smoke sparse-bench churn-soak gameday gameday-smoke install build docker clean generate
 
 default: build test
 
@@ -184,6 +184,21 @@ sparse-bench:
 # by bench-smoke.
 ingest-bench:
 	$(PYTHON) tools/ingest_bench.py
+
+# The everything-at-once soak (tools/gameday.py): one seeded run
+# composing every failure mode the stack claims to survive — a
+# multi-tenant fairness storm (victim p99 bounded while the hot tenant
+# sheds on quota), a kill -9'd replica recovering via WAL replay +
+# hint drain with zero lost acked writes, resize 2->3->2 under load
+# with a WINDOWED device-fault timeline and tier demote/hydrate,
+# subscription convergence across both cutovers, and gossip under
+# datagram loss.  Emits gameday.json; non-blocking soak lane in CI,
+# with the --smoke variant blocking.
+gameday:
+	$(PYTHON) tools/gameday.py --artifact gameday.json
+
+gameday-smoke:
+	$(PYTHON) tools/gameday.py --smoke --artifact gameday.json
 
 # Gossip churn soak (tools/churn_soak.py): 20-50 virtual members under
 # seeded datagram loss + member flapping; asserts membership converges

@@ -601,6 +601,48 @@ def main() -> None:
         except Exception as e:
             log(f"sparse tier failed: {e}")
 
+    # Gameday tier: the everything-at-once soak (tools/gameday.py) at
+    # full scale — multi-tenant fairness under a quota-shedding storm,
+    # kill -9 replica recovery with zero lost acked writes, resize
+    # 2->3->2 under a windowed device-fault timeline with tier
+    # demote/hydrate and subscription convergence, gossip under
+    # datagram loss.  A CPU subprocess like the cluster tiers (it
+    # re-execs onto its own virtual 8-device mesh); the per-leg
+    # numbers (victim p99 ratio, recovery counters, sub lag) land in
+    # the artifact as the composed-failure resilience record.
+    gameday_tier = None
+    if os.environ.get("BENCH_SKIP_GAMEDAY_TIER") != "1":
+        import subprocess
+
+        gd = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "tools",
+            "gameday.py",
+        )
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+        try:
+            out = subprocess.run(
+                [sys.executable, gd], env=env, capture_output=True,
+                timeout=900, text=True,
+            )
+            if out.returncode == 0 and out.stdout.strip():
+                for line in out.stderr.strip().splitlines():
+                    if line.startswith("[gameday"):
+                        log(line)
+                gameday_tier = json.loads(out.stdout.strip().splitlines()[-1])
+                fair = gameday_tier["legs"]["fairness"]
+                log(
+                    "gameday tier: all legs green — victim p99 "
+                    f"{fair['victim_p99_storm_ms']} ms under storm "
+                    f"({fair['ratio']}x isolated), hot shed "
+                    f"{fair['hot_shed']}, wall {gameday_tier['wall_s']} s"
+                )
+            else:
+                log(f"gameday tier failed: rc={out.returncode} "
+                    f"stderr={out.stderr.strip()[-300:]!r}")
+        except Exception as e:
+            log(f"gameday tier failed: {e}")
+
     # Mesh-scaling tier (ISSUE 12 / ROADMAP 2): the mesh-sharded data
     # plane end to end — devices-vs-Gcols/s curve at 1/2/4/8 devices,
     # the 10B-column Intersect+Count headline over the full mesh (ICI-
@@ -1003,6 +1045,8 @@ def main() -> None:
         out["ingest"] = ingest_tier
     if sparse_tier is not None:
         out["sparse"] = sparse_tier
+    if gameday_tier is not None:
+        out["gameday"] = gameday_tier
     out["program_cache"] = {
         "entries": plan.program_cache_stats(),
         "bounds": plan.program_cache_bounds(),

@@ -145,6 +145,10 @@ def build_server(cfg: config_mod.Config):
         admission_internal_concurrency=cfg.net.admission_internal_concurrency,
         admission_queue_depth=cfg.net.admission_queue_depth,
         admission_subscribe_concurrency=cfg.net.admission_subscribe_concurrency,
+        tenants=cfg.net.tenants,
+        tenant_keys=cfg.net.tenant_keys,
+        tenant_default=cfg.net.tenant_default,
+        tenant_internal_token=cfg.net.tenant_internal_token,
         rebalance_throttle_mbps=cfg.cluster.rebalance_throttle_mbps,
         rebalance_verify_rounds=cfg.cluster.rebalance_verify_rounds,
         rebalance_delta_cap=cfg.cluster.rebalance_delta_cap,

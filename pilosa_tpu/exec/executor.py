@@ -138,6 +138,13 @@ class ExecOptions:
     # slices; the handler surfaces it as the partial/missing_slices
     # response marker.  Sorted, deduplicated.
     missing_slices: list[int] = field(default_factory=list)
+    # Originating tenant (net/admission.py TenantRegistry): set by the
+    # handler after API-key resolution and forwarded as X-Tenant on
+    # every remote map leg, so a coordinator's fan-out is charged to
+    # the tenant that sent the query on every node it touches.  A
+    # field rather than a contextvar: map legs run on pool threads
+    # that don't inherit the handler's context.
+    tenant: str = ""
 
 
 @dataclass
@@ -507,9 +514,13 @@ class Executor:
         # the executor-side mix is visible even for direct library use
         # (no HTTP front) — dashboards correlate exec.class.* against
         # net.admission.* to see what the gates actually passed.
-        self.holder.stats.count_with_custom_tags(
-            "exec.class", 1, [f"class:{plan.cost_class(q.calls)}"]
-        )
+        class_tags = [f"class:{plan.cost_class(q.calls)}"]
+        if opt.tenant:
+            # Tenant-tagged only when QoS resolved one: untagged
+            # (library / single-tenant) deployments keep the exact
+            # class-only series their dashboards already chart.
+            class_tags.append(f"tenant:{opt.tenant}")
+        self.holder.stats.count_with_custom_tags("exec.class", 1, class_tags)
 
         # Bulk attribute-insert fast path (reference: executor.go:119-122).
         if q.calls and all(c.name == "SetRowAttrs" for c in q.calls):
@@ -3438,6 +3449,8 @@ class Executor:
             headers = self.tracer.remote_headers(sp)
             if extra_headers:
                 headers = {**(headers or {}), **extra_headers}
+            if opt.tenant:
+                headers = {**(headers or {}), "X-Tenant": opt.tenant}
             kwargs = {}
             if getattr(client, "supports_resilience", False):
                 kwargs["idempotent"] = idempotent

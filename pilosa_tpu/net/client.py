@@ -55,9 +55,15 @@ class InternalClient:
         timeout: float = 30.0,
         retry: "resilience.RetryPolicy | None" = None,
         breakers: "resilience.BreakerRegistry | None" = None,
+        internal_token: str = "",
     ):
         self.host = host
         self.timeout = timeout
+        # Proof of internal-lane membership (net/admission.py
+        # TenantRegistry.internal_ok): attached to every outbound
+        # request so map legs / imports / repair keep their lane when
+        # the server pins it behind a token.  Empty = trusted network.
+        self.internal_token = internal_token
         # Resilience wiring (net/resilience.py), shared across every
         # client a Server hands out: ``retry`` backs off over transport
         # failures on IDEMPOTENT calls (GETs, and POSTs explicitly
@@ -79,7 +85,11 @@ class InternalClient:
         if host == self.host:
             return self
         return InternalClient(
-            host, self.timeout, retry=self.retry, breakers=self.breakers
+            host,
+            self.timeout,
+            retry=self.retry,
+            breakers=self.breakers,
+            internal_token=self.internal_token,
         )
 
     # ------------------------------------------------------------------
@@ -189,6 +199,8 @@ class InternalClient:
         if self.breakers is not None:
             self.breakers.check(self.host)
         hdrs = dict(headers or {})
+        if self.internal_token:
+            hdrs.setdefault("X-Internal-Token", self.internal_token)
         timeout = self.timeout
         if dl is not None:
             timeout = min(timeout, max(dl.remaining(), 0.001))

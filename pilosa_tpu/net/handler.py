@@ -180,6 +180,7 @@ class Handler:
         slow_query_ms: float = 0.0,
         resilience=None,
         admission=None,
+        tenants=None,
         rebalance=None,
         tier=None,
         replication=None,
@@ -212,6 +213,12 @@ class Handler:
         # 429 + Retry-After BEFORE any coalescer/device work.  None =
         # admit everything (bare handler / tests).
         self.admission = admission
+        # Tenant QoS (net/admission.py TenantRegistry): API-key ->
+        # tenant resolution, internal-lane token verification, and the
+        # per-tenant table behind GET /debug/tenants.  None = every
+        # request rides the default tenant and the internal lane is
+        # open (bare handler / tests).
+        self.tenants = tenants
         # Elastic-cluster rebalancer (pilosa_tpu/rebalance): topology
         # events, resize coordination, delta-log/copy/release
         # endpoints, /debug/rebalance.  None = static cluster surface
@@ -318,6 +325,7 @@ class Handler:
             ("GET", r"/debug/ingest", self.handle_get_ingest),
             ("GET", r"/debug/rebalance", self.handle_get_rebalance),
             ("GET", r"/debug/vars", self.handle_get_vars),
+            ("GET", r"/debug/tenants", self.handle_get_tenants),
             ("GET", r"/debug/health", self.handle_get_health),
             ("GET", r"/debug/hbm", self.handle_get_hbm),
             ("GET", r"/debug/perf", self.handle_get_perf),
@@ -850,6 +858,7 @@ class Handler:
                 self.latency.observe_query(
                     str(root.tags.get("cost_class") or "unclassified"),
                     (time.monotonic() - t0) * 1e3,
+                    tenant=str(root.tags.get("tenant") or ""),
                 )
             except Exception:  # noqa: BLE001 — metrics never drop a response
                 pass
@@ -915,6 +924,15 @@ class Handler:
             )
         except ValueError as e:
             return self._query_error(req, str(e), 400)
+        # Internal-lane verification (net/admission.py TenantRegistry):
+        # the Remote flag earns the internal priority lane only with
+        # the cluster's token (when one is configured) — a client
+        # spoofing Remote is classified and charged like any other
+        # client request.  The coordinator forwards the ORIGIN tenant
+        # as X-Tenant on its map legs, so the fan-out is charged to
+        # whoever sent the query, on every node it touches.
+        internal = qreq["remote"] and self._internal_ok(req)
+        tenant = self._resolve_tenant(req, internal)
         opt = ExecOptions(
             remote=qreq["remote"],
             allow_partial=(
@@ -923,6 +941,7 @@ class Handler:
             ),
             write_consistency=write_consistency,
             read_consistency=read_consistency,
+            tenant=tenant,
         )
         # Remote write legs carry the quorum coordinator's per-slice
         # version stamp (taken at the PRIMARY after its local apply).
@@ -953,15 +972,21 @@ class Handler:
         # burn rate, which exist with or without admission gates.
         cls = (
             adm.CLASS_INTERNAL
-            if qreq["remote"]
+            if internal
             else plan_mod.cost_class(q.calls)
         )
         root.annotate(cost_class=cls)
+        if tenant:
+            root.annotate(tenant=tenant)
         ticket = None
         if self.admission is not None:
             try:
                 with self.tracer.span("admission", cost_class=cls) as sp:
-                    ticket = self.admission.acquire(cls)
+                    ticket = self.admission.acquire(
+                        cls,
+                        tenant=tenant,
+                        nbytes=len(req.body or b""),
+                    )
                     sp.annotate(wait_ms=round(ticket.wait_ms, 3))
             except rz.ShedError as e:
                 root.annotate(shed=True)
@@ -1083,24 +1108,58 @@ class Handler:
             return Response.proto(wire.QueryResponse(Err=message), status=status)
         return Response.json({"error": message}, status=status)
 
+    def _internal_ok(self, req: Request) -> bool:
+        """May this request claim the internal lane?  Open when no
+        registry / no token is configured (trusted network, every
+        pre-tenant deployment); token-gated otherwise, so tenants
+        cannot spoof X-Internal-Lane or the Remote flag past QoS."""
+        if self.tenants is None:
+            return True
+        return self.tenants.internal_ok(req.header("X-Internal-Token"))
+
+    def _resolve_tenant(self, req: Request, internal: bool = False) -> str:
+        """The tenant this request is charged to.  Client traffic:
+        X-Api-Key via the registry (a bare X-Tenant only for configured
+        tenants).  Verified internal traffic: the coordinator's
+        forwarded X-Tenant verbatim — the origin already paid admission
+        at its front door and map legs must charge the same account."""
+        if self.tenants is None:
+            return ""
+        if internal:
+            return req.header("X-Tenant") or self.tenants.default_tenant
+        return self.tenants.resolve(
+            req.header("X-Api-Key"), req.header("X-Tenant")
+        )
+
     def _shed_response(self, req: Request, e: rz.ShedError) -> Response:
         """429 + Retry-After: the node is healthy but at capacity, and
         the request was answered before any executor/device work.  The
         header carries whole seconds (HTTP contract, floored at 1);
-        the JSON body carries the precise millisecond hint."""
+        the JSON body carries the precise millisecond hint.  Quota
+        sheds additionally carry X-Quota-Limit / X-Quota-Remaining so
+        a well-behaved client can pace itself instead of retrying into
+        the same empty bucket."""
         import math
 
         if PROTOBUF in req.header("Accept"):
             resp = Response.proto(wire.QueryResponse(Err=str(e)), status=429)
         else:
-            resp = Response.json(
-                {
-                    "error": str(e),
-                    "retryAfterMs": round(e.retry_after_s * 1000.0, 1),
-                },
-                status=429,
-            )
+            body = {
+                "error": str(e),
+                "retryAfterMs": round(e.retry_after_s * 1000.0, 1),
+            }
+            if isinstance(e, adm.QuotaError):
+                body["quota"] = {
+                    "tenant": e.tenant,
+                    "kind": e.quota_kind,
+                    "limit": e.quota_limit,
+                    "remaining": round(e.quota_remaining, 3),
+                }
+            resp = Response.json(body, status=429)
         resp.headers["Retry-After"] = str(max(1, math.ceil(e.retry_after_s)))
+        if isinstance(e, adm.QuotaError):
+            resp.headers["X-Quota-Limit"] = f"{e.quota_limit:g}"
+            resp.headers["X-Quota-Remaining"] = f"{max(0.0, e.quota_remaining):g}"
         return resp
 
     def _admit(self, cls: str, req: Request):
@@ -1112,14 +1171,28 @@ class Handler:
         ``X-Internal-Lane`` reclasses the request onto the internal
         priority lane: hint replays push queued /import payloads
         through the client write route, and cluster-internal traffic
-        must never starve behind (or be shed as) a client storm."""
+        must never starve behind (or be shed as) a client storm.  The
+        reclass is token-gated like the query path's Remote flag."""
         if self.admission is None:
             return None, None
-        if req.header("X-Internal-Lane") in ("1", "true"):
+        internal = False
+        if req.header("X-Internal-Lane") in ("1", "true") and (
+            self._internal_ok(req)
+        ):
             cls = adm.CLASS_INTERNAL
+            internal = True
+        tenant = self._resolve_tenant(req, internal)
         dl = rz.Deadline.from_header(req.header(rz.DEADLINE_HEADER))
         try:
-            return self.admission.acquire(cls, deadline=dl), None
+            return (
+                self.admission.acquire(
+                    cls,
+                    deadline=dl,
+                    tenant=tenant,
+                    nbytes=len(req.body or b""),
+                ),
+                None,
+            )
         except rz.ShedError as e:
             return None, self._shed_response(req, e)
 
@@ -1807,6 +1880,28 @@ class Handler:
             # healthy/suspect/quarantined, watchdog trips, and the
             # node-level degraded flag peers see via gossip.
             out["device"] = dh.snapshot()
+        return Response.json(out)
+
+    def handle_get_tenants(self, req: Request) -> Response:
+        """The per-tenant QoS table (net/admission.py TenantRegistry):
+        weight, admitted/shed/quota-shed counters, queue-wait EWMA, and
+        live quota headroom per tenant, plus the per-class queue split
+        when any gate has tenants backlogged.  The operator's first
+        stop during a noisy-neighbor incident."""
+        if self.tenants is None:
+            return Response.json({"tenants": {}})
+        out: dict = {
+            "defaultTenant": self.tenants.default_tenant,
+            "tenants": self.tenants.snapshot(),
+        }
+        if self.admission is not None:
+            queued = {}
+            for cls, snap in self.admission.snapshot().items():
+                by = snap.get("queuedByTenant")
+                if by:
+                    queued[cls] = by
+            if queued:
+                out["queuedByClass"] = queued
         return Response.json(out)
 
     def handle_get_hbm(self, req: Request) -> Response:

@@ -110,6 +110,10 @@ class Server:
         ingest_scatter: bool = True,
         ingest_wal_segment_bytes: int = 4 << 20,
         admission_subscribe_concurrency: int = 4,
+        tenants=None,
+        tenant_keys=None,
+        tenant_default: str = "default",
+        tenant_internal_token: str = "",
         latency_buckets_ms=None,
         slo_ms: float = 0.0,
         slo_objective: float = 0.999,
@@ -215,6 +219,19 @@ class Server:
         # wait exceeds the request's remaining deadline.  Remote map
         # legs ride a separate internal priority lane so a saturated
         # cluster cannot distributed-livelock.
+        # Tenant QoS ([net] tenants/tenant-keys, net/admission.py
+        # TenantRegistry): API-key -> tenant resolution, WFQ weights,
+        # and quota buckets.  Built even when admission is off so the
+        # internal-lane token check and /debug/tenants still work.
+        from pilosa_tpu.net.admission import TenantRegistry
+
+        self.tenants = TenantRegistry(
+            tenants=tenants,
+            keys=tenant_keys,
+            default_tenant=tenant_default,
+            internal_token=tenant_internal_token,
+            stats=stats,
+        )
         self.admission = None
         if admission:
             from pilosa_tpu.net.admission import AdmissionController
@@ -227,6 +244,7 @@ class Server:
                 subscribe_concurrency=admission_subscribe_concurrency,
                 queue_depth=admission_queue_depth,
                 stats=stats,
+                tenants=self.tenants,
             )
 
         self.holder = Holder(data_dir)
@@ -328,6 +346,7 @@ class Server:
             host,
             retry=self.resilience.retry,
             breakers=self.resilience.breakers,
+            internal_token=self.tenants.internal_token,
         )
 
     # ------------------------------------------------------------------
@@ -524,6 +543,7 @@ class Server:
             slow_query_ms=self.slow_query_ms,
             resilience=self.resilience,
             admission=self.admission,
+            tenants=self.tenants,
             rebalance=self.rebalance,
             tier=self.tier,
             replication=self.replication,
@@ -813,6 +833,7 @@ class Server:
                     timeout=self.broadcast_timeout_ms / 1000.0,
                     retry=self.resilience.retry,
                     breakers=self.resilience.breakers,
+                    internal_token=self.tenants.internal_token,
                 )
                 for index_name, max_slice in client.max_slice_by_index().items():
                     idx = self.holder.index(index_name)

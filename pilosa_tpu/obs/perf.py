@@ -377,13 +377,21 @@ class LatencyHistograms:
         self._mu = threading.Lock()  # leaf lock: plain increments only
         # family -> {labels tuple -> _Series}
         self._fams: dict[str, dict[tuple, _Series]] = {
-            "query": {}, "http": {},
+            "query": {}, "http": {}, "tenant": {},
         }
 
     # -- hot path ------------------------------------------------------
 
-    def observe_query(self, cls: str, ms: float) -> None:
+    def observe_query(self, cls: str, ms: float, tenant: str = "") -> None:
         self._observe("query", (("class", cls),), ms)
+        if tenant:
+            # Separate family, not an extra label on "query": the
+            # per-class series (and its SLO burn math) stays exactly
+            # what single-tenant dashboards already chart, while
+            # tenants get their own histogram + SLO series.
+            self._observe(
+                "tenant", (("class", cls), ("tenant", tenant)), ms
+            )
 
     def observe_http(self, method: str, path: str, ms: float) -> None:
         self._observe("http", (("method", method), ("path", path)), ms)
@@ -396,7 +404,7 @@ class LatencyHistograms:
             s = fam.get(labels)
             if s is None:
                 s = fam[labels] = _Series(len(self.buckets))
-            if family == "query" and self.slo_ms > 0:
+            if family in ("query", "tenant") and self.slo_ms > 0:
                 # Checkpoint the totals BEFORE folding in this sample:
                 # the entry marks the window boundary, and the sample
                 # itself belongs inside the window.
@@ -444,8 +452,9 @@ class LatencyHistograms:
         now = time.monotonic()
         out: list[str] = []
         names = {"query": "pilosa_query_latency_ms",
-                 "http": "pilosa_http_latency_ms"}
-        for fam in ("query", "http"):
+                 "http": "pilosa_http_latency_ms",
+                 "tenant": "pilosa_tenant_query_latency_ms"}
+        for fam in ("query", "http", "tenant"):
             series = snap[fam]
             if not series:
                 continue
@@ -476,8 +485,10 @@ class LatencyHistograms:
             )
             err_lines: list[str] = []
             burn_lines: list[str] = []
-            for labels in sorted(snap["query"]):
-                counts, total, count, over, burn = snap["query"][labels]
+            slo_series = [("query", ls) for ls in sorted(snap["query"])]
+            slo_series += [("tenant", ls) for ls in sorted(snap["tenant"])]
+            for fam, labels in slo_series:
+                counts, total, count, over, burn = snap[fam][labels]
                 s = _Series(len(self.buckets))
                 s.count, s.over_slo = count, over
                 s.burn = deque(burn)

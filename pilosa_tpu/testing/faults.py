@@ -45,6 +45,12 @@ stage):
 * ``times`` — stop firing after this many hits
 * ``prob``  — fire with this probability, drawn from a per-rule RNG
   seeded by ``seed`` (default 0) — a seeded run is fully deterministic
+* ``after-ms`` / ``until-ms`` — activation window measured from the
+  moment the plan was installed (:func:`install` / first env read): the
+  rule matches only while ``after_ms <= elapsed < until_ms``.  A whole
+  composed-failure timeline (gameday) preinstalls one plan whose rules
+  activate and deactivate on schedule — no mid-run re-installs.
+  Windowed calls don't advance ``nth`` outside the window.
 
 Actions: ``mode=delay`` sleeps ``delay-ms`` and continues; ``mode=error``
 raises :class:`FaultError` (a ``ConnectionError``, so the retry policy
@@ -112,6 +118,8 @@ class FaultRule:
         mode: str = "error",
         kind: str | None = None,
         delay_ms: float = 0.0,
+        after_ms: float | None = None,
+        until_ms: float | None = None,
     ):
         if mode not in MODES:
             raise FaultSpecError(f"unknown fault mode: {mode!r}")
@@ -131,6 +139,18 @@ class FaultRule:
         self.mode = mode
         self.kind = kind
         self.delay_ms = float(delay_ms)
+        self.after_ms = float(after_ms) if after_ms is not None else None
+        self.until_ms = float(until_ms) if until_ms is not None else None
+        if (
+            self.after_ms is not None
+            and self.until_ms is not None
+            and self.until_ms <= self.after_ms
+        ):
+            raise FaultSpecError("until-ms must be > after-ms")
+        # Timeline epoch: set when the rule joins an installed plan, so
+        # after-ms/until-ms windows count from plan installation, not
+        # rule construction.
+        self._t0 = time.monotonic()
         self._rng = random.Random(seed if seed is not None else 0)
         self._mu = threading.Lock()
         # calls: invocations passing the STATIC filters (stage/host/
@@ -155,6 +175,12 @@ class FaultRule:
             path or "", self.path
         ):
             return False
+        if self.after_ms is not None or self.until_ms is not None:
+            elapsed_ms = (time.monotonic() - self._t0) * 1000.0
+            if self.after_ms is not None and elapsed_ms < self.after_ms:
+                return False
+            if self.until_ms is not None and elapsed_ms >= self.until_ms:
+                return False
         return True
 
     def consider(
@@ -214,12 +240,24 @@ class FaultRule:
                 out[k] = v
         if self.delay_ms:
             out["delayMs"] = self.delay_ms
+        if self.after_ms is not None:
+            out["afterMs"] = self.after_ms
+        if self.until_ms is not None:
+            out["untilMs"] = self.until_ms
         return out
 
 
 class FaultPlan:
     def __init__(self, rules):
         self.rules = list(rules)
+
+    def rearm(self) -> None:
+        """Restart every rule's timeline epoch — after-ms/until-ms
+        windows count from NOW.  Called by :func:`install` so a plan
+        built ahead of time starts its timeline at installation."""
+        now = time.monotonic()
+        for rule in self.rules:
+            rule._t0 = now
 
     def check(
         self,
@@ -237,7 +275,7 @@ class FaultPlan:
 
 
 _INT_KEYS = {"nth", "times", "seed", "device"}
-_FLOAT_KEYS = {"prob", "delay_ms"}
+_FLOAT_KEYS = {"prob", "delay_ms", "after_ms", "until_ms"}
 _STR_KEYS = {"path", "host", "mode", "kind"}
 
 
@@ -292,6 +330,7 @@ def install(plan: "FaultPlan | str") -> FaultPlan:
     global _plan
     if isinstance(plan, str):
         plan = parse(plan)
+    plan.rearm()
     _plan = plan
     return plan
 

@@ -322,6 +322,36 @@ class TestFaults:
         plan.check("rpc.send")
         assert plan.rules[0].hits == 2
 
+    def test_window_opens_and_closes(self):
+        """after-ms/until-ms bound a rule to a timeline window measured
+        from plan install — the gameday's composed-failure clock."""
+        plan = faults.install(
+            "rpc.send:mode=error,after-ms=40,until-ms=120"
+        )
+        plan.check("rpc.send")  # t≈0: window not open yet
+        time.sleep(0.06)
+        with pytest.raises(faults.FaultError):
+            plan.check("rpc.send")  # inside [40, 120)
+        time.sleep(0.09)
+        plan.check("rpc.send")  # window closed again
+        assert plan.rules[0].hits == 1
+        # outside-window calls don't advance nth/times accounting
+        assert plan.rules[0].calls == 1
+
+    def test_window_rearm_resets_epoch(self):
+        plan = faults.install("rpc.send:mode=error,after-ms=40")
+        time.sleep(0.05)
+        with pytest.raises(faults.FaultError):
+            plan.check("rpc.send")
+        plan.rearm()
+        plan.check("rpc.send")  # epoch reset: window closed again
+        snap = plan.snapshot()[0]
+        assert snap["afterMs"] == 40.0 and "untilMs" not in snap
+
+    def test_window_rejects_inverted_bounds(self):
+        with pytest.raises(faults.FaultSpecError):
+            faults.parse("rpc.send:after-ms=200,until-ms=100")
+
     def test_host_and_path_filters(self):
         plan = faults.install(
             "rpc.send:host=a:1,path=/index/*/query,mode=error"

@@ -44,6 +44,9 @@ def main() -> int:
         # The quorum-replication tier has its own smoke
         # (make replication-smoke).
         BENCH_SKIP_REPLICATION_TIER="1",
+        # The composed-failure soak has its own smoke
+        # (make gameday-smoke).
+        BENCH_SKIP_GAMEDAY_TIER="1",
         # Mesh-scaling tier at smoke scale: tiny curve corpus, a
         # 16M-column headline (the 10B default is the real bench run),
         # light node-grid seeding.

@@ -113,16 +113,74 @@ class Workload:
         raise ValueError(f"unknown kind {kind!r}")
 
 
+class TenantSpec:
+    """One ``name:weight[:qps[:bytes_per_s]]`` entry from ``--tenants``.
+    Weight picks the share of storm traffic this tenant generates; the
+    optional quotas are forwarded to the self-booted server config so
+    the harness can demonstrate 429-on-quota without a config file."""
+
+    __slots__ = ("name", "weight", "qps", "bytes_per_s")
+
+    def __init__(self, name: str, weight: float, qps: float = 0.0,
+                 bytes_per_s: float = 0.0):
+        self.name = name
+        self.weight = weight
+        self.qps = qps
+        self.bytes_per_s = bytes_per_s
+
+    @classmethod
+    def parse(cls, spec: str) -> "TenantSpec":
+        parts = spec.split(":")
+        if not parts[0] or len(parts) > 4:
+            raise ValueError(f"bad tenant spec {spec!r} "
+                             "(want name:weight[:qps[:bytes_per_s]])")
+        weight = float(parts[1]) if len(parts) > 1 and parts[1] else 1.0
+        qps = float(parts[2]) if len(parts) > 2 and parts[2] else 0.0
+        bps = float(parts[3]) if len(parts) > 3 and parts[3] else 0.0
+        if weight <= 0:
+            raise ValueError(f"tenant {parts[0]!r}: weight must be > 0")
+        return cls(parts[0], weight, qps, bps)
+
+    def server_spec(self) -> str:
+        """The ``[net] tenants`` entry equivalent of this spec."""
+        s = f"{self.name}:{max(1, int(round(self.weight)))}"
+        if self.qps or self.bytes_per_s:
+            s += f":{self.qps:g}"
+        if self.bytes_per_s:
+            s += f":{self.bytes_per_s:g}"
+        return s
+
+
+def parse_tenants(spec: str) -> list[TenantSpec]:
+    return [TenantSpec.parse(p) for p in spec.split(",") if p.strip()]
+
+
+def tenant_wheel(tenants: list[TenantSpec], steps: int = 1000) -> list[str]:
+    """Deterministic weighted wheel of tenant names (same trick as the
+    workload mix wheel: request i is charged to wheel[i % len])."""
+    import random
+
+    total = sum(t.weight for t in tenants)
+    wheel: list[str] = []
+    for t in tenants:
+        wheel.extend([t.name] * max(1, int(round(t.weight / total * steps))))
+    random.Random(1).shuffle(wheel)
+    return wheel
+
+
 _conn_local = threading.local()
 
 
 def _do_request(
-    host: str, method: str, path: str, body: bytes, deadline_ms: float
+    host: str, method: str, path: str, body: bytes, deadline_ms: float,
+    tenant: str = "",
 ) -> tuple[int, bytes]:
     """One HTTP request on this thread's keep-alive connection
     (reconnect once on a dead socket)."""
     timeout = deadline_ms / 1000.0 * 3 + 1.0
     headers = {"X-Deadline-Ms": str(int(deadline_ms))}
+    if tenant:
+        headers["X-Tenant"] = tenant
     for attempt in (0, 1):
         conn = getattr(_conn_local, "conn", None)
         if conn is None or getattr(_conn_local, "host", None) != host:
@@ -183,10 +241,13 @@ def run_point(
     offered_qps: float,
     duration_s: float,
     deadline_ms: float,
+    tenants: list[TenantSpec] | None = None,
 ) -> dict:
     """One open-loop point: fire ``offered_qps * duration_s`` requests
     at fixed schedule times; latency is measured from the SCHEDULED
-    start (coordinated-omission-free)."""
+    start (coordinated-omission-free).  With ``tenants``, each request
+    carries ``X-Tenant`` sampled from the weighted tenant wheel and
+    stats are additionally broken out per tenant."""
     n = max(1, int(offered_qps * duration_s))
     pool = ThreadPoolExecutor(
         max_workers=min(512, max(16, int(offered_qps * deadline_ms / 1000.0 * 2)))
@@ -200,29 +261,49 @@ def run_point(
         "errors": 0,
     }
     ok_latencies: list[float] = []
+    wheel = tenant_wheel(tenants) if tenants else []
+    by_tenant: dict[str, dict] = {
+        t.name: {"sent": 0, "ok": 0, "shed": 0, "errors": 0, "lat": []}
+        for t in (tenants or [])
+    }
 
     def fire(i: int, t_sched: float) -> None:
         kind, method, path, body = workload.request(i)
+        tenant = wheel[i % len(wheel)] if wheel else ""
         try:
-            status, _ = _do_request(host, method, path, body, deadline_ms)
+            status, _ = _do_request(
+                host, method, path, body, deadline_ms, tenant=tenant
+            )
         except Exception:  # noqa: BLE001 — client-side failure
             with lock:
                 stats["errors"] += 1
+                if tenant:
+                    by_tenant[tenant]["errors"] += 1
             return
         lat_ms = (time.monotonic() - t_sched) * 1000.0
         with lock:
+            ts = by_tenant.get(tenant)
+            if ts is not None:
+                ts["sent"] += 1
             if status == 200:
                 if lat_ms <= deadline_ms:
                     stats["ok_within_deadline"] += 1
                     ok_latencies.append(lat_ms)
                 else:
                     stats["ok_late"] += 1
+                if ts is not None:
+                    ts["ok"] += 1
+                    ts["lat"].append(lat_ms)
             elif status == 429:
                 stats["shed"] += 1
+                if ts is not None:
+                    ts["shed"] += 1
             elif status == 504:
                 stats["deadline_504"] += 1
             else:
                 stats["errors"] += 1
+                if ts is not None:
+                    ts["errors"] += 1
 
     t0 = time.monotonic()
     for i in range(n):
@@ -255,6 +336,17 @@ def run_point(
         "p50_ms": pct(0.50),
         "p99_ms": pct(0.99),
     }
+    if by_tenant:
+        tenants_out = {}
+        for name, ts in by_tenant.items():
+            lat = sorted(ts.pop("lat"))
+            ts["p99_ms"] = (
+                round(lat[min(len(lat) - 1, int(0.99 * len(lat)))], 2)
+                if lat else None
+            )
+            ts["shed_rate"] = round(ts["shed"] / max(ts["sent"], 1), 4)
+            tenants_out[name] = ts
+        out["tenants"] = tenants_out
     return out
 
 
@@ -265,10 +357,12 @@ def run_sweep(
     duration_s: float,
     deadline_ms: float,
     slo_ms: float,
+    tenants: list[TenantSpec] | None = None,
 ) -> dict:
     points = []
     for qps in qps_points:
-        pt = run_point(host, workload, qps, duration_s, deadline_ms)
+        pt = run_point(host, workload, qps, duration_s, deadline_ms,
+                       tenants=tenants)
         log(
             f"  offered {pt['offered_qps']:>8} qps -> goodput "
             f"{pt['goodput_qps']:>8} qps, p99 {pt['p99_ms']} ms, "
@@ -294,7 +388,8 @@ def run_sweep(
 # ---------------------------------------------------------------------------
 
 
-def boot_server(data_dir: str, args, admission_on: bool):
+def boot_server(data_dir: str, args, admission_on: bool,
+                tenants: list[TenantSpec] | None = None):
     from pilosa_tpu.net.server import Server
     from pilosa_tpu.obs.stats import ExpvarStatsClient
 
@@ -311,6 +406,9 @@ def boot_server(data_dir: str, args, admission_on: bool):
         admission_heavy_concurrency=args.heavy_concurrency,
         admission_write_concurrency=args.write_concurrency,
         admission_queue_depth=args.queue_depth,
+        # Configure the storm tenants server-side so bare X-Tenant tags
+        # resolve (unconfigured tags fall back to the default tenant).
+        tenants=[t.server_spec() for t in (tenants or [])],
     )
     s.open()
     return s
@@ -348,7 +446,9 @@ def self_boot_sweep(args, admission_on: bool) -> dict:
     import shutil
 
     td = tempfile.mkdtemp(prefix="load-harness-")
-    server = boot_server(os.path.join(td, "data"), args, admission_on)
+    tenants = parse_tenants(args.tenants) if args.tenants else None
+    server = boot_server(os.path.join(td, "data"), args, admission_on,
+                         tenants=tenants)
     try:
         mix = parse_mix(args.mix)
         seed_corpus(server, args.slices, seed_values="range" in mix or "import" in mix)
@@ -374,13 +474,15 @@ def self_boot_sweep(args, admission_on: bool) -> dict:
             ]
         out = run_sweep(
             server.host, workload, qps_points, args.duration,
-            args.deadline_ms, args.slo_ms,
+            args.deadline_ms, args.slo_ms, tenants=tenants,
         )
         out["admission"] = admission_on
         if capacity is not None:
             out["capacity_qps_closed_loop"] = round(capacity, 1)
         if admission_on and server.admission is not None:
             out["admission_snapshot"] = server.admission.snapshot()
+        if tenants is not None:
+            out["tenants_snapshot"] = server.tenants.snapshot()
         return out
     finally:
         server.close()
@@ -420,6 +522,12 @@ def main() -> int:
     ap.add_argument("--deadline-ms", type=float, default=500.0)
     ap.add_argument("--slo-ms", type=float, default=250.0,
                     help="p99 SLO for the max-sustained-QPS figure")
+    ap.add_argument(
+        "--tenants", default="",
+        help="name:weight[:qps[:bytes_per_s]][,name:weight...] — tag "
+        "each request with X-Tenant sampled by weight; self-boot also "
+        "configures the tenants (weights + quotas) server-side",
+    )
     ap.add_argument("--seed", action="store_true",
                     help="with --host: seed the corpus first")
     ap.add_argument("--point-concurrency", type=int, default=32)
@@ -431,6 +539,8 @@ def main() -> int:
     args = ap.parse_args()
 
     artifact: dict = {"tool": "load_harness", "mix": args.mix}
+    if args.tenants:
+        artifact["tenant_specs"] = args.tenants
     if args.self_boot or args.compare:
         log("=== sweep with admission control ===")
         artifact["admission_on"] = self_boot_sweep(args, admission_on=True)
@@ -456,6 +566,7 @@ def main() -> int:
         artifact["sweep"] = run_sweep(
             args.host, workload, qps_points, args.duration,
             args.deadline_ms, args.slo_ms,
+            tenants=parse_tenants(args.tenants) if args.tenants else None,
         )
         artifact["max_sustained_qps_at_p99_slo"] = artifact["sweep"][
             "max_sustained_qps_at_p99_slo"
